@@ -1,0 +1,39 @@
+"""tpu-parquet: a TPU-native Apache Parquet framework.
+
+A from-scratch, columnar, batch-oriented reimplementation of the capabilities of the
+pure-Go reference (fraugster/parquet-go — see SURVEY.md): full Parquet read/write
+(all 8 physical types, PLAIN / RLE-hybrid / dictionary / delta encodings, SNAPPY /
+GZIP / ZSTD codecs, data pages v1+v2, CRC32, statistics, nested LIST/MAP schemas),
+a textual schema-definition DSL, high-level object marshalling, and CLI tools —
+with the hot decode paths running as vectorized JAX/XLA kernels on TPU and row
+groups sharded across device meshes.
+"""
+
+__version__ = "0.1.0"
+
+from .footer import ParquetError, read_file_metadata
+from .format import (
+    CompressionCodec,
+    ConvertedType,
+    Encoding,
+    FieldRepetitionType,
+    FileMetaData,
+    LogicalType,
+    PageType,
+    SchemaElement,
+    Type,
+)
+
+__all__ = [
+    "ParquetError",
+    "read_file_metadata",
+    "FileMetaData",
+    "SchemaElement",
+    "Type",
+    "ConvertedType",
+    "LogicalType",
+    "FieldRepetitionType",
+    "Encoding",
+    "CompressionCodec",
+    "PageType",
+]
