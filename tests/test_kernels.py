@@ -1,0 +1,309 @@
+"""Unit tests for encoding kernels: bitpack, RLE hybrid, delta, plain, byte arrays.
+
+Mirrors the reference's primitive-level round-trip strategy (SURVEY.md §4.1:
+bitpacking32_test.go exhaustive width loops, hybrid_test.go, deltabp_test.go,
+types_test.go) with exhaustive widths and adversarial inputs.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_parquet.column import ByteArrayData
+from tpu_parquet.format import Type
+from tpu_parquet.kernels import bitpack, bytearray as ba_codec, delta, plain, rle
+
+
+# ---------------------------------------------------------------------------
+# bitpack
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("width", list(range(0, 65)))
+def test_bitpack_roundtrip_exhaustive_widths(width):
+    rng = np.random.default_rng(width)
+    n = 64
+    if width == 0:
+        vals = np.zeros(n, dtype=np.uint64)
+    elif width == 64:
+        vals = rng.integers(0, 2**63, n, dtype=np.uint64) * 2 + rng.integers(0, 2, n, dtype=np.uint64)
+    else:
+        vals = rng.integers(0, 2**width, n, dtype=np.uint64)
+    packed = bitpack.pack(vals, width)
+    assert len(packed) == (n * width + 7) // 8
+    out = bitpack.unpack(packed, width, n)
+    np.testing.assert_array_equal(out.astype(np.uint64), vals)
+
+
+def test_bitpack_known_vector():
+    # 3-bit values 0..7 packed LSB-first: the parquet spec's worked example.
+    vals = np.arange(8, dtype=np.uint64)
+    packed = bitpack.pack(vals, 3)
+    assert packed == bytes([0b10001000, 0b11000110, 0b11111010])
+    np.testing.assert_array_equal(bitpack.unpack(packed, 3, 8), vals)
+
+
+def test_bitpack_underflow_raises():
+    with pytest.raises(ValueError):
+        bitpack.unpack(b"\x01", 8, 9)
+
+
+# ---------------------------------------------------------------------------
+# RLE hybrid
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("width", [1, 2, 3, 5, 8, 12, 16, 20, 32])
+@pytest.mark.parametrize("use_rle", [True, False])
+def test_hybrid_roundtrip(width, use_rle):
+    rng = np.random.default_rng(width)
+    hi = min(2**width, 2**31)
+    cases = [
+        rng.integers(0, hi, 1000),
+        np.zeros(777, dtype=np.int64),
+        np.full(100, hi - 1, dtype=np.int64),
+        np.repeat(rng.integers(0, hi, 20), rng.integers(1, 50, 20)),
+        rng.integers(0, hi, 1),
+        rng.integers(0, hi, 8),
+        rng.integers(0, hi, 9),
+    ]
+    for vals in cases:
+        buf = rle.encode(vals.astype(np.uint64), width, use_rle_runs=use_rle)
+        out = rle.decode(buf, width, len(vals))
+        np.testing.assert_array_equal(out.astype(np.int64), vals)
+
+
+def test_hybrid_rle_runs_smaller_for_constant_data():
+    vals = np.zeros(10000, dtype=np.uint64)
+    with_rle = rle.encode(vals, 1, use_rle_runs=True)
+    without = rle.encode(vals, 1, use_rle_runs=False)
+    assert len(with_rle) < 10
+    assert len(without) > 1000
+
+
+def test_hybrid_mixed_runs_alignment():
+    # short noise + long constant run + short noise: exercises the borrow logic
+    rng = np.random.default_rng(0)
+    vals = np.concatenate([
+        rng.integers(0, 4, 5),
+        np.full(1000, 3),
+        rng.integers(0, 4, 3),
+        np.full(64, 1),
+        rng.integers(0, 4, 11),
+    ]).astype(np.uint64)
+    for width in (2, 3, 7):
+        out = rle.decode(rle.encode(vals, width), width, len(vals))
+        np.testing.assert_array_equal(out.astype(np.uint64), vals)
+
+
+def test_hybrid_width_zero():
+    buf = rle.encode(np.zeros(50, dtype=np.uint64), 0)
+    out = rle.decode(buf, 0, 50)
+    np.testing.assert_array_equal(out, np.zeros(50))
+
+
+def test_hybrid_bomb_run_header_clamped():
+    # one tiny input claiming 2^50 RLE repeats must not allocate 2^50 values
+    bomb = bytearray()
+    v = (1 << 50) << 1
+    while v >= 0x80:
+        bomb.append((v & 0x7F) | 0x80)
+        v >>= 7
+    bomb.append(v)
+    bomb.append(7)  # the repeated value (width 3 -> 1 byte)
+    out = rle.decode(bytes(bomb), 3, 100)
+    np.testing.assert_array_equal(out, np.full(100, 7))
+
+
+def test_gzip_bomb_declared_size_enforced():
+    import zlib as _z
+
+    from tpu_parquet.compress import CompressionError, compress_block, decompress_block
+    from tpu_parquet.format import CompressionCodec
+
+    bomb_plain = b"\x00" * 50_000_000
+    comp = compress_block(bomb_plain, CompressionCodec.GZIP)
+    # declares 10 bytes but inflates to 50MB: must raise without materializing
+    with pytest.raises(CompressionError):
+        decompress_block(comp, CompressionCodec.GZIP, 10)
+
+
+def test_hybrid_truncated_raises():
+    buf = rle.encode(np.arange(100, dtype=np.uint64), 7)
+    with pytest.raises(rle.RLEError):
+        rle.decode(buf[: len(buf) // 2], 7, 100)
+    with pytest.raises(rle.RLEError):
+        rle.decode(b"", 7, 1)
+
+
+def test_hybrid_prefixed():
+    vals = np.arange(64, dtype=np.uint64) % 8
+    buf = rle.encode_prefixed(vals, 3)
+    out, consumed = rle.decode_prefixed(buf, 3, 64)
+    assert consumed == len(buf)
+    np.testing.assert_array_equal(out.astype(np.uint64), vals)
+    with pytest.raises(rle.RLEError):
+        rle.decode_prefixed(b"\x01\x00", 3, 64)
+
+
+def test_hybrid_decoder_reads_rle_runs_from_other_writers():
+    # Hand-built stream: RLE run of 13 sevens (width 3), then bitpacked group 0..7
+    buf = bytes([13 << 1, 7]) + bytes([(1 << 1) | 1]) + bitpack.pack(
+        np.arange(8, dtype=np.uint64), 3
+    )
+    out = rle.decode(buf, 3, 21)
+    np.testing.assert_array_equal(
+        out, np.concatenate([np.full(13, 7), np.arange(8)])
+    )
+
+
+# ---------------------------------------------------------------------------
+# DELTA_BINARY_PACKED
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("bits", [32, 64])
+def test_delta_roundtrip(bits):
+    rng = np.random.default_rng(bits)
+    lo, hi = (-(2**31), 2**31 - 1) if bits == 32 else (-(2**62), 2**62)
+    dtype = np.int32 if bits == 32 else np.int64
+    cases = [
+        np.arange(1000, dtype=dtype),
+        np.arange(1000, 0, -1, dtype=dtype),
+        rng.integers(lo, hi, 10_000).astype(dtype),
+        np.zeros(1, dtype=dtype),
+        np.array([lo, hi, lo, hi, 0], dtype=dtype),  # min-delta overflow edges
+        np.array([], dtype=dtype),
+        rng.integers(-5, 5, 129).astype(dtype),      # partial final block
+        rng.integers(lo, hi, 127).astype(dtype),     # partial final miniblock
+        np.full(500, 42, dtype=dtype),
+    ]
+    for vals in cases:
+        buf = delta.encode(vals, bits=bits)
+        out, consumed = delta.decode(buf, bits=bits)
+        assert consumed == len(buf)
+        np.testing.assert_array_equal(out[: len(vals)], vals)
+
+
+def test_delta_wrapping_min_delta():
+    # int64 extremes: delta arithmetic must wrap like the reference's Go int64
+    vals = np.array([0, 2**62, -(2**62), 2**62], dtype=np.int64)
+    out, _ = delta.decode(delta.encode(vals, bits=64), bits=64)
+    np.testing.assert_array_equal(out[:4], vals)
+
+
+def test_delta_malformed_raises():
+    good = delta.encode(np.arange(100, dtype=np.int64))
+    for cut in (0, 1, 3, len(good) // 2):
+        with pytest.raises(delta.DeltaError):
+            delta.decode(good[:cut])
+    # invalid block geometry
+    with pytest.raises(delta.DeltaError):
+        delta.decode(b"\x05\x04\x0a\x00")  # block_size=5 not multiple of 128
+
+
+# ---------------------------------------------------------------------------
+# PLAIN codecs
+# ---------------------------------------------------------------------------
+
+def test_plain_fixed_types_roundtrip():
+    rng = np.random.default_rng(7)
+    cases = [
+        (Type.INT32, rng.integers(-(2**31), 2**31, 500).astype(np.int32)),
+        (Type.INT64, rng.integers(-(2**63), 2**63 - 1, 500).astype(np.int64)),
+        (Type.FLOAT, rng.normal(size=500).astype(np.float32)),
+        (Type.DOUBLE, rng.normal(size=500).astype(np.float64)),
+    ]
+    for ptype, vals in cases:
+        buf = plain.encode(vals, ptype)
+        out = plain.decode(buf, ptype, len(vals))
+        np.testing.assert_array_equal(out, vals)
+
+
+def test_plain_nan_preserved():
+    vals = np.array([np.nan, 1.0, -np.inf, np.inf], dtype=np.float64)
+    out = plain.decode(plain.encode(vals, Type.DOUBLE), Type.DOUBLE, 4)
+    np.testing.assert_array_equal(np.isnan(out), np.isnan(vals))
+    assert out[2] == -np.inf
+
+
+def test_plain_boolean_roundtrip():
+    rng = np.random.default_rng(3)
+    for n in (1, 7, 8, 9, 1000):
+        vals = rng.integers(0, 2, n).astype(bool)
+        out = plain.decode(plain.encode(vals, Type.BOOLEAN), Type.BOOLEAN, n)
+        np.testing.assert_array_equal(out, vals)
+
+
+def test_plain_int96_roundtrip():
+    rng = np.random.default_rng(4)
+    vals = rng.integers(0, 2**32, (20, 3)).astype("<u4")
+    out = plain.decode(plain.encode(vals, Type.INT96), Type.INT96, 20)
+    np.testing.assert_array_equal(out, vals)
+
+
+def test_plain_byte_array_roundtrip():
+    items = [b"", b"a", b"hello world", b"\x00" * 100, "héllo".encode()]
+    ba = ByteArrayData.from_list(items)
+    buf = plain.encode(ba, Type.BYTE_ARRAY)
+    out = plain.decode(buf, Type.BYTE_ARRAY, len(items))
+    assert out.to_list() == items
+
+
+def test_plain_byte_array_malformed():
+    with pytest.raises(plain.PlainError):
+        plain.decode_byte_array(b"\xff\xff\xff\xff", 1)  # huge length
+    with pytest.raises(plain.PlainError):
+        plain.decode_byte_array(b"\x02\x00\x00\x00a", 1)  # truncated payload
+    with pytest.raises(plain.PlainError):
+        plain.decode_byte_array(b"", 1)
+
+
+def test_plain_fixed_len_byte_array():
+    items = [b"abcd", b"wxyz", b"1234"]
+    ba = ByteArrayData.from_list(items)
+    buf = plain.encode(ba, Type.FIXED_LEN_BYTE_ARRAY, type_length=4)
+    assert buf == b"abcdwxyz1234"
+    out = plain.decode(buf, Type.FIXED_LEN_BYTE_ARRAY, 3, type_length=4)
+    assert out.to_list() == items
+    with pytest.raises(plain.PlainError):
+        plain.encode(ByteArrayData.from_list([b"abc"]), Type.FIXED_LEN_BYTE_ARRAY, 4)
+
+
+def test_plain_truncated_raises():
+    with pytest.raises(plain.PlainError):
+        plain.decode(b"\x01\x02", Type.INT64, 1)
+
+
+# ---------------------------------------------------------------------------
+# Delta byte-array codecs
+# ---------------------------------------------------------------------------
+
+def test_delta_length_byte_array_roundtrip():
+    items = [b"alpha", b"", b"beta", b"gamma" * 50, b"d"]
+    ba = ByteArrayData.from_list(items)
+    out = ba_codec.decode_delta_length(ba_codec.encode_delta_length(ba), len(items))
+    assert out.to_list() == items
+
+
+def test_delta_byte_array_roundtrip():
+    items = [b"apple", b"applesauce", b"applet", b"banana", b"band", b"", b"c"]
+    ba = ByteArrayData.from_list(items)
+    buf = ba_codec.encode_delta(ba)
+    out = ba_codec.decode_delta(buf, len(items))
+    assert out.to_list() == items
+    # sorted-ish data should beat plain length-delta thanks to prefix sharing
+    sorted_items = [f"user_{i:08d}".encode() for i in range(1000)]
+    ba2 = ByteArrayData.from_list(sorted_items)
+    assert len(ba_codec.encode_delta(ba2)) < len(ba_codec.encode_delta_length(ba2))
+    out2 = ba_codec.decode_delta(ba_codec.encode_delta(ba2), 1000)
+    assert out2.to_list() == sorted_items
+
+
+def test_delta_byte_array_malformed():
+    items = [b"aa", b"ab"]
+    buf = ba_codec.encode_delta(ByteArrayData.from_list(items))
+    with pytest.raises((ba_codec.ByteArrayError, delta.DeltaError)):
+        ba_codec.decode_delta(buf[: len(buf) - 2], 2)
+
+
+def test_byte_array_take():
+    ba = ByteArrayData.from_list([b"zero", b"one", b"two", b""])
+    out = ba.take(np.array([3, 1, 1, 0]))
+    assert out.to_list() == [b"", b"one", b"one", b"zero"]

@@ -1,0 +1,281 @@
+"""End-to-end read conformance against pyarrow-written files.
+
+The canonical-implementation cross-check that the reference gets from
+parquet-testing/parquet-mr corpora (SURVEY.md §4.5-4.6): pyarrow writes a matrix of
+{types × codecs × page versions × encodings × null patterns}; our reader must
+produce identical values.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpu_parquet.column import ByteArrayData
+from tpu_parquet.footer import ParquetError
+from tpu_parquet.reader import FileReader
+
+
+def write(tmp_path, table, name="t.parquet", **kw):
+    p = tmp_path / name
+    pq.write_table(table, p, **kw)
+    return p
+
+
+def expect_column(path, col_name, expected):
+    with FileReader(path) as r:
+        got = r.read_pylist()[col_name]
+    assert len(got) == len(expected)
+    for i, (g, e) in enumerate(zip(got, expected)):
+        if e is None:
+            assert g is None, f"row {i}: expected None, got {g!r}"
+        elif isinstance(e, float):
+            assert g == pytest.approx(e, nan_ok=True), f"row {i}"
+        else:
+            assert g == e, f"row {i}: {g!r} != {e!r}"
+
+
+# ---------------------------------------------------------------------------
+# Minimum end-to-end slice (SURVEY.md §7.3): int64 PLAIN + SNAPPY
+# ---------------------------------------------------------------------------
+
+def test_int64_plain_snappy(tmp_path):
+    data = list(range(100_000))
+    p = write(
+        tmp_path, pa.table({"v": pa.array(data, pa.int64())}),
+        compression="snappy", use_dictionary=False,
+    )
+    with FileReader(p) as r:
+        cols = r.read_all()
+        np.testing.assert_array_equal(cols["v"].values, np.arange(100_000))
+
+
+@pytest.mark.parametrize("codec", ["none", "snappy", "gzip", "zstd"])
+@pytest.mark.parametrize("page_version", ["1.0", "2.0"])
+def test_codec_page_matrix(tmp_path, codec, page_version):
+    rng = np.random.default_rng(1)
+    ints = rng.integers(-(2**60), 2**60, 5000)
+    data = {
+        "i32": pa.array(rng.integers(-(2**31), 2**31, 5000), pa.int32()),
+        "i64": pa.array(ints, pa.int64()),
+        "f32": pa.array(rng.normal(size=5000).astype(np.float32), pa.float32()),
+        "f64": pa.array(rng.normal(size=5000), pa.float64()),
+        "b": pa.array(rng.integers(0, 2, 5000).astype(bool)),
+        "s": pa.array([f"val_{i % 100}" for i in range(5000)]),
+    }
+    table = pa.table(data)
+    p = write(
+        tmp_path, table, compression=codec, data_page_version=page_version,
+    )
+    with FileReader(p) as r:
+        assert r.num_rows == 5000
+        got = r.read_pylist()
+    for name in data:
+        expected = table.column(name).to_pylist()
+        if name in ("f32", "f64"):
+            np.testing.assert_allclose(got[name], expected, rtol=1e-6)
+        else:
+            assert got[name] == expected
+
+
+@pytest.mark.parametrize("page_version", ["1.0", "2.0"])
+def test_nulls_optional_columns(tmp_path, page_version):
+    rng = np.random.default_rng(2)
+    vals = [None if rng.random() < 0.3 else int(i) for i in range(10_000)]
+    strs = [None if rng.random() < 0.3 else f"s{i}" for i in range(10_000)]
+    table = pa.table({
+        "v": pa.array(vals, pa.int64()),
+        "s": pa.array(strs, pa.string()),
+    })
+    p = write(tmp_path, table, data_page_version=page_version,
+              use_dictionary=False)
+    expect_column(p, "v", vals)
+    expect_column(p, "s", strs)
+
+
+def test_all_null_column(tmp_path):
+    table = pa.table({"v": pa.array([None] * 100, pa.int64())})
+    p = write(tmp_path, table)
+    expect_column(p, "v", [None] * 100)
+
+
+def test_dictionary_encoded_strings(tmp_path):
+    vals = [f"city_{i % 50}" for i in range(50_000)]
+    p = write(tmp_path, pa.table({"s": pa.array(vals)}), use_dictionary=True)
+    expect_column(p, "s", vals)
+
+
+def test_dictionary_encoded_numbers_with_nulls(tmp_path):
+    rng = np.random.default_rng(3)
+    vals = [None if rng.random() < 0.1 else int(rng.integers(0, 20)) for _ in range(20_000)]
+    p = write(tmp_path, pa.table({"v": pa.array(vals, pa.int64())}),
+              use_dictionary=True)
+    expect_column(p, "v", vals)
+
+
+def test_dictionary_fallback_mixed_pages(tmp_path):
+    # dictionary overflow mid-chunk: arrow falls back to plain pages in the same
+    # chunk; both page kinds must decode
+    vals = [f"unique_{i}" for i in range(100_000)]
+    p = write(tmp_path, pa.table({"s": pa.array(vals)}),
+              use_dictionary=True, dictionary_pagesize_limit=4096)
+    expect_column(p, "s", vals)
+
+
+def test_delta_binary_packed(tmp_path):
+    rng = np.random.default_rng(4)
+    i64 = rng.integers(-(2**40), 2**40, 30_000)
+    i32 = rng.integers(-(2**28), 2**28, 30_000).astype(np.int32)
+    table = pa.table({"a": pa.array(i64, pa.int64()),
+                      "b": pa.array(i32, pa.int32())})
+    p = write(tmp_path, table, use_dictionary=False,
+              column_encoding={"a": "DELTA_BINARY_PACKED",
+                               "b": "DELTA_BINARY_PACKED"})
+    with FileReader(p) as r:
+        cols = r.read_all()
+    np.testing.assert_array_equal(cols["a"].values, i64)
+    np.testing.assert_array_equal(cols["b"].values, i32)
+
+
+def test_delta_byte_array_encodings(tmp_path):
+    vals = sorted(f"prefix_shared_{i:06d}" for i in range(5000))
+    table = pa.table({
+        "dba": pa.array(vals), "dlba": pa.array(vals),
+    })
+    p = write(tmp_path, table, use_dictionary=False,
+              column_encoding={"dba": "DELTA_BYTE_ARRAY",
+                               "dlba": "DELTA_LENGTH_BYTE_ARRAY"})
+    expect_column(p, "dba", vals)
+    expect_column(p, "dlba", vals)
+
+
+def test_byte_stream_split(tmp_path):
+    rng = np.random.default_rng(5)
+    f32 = rng.normal(size=5000).astype(np.float32)
+    f64 = rng.normal(size=5000)
+    table = pa.table({"a": pa.array(f32, pa.float32()),
+                      "b": pa.array(f64, pa.float64())})
+    p = write(tmp_path, table, use_dictionary=False,
+              column_encoding={"a": "BYTE_STREAM_SPLIT",
+                               "b": "BYTE_STREAM_SPLIT"})
+    with FileReader(p) as r:
+        cols = r.read_all()
+    np.testing.assert_array_equal(cols["a"].values, f32)
+    np.testing.assert_array_equal(cols["b"].values, f64)
+
+
+def test_fixed_len_byte_array(tmp_path):
+    vals = [bytes([i] * 16) for i in range(200)]
+    table = pa.table({"u": pa.array(vals, pa.binary(16))})
+    p = write(tmp_path, table, use_dictionary=False)
+    expect_column(p, "u", vals)
+
+
+def test_boolean_rle_v2(tmp_path):
+    rng = np.random.default_rng(6)
+    vals = rng.integers(0, 2, 10_000).astype(bool).tolist()
+    table = pa.table({"b": pa.array(vals)})
+    # v2 pages encode booleans with RLE
+    p = write(tmp_path, table, data_page_version="2.0", use_dictionary=False,
+              column_encoding={"b": "RLE"})
+    expect_column(p, "b", vals)
+
+
+def test_multi_row_group_and_multi_page(tmp_path):
+    vals = list(range(250_000))
+    table = pa.table({"v": pa.array(vals, pa.int64())})
+    p = write(tmp_path, table, row_group_size=50_000,
+              data_page_size=4096, use_dictionary=False)
+    with FileReader(p) as r:
+        assert r.num_row_groups == 5
+        rg0 = r.read_row_group(0)
+        np.testing.assert_array_equal(rg0["v"].values, np.arange(50_000))
+        all_cols = r.read_all()
+        np.testing.assert_array_equal(all_cols["v"].values, np.array(vals))
+
+
+def test_column_projection(tmp_path):
+    table = pa.table({"a": [1, 2, 3], "b": ["x", "y", "z"], "c": [1.0, 2.0, 3.0]})
+    p = write(tmp_path, table)
+    with FileReader(p, columns=["a", "c"]) as r:
+        cols = r.read_all()
+        assert set(cols) == {"a", "c"}
+        np.testing.assert_array_equal(cols["a"].values, [1, 2, 3])
+
+
+def test_nested_list_levels_decoded(tmp_path):
+    table = pa.table({
+        "lst": pa.array([[1, 2], None, [], [3, 4, 5]], pa.list_(pa.int64())),
+    })
+    p = write(tmp_path, table, use_dictionary=False)
+    with FileReader(p) as r:
+        cols = r.read_all()
+    cd = cols["lst.list.element"]
+    assert cd.max_def == 3 and cd.max_rep == 1
+    np.testing.assert_array_equal(cd.values, [1, 2, 3, 4, 5])
+    # slots: [1,2] -> d3r0,d3r1 | None -> d0r0 | [] -> d1r0 | [3,4,5] -> d3r0,d3r1,d3r1
+    np.testing.assert_array_equal(cd.def_levels, [3, 3, 0, 1, 3, 3, 3])
+    np.testing.assert_array_equal(cd.rep_levels, [0, 1, 0, 0, 0, 1, 1])
+
+
+def test_int96_timestamps(tmp_path):
+    import datetime
+
+    ts = [datetime.datetime(2020, 1, 1) + datetime.timedelta(hours=i) for i in range(100)]
+    table = pa.table({"t": pa.array(ts, pa.timestamp("ns"))})
+    p = write(tmp_path, table, use_deprecated_int96_timestamps=True)
+    with FileReader(p) as r:
+        cols = r.read_all()
+    assert cols["t"].values.shape == (100, 3)
+
+
+def test_crc_validation(tmp_path):
+    table = pa.table({"v": pa.array(range(1000), pa.int64())})
+    p = write(tmp_path, table, write_page_checksum=True, use_dictionary=False)
+    with FileReader(p, validate_crc=True) as r:
+        np.testing.assert_array_equal(r.read_all()["v"].values, np.arange(1000))
+    # corrupt one byte of page *payload* (end of chunk, past the header) -> CRC
+    # must catch it; without validation the corrupt value is returned silently
+    blob = bytearray(p.read_bytes())
+    with FileReader(blob) as probe:
+        md = probe.metadata.row_groups[0].columns[0].meta_data
+        end = md.data_page_offset + md.total_compressed_size
+    blob[end - 10] ^= 0xFF
+    with pytest.raises(ParquetError, match="CRC"):
+        with FileReader(bytes(blob), validate_crc=True) as r:
+            r.read_all()
+    with FileReader(bytes(blob), validate_crc=False) as r:
+        assert not np.array_equal(r.read_all()["v"].values, np.arange(1000))
+
+
+def test_memory_budget(tmp_path):
+    from tpu_parquet.alloc import MemoryBudgetExceeded
+
+    table = pa.table({"v": pa.array(range(100_000), pa.int64())})
+    p = write(tmp_path, table, use_dictionary=False)
+    with FileReader(p, max_memory=1000) as r:
+        with pytest.raises(MemoryBudgetExceeded):
+            r.read_all()
+    with FileReader(p, max_memory=100 * 1024 * 1024) as r:
+        assert len(r.read_all()["v"].values) == 100_000
+
+
+def test_metadata_accessors(tmp_path):
+    table = pa.table({"v": [1, 2, 3]})
+    p = write(tmp_path, table)
+    with FileReader(p) as r:
+        assert r.num_rows == 3
+        assert "parquet-cpp-arrow" in r.created_by
+        assert r.row_group_num_rows(0) == 3
+        assert len(r.columns()) == 1
+        # pyarrow stashes its schema in key-value metadata
+        assert isinstance(r.key_value_metadata(), dict)
+
+
+def test_empty_table(tmp_path):
+    table = pa.table({"v": pa.array([], pa.int64())})
+    p = write(tmp_path, table)
+    with FileReader(p) as r:
+        assert r.num_rows == 0
+        cols = r.read_all()
+        assert len(cols["v"].values) == 0

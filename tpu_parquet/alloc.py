@@ -1,0 +1,51 @@
+"""Memory-budget enforcement for adversarial files.
+
+Equivalent of the reference's allocTracker (alloc.go:10-89): decoders register the
+sizes of buffers they are about to materialize (decompressed pages, value arrays);
+exceeding the configured budget raises instead of OOMing on decompression bombs.
+Python has no finalizer-based decrement need here because tracking is scoped to a
+single read operation and reset per row group.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class MemoryBudgetExceeded(MemoryError):
+    def __init__(self, requested: int, total: int, budget: int):
+        super().__init__(
+            f"memory budget exceeded: allocating {requested} bytes would bring the "
+            f"total to {total} of a {budget}-byte budget (suspected corrupt or "
+            f"malicious file)"
+        )
+        self.requested = requested
+        self.total = total
+        self.budget = budget
+
+
+class AllocTracker:
+    """Running byte counter with a hard cap (0 = unlimited)."""
+
+    def __init__(self, max_size: int = 0):
+        self.max_size = int(max_size)
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def register(self, nbytes: int) -> None:
+        if self.max_size <= 0:
+            return
+        with self._lock:
+            self.total += int(nbytes)
+            if self.total > self.max_size:
+                raise MemoryBudgetExceeded(int(nbytes), self.total, self.max_size)
+
+    def release(self, nbytes: int) -> None:
+        if self.max_size <= 0:
+            return
+        with self._lock:
+            self.total -= int(nbytes)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.total = 0

@@ -1,0 +1,409 @@
+"""Column-chunk decoding: page walk + (type × encoding) dispatch → ColumnData.
+
+Equivalent of the reference's chunk_reader.go (readChunk/readPages/readPageBlock +
+getValuesDecoder dispatch :106-159) and page_v1.go/page_v2.go/page_dict.go — but
+columnar: the whole chunk's byte range is read in one IO (that is also the unit
+shipped to TPU HBM), pages are sliced out of the buffer, and every decode step is a
+bulk array transform rather than a value-at-a-time interface call.
+
+Encoding support matrix mirrors chunk_reader.go:106-159 exactly, plus
+BYTE_STREAM_SPLIT (in the format since 2.8; the Go reference lacks it).
+PLAIN_DICTIONARY is aliased to RLE_DICTIONARY on read (chunk_reader.go:108-110).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import BinaryIO, Optional
+
+import numpy as np
+
+from .alloc import AllocTracker
+from .column import ByteArrayData, ColumnData
+from .compress import decompress_block
+from .footer import ParquetError
+from .format import Encoding, PageHeader, PageType, Type
+from .kernels import bitpack, bytearray as ba_codec, delta, plain, rle
+from .schema.core import SchemaNode
+from .thrift import ThriftError, read_struct
+
+
+@dataclass
+class PageSlice:
+    """One page located inside a chunk buffer (header + payload span)."""
+
+    header: PageHeader
+    payload_start: int
+    payload_end: int
+
+
+def walk_pages(buf: bytes, total_values: int) -> list[PageSlice]:
+    """Parse page headers until the chunk's declared value count is consumed.
+
+    Mirrors readPages (chunk_reader.go:182-263): iterate thrift PageHeaders and
+    their payloads; dictionary pages don't count toward the value total.
+    """
+    pages: list[PageSlice] = []
+    pos = 0
+    seen_values = 0
+    seen_dict = False
+    n = len(buf)
+    while seen_values < total_values:
+        if pos >= n:
+            raise ParquetError(
+                f"chunk exhausted at {seen_values}/{total_values} values"
+            )
+        try:
+            header, pos = read_struct(PageHeader, buf, pos)
+        except ThriftError as e:
+            raise ParquetError(f"corrupt page header: {e}") from e
+        if header.compressed_page_size is None or header.compressed_page_size < 0:
+            raise ParquetError(
+                f"invalid compressed page size {header.compressed_page_size}"
+            )
+        if header.uncompressed_page_size is None or header.uncompressed_page_size < 0:
+            raise ParquetError(
+                f"invalid uncompressed page size {header.uncompressed_page_size}"
+            )
+        end = pos + header.compressed_page_size
+        if end > n:
+            raise ParquetError("page payload extends past chunk end")
+        ptype = header.type
+        if ptype == PageType.DICTIONARY_PAGE:
+            if seen_dict or pages:
+                # only one dict page, and only at the start (chunk_reader.go:196-199)
+                raise ParquetError("unexpected extra dictionary page")
+            if header.dictionary_page_header is None:
+                raise ParquetError("dictionary page missing its header")
+            seen_dict = True
+        elif ptype == PageType.DATA_PAGE:
+            if header.data_page_header is None:
+                raise ParquetError("data page v1 missing its header")
+            seen_values += header.data_page_header.num_values or 0
+        elif ptype == PageType.DATA_PAGE_V2:
+            if header.data_page_header_v2 is None:
+                raise ParquetError("data page v2 missing its header")
+            seen_values += header.data_page_header_v2.num_values or 0
+        # INDEX_PAGE and unknown types: skip payload silently
+        pages.append(PageSlice(header, pos, end))
+        pos = end
+    return pages
+
+
+def _check_crc(header: PageHeader, payload: bytes, validate: bool) -> None:
+    if not validate or header.crc is None:
+        return
+    actual = zlib.crc32(payload) & 0xFFFFFFFF
+    if actual != header.crc & 0xFFFFFFFF:
+        raise ParquetError(
+            f"page CRC mismatch: header {header.crc & 0xFFFFFFFF:#x}, data {actual:#x}"
+        )
+
+
+def _byte_stream_split_decode(raw: bytes, ptype: Type, count: int, type_length: int):
+    """BYTE_STREAM_SPLIT: K per-byte streams concatenated; de-interleave."""
+    width = {
+        Type.FLOAT: 4, Type.DOUBLE: 8, Type.INT32: 4, Type.INT64: 8,
+    }.get(ptype, type_length)
+    if width <= 0:
+        raise ParquetError(f"BYTE_STREAM_SPLIT unsupported for {ptype!r}")
+    need = count * width
+    if len(raw) < need:
+        raise ParquetError("BYTE_STREAM_SPLIT: truncated data")
+    mat = np.frombuffer(raw, np.uint8, need).reshape(width, count).T.copy()
+    flat = mat.reshape(-1)
+    if ptype == Type.FLOAT:
+        return flat.view("<f4").copy()
+    if ptype == Type.DOUBLE:
+        return flat.view("<f8").copy()
+    if ptype == Type.INT32:
+        return flat.view("<i4").copy()
+    if ptype == Type.INT64:
+        return flat.view("<i8").copy()
+    offsets = np.arange(count + 1, dtype=np.int64) * width
+    return ByteArrayData(offsets=offsets, heap=flat)
+
+
+class ChunkDecoder:
+    """Decodes one column chunk into a ColumnData."""
+
+    def __init__(
+        self,
+        leaf: SchemaNode,
+        validate_crc: bool = False,
+        alloc: Optional[AllocTracker] = None,
+    ):
+        self.leaf = leaf
+        self.validate_crc = validate_crc
+        self.alloc = alloc or AllocTracker(0)
+        self.dictionary = None  # decoded dict values (np array or ByteArrayData)
+
+    # -- value decoding dispatch (getValuesDecoder, chunk_reader.go:106-159) --
+
+    def _decode_values(self, enc: int, raw: bytes, count: int):
+        ptype = self.leaf.physical_type
+        tl = self.leaf.type_length
+        try:
+            enc = Encoding(enc)
+        except (ValueError, TypeError):
+            raise ParquetError(f"unknown value encoding {enc!r}") from None
+        if enc == Encoding.PLAIN_DICTIONARY:
+            enc = Encoding.RLE_DICTIONARY
+        if enc == Encoding.PLAIN:
+            return plain.decode(raw, ptype, count, tl)
+        if enc == Encoding.RLE_DICTIONARY:
+            if self.dictionary is None:
+                raise ParquetError(
+                    "dictionary-encoded page but no dictionary page seen"
+                )
+            if len(raw) < 1:
+                raise ParquetError("dictionary page data truncated (missing width)")
+            width = raw[0]
+            if width > 32:
+                raise ParquetError(f"dictionary index width {width} invalid")
+            idx = rle.decode(raw[1:], width, count).astype(np.int64)
+            dict_len = len(self.dictionary)
+            if count and (idx.max(initial=0) >= dict_len):
+                raise ParquetError(
+                    f"dictionary index {int(idx.max())} out of range ({dict_len})"
+                )
+            if isinstance(self.dictionary, ByteArrayData):
+                return self.dictionary.take(idx)
+            return self.dictionary[idx]
+        if enc == Encoding.DELTA_BINARY_PACKED:
+            if ptype == Type.INT32:
+                vals, _ = delta.decode(raw, bits=32)
+            elif ptype == Type.INT64:
+                vals, _ = delta.decode(raw, bits=64)
+            else:
+                raise ParquetError(f"DELTA_BINARY_PACKED invalid for {ptype!r}")
+            if len(vals) < count:
+                raise ParquetError(
+                    f"delta stream yielded {len(vals)} of {count} values"
+                )
+            return vals[:count]
+        if enc == Encoding.DELTA_LENGTH_BYTE_ARRAY:
+            if ptype != Type.BYTE_ARRAY:
+                raise ParquetError(f"DELTA_LENGTH_BYTE_ARRAY invalid for {ptype!r}")
+            return ba_codec.decode_delta_length(raw, count)
+        if enc == Encoding.DELTA_BYTE_ARRAY:
+            if ptype != Type.BYTE_ARRAY:
+                raise ParquetError(f"DELTA_BYTE_ARRAY invalid for {ptype!r}")
+            return ba_codec.decode_delta(raw, count)
+        if enc == Encoding.RLE:
+            if ptype != Type.BOOLEAN:
+                raise ParquetError(f"RLE value encoding invalid for {ptype!r}")
+            vals, _ = rle.decode_prefixed(raw, 1, count)
+            return vals.astype(bool)
+        if enc == Encoding.BYTE_STREAM_SPLIT:
+            return _byte_stream_split_decode(raw, ptype, count, tl)
+        raise ParquetError(f"unsupported value encoding {enc.name} for {ptype!r}")
+
+    # -- pages ----------------------------------------------------------------
+
+    def _decode_dict_page(self, ps: PageSlice, buf: bytes, codec: int):
+        header = ps.header
+        payload = buf[ps.payload_start : ps.payload_end]
+        _check_crc(header, payload, self.validate_crc)
+        self.alloc.register(header.uncompressed_page_size)
+        raw = decompress_block(payload, codec, header.uncompressed_page_size)
+        dh = header.dictionary_page_header
+        enc = Encoding(dh.encoding)
+        if enc not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
+            raise ParquetError(f"dictionary page encoding {enc.name} unsupported")
+        count = dh.num_values or 0
+        if count < 0:
+            raise ParquetError(f"negative dictionary size {count}")
+        self.dictionary = plain.decode(
+            raw, self.leaf.physical_type, count, self.leaf.type_length
+        )
+
+    def _decode_data_page_v1(self, ps: PageSlice, buf: bytes, codec: int):
+        header = ps.header
+        dh = header.data_page_header
+        payload = buf[ps.payload_start : ps.payload_end]
+        _check_crc(header, payload, self.validate_crc)
+        self.alloc.register(header.uncompressed_page_size)
+        raw = decompress_block(payload, codec, header.uncompressed_page_size)
+        num_values = dh.num_values or 0
+        if num_values < 0:
+            raise ParquetError(f"negative page value count {num_values}")
+        pos = 0
+        max_rep, max_def = self.leaf.max_rep, self.leaf.max_def
+        rlv = dlv = None
+        if max_rep > 0:
+            rlv, used = rle.decode_prefixed(
+                raw[pos:], bitpack.bit_width(max_rep), num_values
+            )
+            pos += used
+        if max_def > 0:
+            dlv, used = rle.decode_prefixed(
+                raw[pos:], bitpack.bit_width(max_def), num_values
+            )
+            pos += used
+        defined = int(np.count_nonzero(dlv == max_def)) if dlv is not None else num_values
+        values = self._decode_values(dh.encoding, raw[pos:], defined)
+        return values, dlv, rlv, num_values
+
+    def _decode_data_page_v2(self, ps: PageSlice, buf: bytes, codec: int):
+        header = ps.header
+        dh = header.data_page_header_v2
+        payload = buf[ps.payload_start : ps.payload_end]
+        _check_crc(header, payload, self.validate_crc)
+        num_values = dh.num_values or 0
+        if num_values < 0:
+            raise ParquetError(f"negative page value count {num_values}")
+        rep_len = dh.repetition_levels_byte_length or 0
+        def_len = dh.definition_levels_byte_length or 0
+        if rep_len < 0 or def_len < 0 or rep_len + def_len > len(payload):
+            raise ParquetError("v2 level lengths exceed page")
+        max_rep, max_def = self.leaf.max_rep, self.leaf.max_def
+        rlv = dlv = None
+        if max_rep > 0:
+            if rep_len == 0:
+                raise ParquetError("v2 page missing repetition levels")
+            rlv = rle.decode(
+                payload[:rep_len], bitpack.bit_width(max_rep), num_values
+            )
+        if max_def > 0:
+            dlv = rle.decode(
+                payload[rep_len : rep_len + def_len],
+                bitpack.bit_width(max_def),
+                num_values,
+            )
+        values_block = payload[rep_len + def_len :]
+        uncompressed_values = (
+            header.uncompressed_page_size - rep_len - def_len
+        )
+        self.alloc.register(max(uncompressed_values, 0))
+        if dh.is_compressed is None or dh.is_compressed:
+            raw = decompress_block(values_block, codec, uncompressed_values)
+        else:
+            raw = values_block
+        if dh.num_nulls is not None and dlv is not None:
+            declared_nulls = dh.num_nulls
+            actual_nulls = int(np.count_nonzero(dlv != max_def))
+            if declared_nulls != actual_nulls and max_rep == 0:
+                raise ParquetError(
+                    f"v2 page declares {declared_nulls} nulls, levels say {actual_nulls}"
+                )
+        defined = int(np.count_nonzero(dlv == max_def)) if dlv is not None else num_values
+        values = self._decode_values(dh.encoding, raw, defined)
+        return values, dlv, rlv, num_values
+
+    # -- whole chunk -----------------------------------------------------------
+
+    def decode(self, buf: bytes, codec: int, total_values: int) -> ColumnData:
+        pages = walk_pages(buf, total_values)
+        values_parts = []
+        def_parts = []
+        rep_parts = []
+        slots = 0
+        for ps in pages:
+            pt = ps.header.type
+            if pt == PageType.DICTIONARY_PAGE:
+                self._decode_dict_page(ps, buf, codec)
+                continue
+            if pt == PageType.DATA_PAGE:
+                v, d, r, n = self._decode_data_page_v1(ps, buf, codec)
+            elif pt == PageType.DATA_PAGE_V2:
+                v, d, r, n = self._decode_data_page_v2(ps, buf, codec)
+            else:
+                continue  # index/unknown pages: ignore
+            values_parts.append(v)
+            slots += n
+            if d is not None:
+                def_parts.append(d)
+            if r is not None:
+                rep_parts.append(r)
+
+        max_rep, max_def = self.leaf.max_rep, self.leaf.max_def
+        values = _concat_values(values_parts)
+        def_levels = (
+            np.concatenate(def_parts).astype(np.int32) if def_parts else None
+        )
+        rep_levels = (
+            np.concatenate(rep_parts).astype(np.int32) if rep_parts else None
+        )
+        if def_levels is not None and len(def_levels) != slots:
+            raise ParquetError("definition level count mismatch")
+        if rep_levels is not None and len(rep_levels) != slots:
+            raise ParquetError("repetition level count mismatch")
+        return ColumnData(
+            values=values,
+            def_levels=def_levels,
+            rep_levels=rep_levels,
+            max_def=max_def,
+            max_rep=max_rep,
+            num_leaf_slots=slots,
+        )
+
+
+def _concat_values(parts: list):
+    if not parts:
+        return np.zeros(0, dtype=np.int64)
+    if len(parts) == 1:
+        return parts[0]
+    if isinstance(parts[0], ByteArrayData):
+        offsets_parts = [parts[0].offsets]
+        heap_parts = [parts[0].heap]
+        base = int(parts[0].offsets[-1])
+        for p in parts[1:]:
+            offsets_parts.append(p.offsets[1:] + base)
+            heap_parts.append(p.heap)
+            base += int(p.offsets[-1])
+        return ByteArrayData(
+            offsets=np.concatenate(offsets_parts),
+            heap=np.concatenate(heap_parts),
+        )
+    return np.concatenate(parts)
+
+
+def read_chunk(
+    f: BinaryIO,
+    chunk,
+    leaf: SchemaNode,
+    validate_crc: bool = False,
+    alloc: Optional[AllocTracker] = None,
+) -> ColumnData:
+    """Read + decode one column chunk from an open file.
+
+    Mirrors readChunk (chunk_reader.go:299-330): requires embedded ColumnMetaData
+    (PARQUET-291: file_offset is unreliable), seeks to the dictionary page when
+    present else the first data page, and consumes total_compressed_size bytes.
+    """
+    md = chunk.meta_data
+    if md is None:
+        raise ParquetError(
+            "column chunk missing embedded metadata (external metadata unsupported)"
+        )
+    if chunk.file_path:
+        raise ParquetError(
+            f"column chunk data in external file {chunk.file_path!r} unsupported"
+        )
+    if md.type is not None and leaf.physical_type is not None:
+        if md.type != int(leaf.physical_type):
+            raise ParquetError(
+                f"chunk type {md.type} does not match schema type {leaf.physical_type!r}"
+            )
+    if md.data_page_offset is None or md.data_page_offset < 0:
+        raise ParquetError(f"invalid data page offset {md.data_page_offset}")
+    offset = md.data_page_offset
+    if md.dictionary_page_offset is not None and md.dictionary_page_offset >= 0:
+        offset = min(offset, md.dictionary_page_offset)
+    size = md.total_compressed_size
+    if size is None or size < 0:
+        raise ParquetError(f"invalid chunk size {size}")
+    if md.num_values is None or md.num_values < 0:
+        raise ParquetError(f"invalid chunk value count {md.num_values}")
+    if alloc is not None:
+        alloc.register(size)
+    f.seek(offset)
+    buf = f.read(size)
+    if len(buf) != size:
+        raise ParquetError(
+            f"chunk truncated: wanted {size} bytes at {offset}, got {len(buf)}"
+        )
+    dec = ChunkDecoder(leaf, validate_crc=validate_crc, alloc=alloc)
+    return dec.decode(buf, md.codec, md.num_values)

@@ -1,0 +1,8 @@
+"""Decode/encode kernels for the hot parquet paths.
+
+Each kernel exists as a vectorized NumPy host implementation (the correctness
+reference, and the host fallback) and — for the decode hot path — a JAX/XLA device
+implementation in jax_kernels.py used by the TPU pipeline.  This replaces the
+reference's per-value virtual-dispatch decoders (hybrid_decoder.go, deltabp_decoder.go,
+type_*.go) with batch-oriented array transforms (SURVEY.md §7.1).
+"""

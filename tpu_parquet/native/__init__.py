@@ -1,0 +1,112 @@
+"""Native (C++) runtime pieces, loaded via ctypes.
+
+Built lazily with g++ the first time they're needed (no pip/cmake dependency at
+import time); the shared object is cached next to the sources and rebuilt when any
+source file changes (content-hash stamp).  Everything here is optional: each consumer
+has a pure-Python fallback, so the framework still works — slower — without a C++
+toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["snappy.cpp"]
+_LIB_BASENAME = "_libtpq_native.so"
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for src in _SOURCES:
+        with open(os.path.join(_DIR, src), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _build(lib_path: str) -> None:
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-march=native",
+        "-o", lib_path,
+    ] + [os.path.join(_DIR, s) for s in _SOURCES]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+
+
+def load():
+    """Return the ctypes native library, building it if needed; None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            stamp = _source_hash()
+            lib_path = os.path.join(_DIR, f"{_LIB_BASENAME}.{stamp}")
+            if not os.path.exists(lib_path):
+                _build(lib_path)
+                # drop stale builds
+                for f in os.listdir(_DIR):
+                    if f.startswith(_LIB_BASENAME) and not f.endswith(stamp):
+                        try:
+                            os.unlink(os.path.join(_DIR, f))
+                        except OSError:
+                            pass
+            lib = ctypes.CDLL(lib_path)
+            lib.tpq_snappy_uncompressed_length.restype = ctypes.c_longlong
+            lib.tpq_snappy_uncompressed_length.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.tpq_snappy_decompress.restype = ctypes.c_int
+            lib.tpq_snappy_decompress.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.tpq_snappy_max_compressed_length.restype = ctypes.c_size_t
+            lib.tpq_snappy_max_compressed_length.argtypes = [ctypes.c_size_t]
+            lib.tpq_snappy_compress.restype = ctypes.c_longlong
+            lib.tpq_snappy_compress.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ]
+            _lib = lib
+        except Exception:
+            _load_failed = True
+    return _lib
+
+
+def snappy_decompress(data: bytes) -> bytes:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = lib.tpq_snappy_uncompressed_length(data, len(data))
+    if n < 0:
+        raise ValueError("malformed snappy data: bad length header")
+    out = ctypes.create_string_buffer(n)
+    rc = lib.tpq_snappy_decompress(data, len(data), out, n)
+    if rc != 0:
+        raise ValueError(f"malformed snappy data (error {rc})")
+    return out.raw
+
+
+def snappy_compress(data: bytes) -> bytes:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    cap = lib.tpq_snappy_max_compressed_length(len(data))
+    out = ctypes.create_string_buffer(cap)
+    n = lib.tpq_snappy_compress(data, len(data), out)
+    if n < 0:
+        raise ValueError("snappy compression failed")
+    return out.raw[:n]
+
+
+def available() -> bool:
+    return load() is not None

@@ -91,10 +91,14 @@ def _spec_ctype(spec: Any) -> int:
 
 
 def _zigzag32(n: int) -> int:
+    if not -(1 << 31) <= n < (1 << 31):
+        raise ThriftError(f"value {n} out of range for 32-bit thrift field")
     return ((n << 1) ^ (n >> 31)) & 0xFFFFFFFF
 
 
 def _zigzag64(n: int) -> int:
+    if not -(1 << 63) <= n < (1 << 63):
+        raise ThriftError(f"value {n} out of range for 64-bit thrift field")
     return ((n << 1) ^ (n >> 63)) & 0xFFFFFFFFFFFFFFFF
 
 
@@ -345,6 +349,19 @@ def _read_value(
     if isinstance(spec, tuple) and spec[0] == "list":
         size, etype = r.read_list_header()
         elem_spec = spec[1]
+        # type-confusion guard: if the wire's element type doesn't match the
+        # spec, consume the list per the wire type and treat the field as absent
+        if elem_spec == "bool":
+            ok = etype in (CT_TRUE, CT_FALSE)
+        else:
+            ok = etype == _spec_ctype(elem_spec)
+        if not ok:
+            if etype in (CT_TRUE, CT_FALSE):
+                r._need(size)
+            else:
+                for _ in range(size):
+                    r.skip(etype, depth + 1)
+            return None
         return [_read_value(r, elem_spec, etype, depth + 1) for _ in range(size)]
     if isinstance(spec, type) and issubclass(spec, ThriftStruct):
         return _read_struct_body(r, spec, depth + 1)
