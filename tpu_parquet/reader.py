@@ -168,8 +168,9 @@ class FileReader:
     def seek_to_row_group(self, index: int) -> None:
         if not 0 <= index < self.num_row_groups:
             raise IndexError(f"row group {index} of {self.num_row_groups}")
+        if index != self._current_row_group:
+            self._preloaded = None
         self._current_row_group = index
-        self._preloaded = None
 
     def skip_row_group(self) -> None:
         if self._current_row_group >= self.num_row_groups:
@@ -190,6 +191,24 @@ class FileReader:
         if self._current_row_group >= self.num_row_groups:
             raise IndexError("cursor past the last row group")
         return self.metadata.row_groups[self._current_row_group]
+
+    # -- row-oriented API (NextRow parity) -------------------------------------
+
+    def iter_rows(self):
+        """Iterate raw nested dict rows (reference NextRow semantics)."""
+        from .assembly import RowIterator
+
+        return RowIterator(self)
+
+    def iter_rows_logical(self):
+        """Iterate rows with LIST/MAP wrappers unwrapped to python lists/dicts."""
+        from .logical import unwrap_row
+
+        for row in self.iter_rows():
+            yield unwrap_row(self.schema, row)
+
+    def __iter__(self):
+        return self.iter_rows()
 
     # -- python-value conversion ----------------------------------------------
 
@@ -239,13 +258,9 @@ def column_to_pylist(cd: ColumnData, leaf: Optional[SchemaNode] = None) -> list:
     """
     if cd.max_rep > 0:
         raise ParquetError("column_to_pylist only handles flat columns")
-    as_str = False
-    if leaf is not None:
-        ct = leaf.converted_type
-        lt = leaf.logical_type
-        as_str = ct in (ConvertedType.UTF8, ConvertedType.ENUM, ConvertedType.JSON) or (
-            lt is not None and lt.which() in ("STRING", "ENUM", "JSON")
-        )
+    from .logical import is_string_leaf
+
+    as_str = leaf is not None and is_string_leaf(leaf)
     if isinstance(cd.values, ByteArrayData):
         vals = cd.values.to_list()
         if as_str:
