@@ -292,6 +292,55 @@ def test_preload_cache_not_invalidated_by_iteration(tmp_path):
         assert len(rows) == 10
 
 
+def test_iterator_honors_seek(tmp_path):
+    table = pa.table({"v": pa.array(range(10), pa.int64())})
+    p = write(tmp_path, table, row_group_size=5)
+    with FileReader(p) as r:
+        r.seek_to_row_group(1)
+        rows = [row["v"] for row in r.iter_rows()]
+    assert rows == [5, 6, 7, 8, 9]
+
+
+def test_assemble_window():
+    schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
+    cols = {"v": ColumnData(values=np.arange(100, dtype=np.int64), max_def=0, max_rep=0)}
+    rows = assemble_rows(schema, cols, start=10, count=3)
+    assert rows == [{"v": 10}, {"v": 11}, {"v": 12}]
+
+
+def test_legacy_map_key_value_on_repeated_group():
+    # legacy layout: MAP_KEY_VALUE annotates the repeated group itself
+    from tpu_parquet.format import ConvertedType, SchemaElement
+
+    kv_elem = SchemaElement(
+        name="map", repetition_type=int(FRT.REPEATED),
+        converted_type=int(ConvertedType.MAP_KEY_VALUE),
+    )
+    schema = build_schema([
+        group_column("m", [
+            SchemaNode(kv_elem, [
+                data_column("key", Type.INT64, FRT.REQUIRED),
+                data_column("value", Type.INT64, FRT.REQUIRED),
+            ]),
+        ], FRT.OPTIONAL),
+    ])
+    cols = {
+        "m.map.key": ColumnData(
+            values=np.array([1, 2], dtype=np.int64),
+            def_levels=np.array([2, 2]), rep_levels=np.array([0, 1]),
+            max_def=2, max_rep=1,
+        ),
+        "m.map.value": ColumnData(
+            values=np.array([7, 8], dtype=np.int64),
+            def_levels=np.array([2, 2]), rep_levels=np.array([0, 1]),
+            max_def=2, max_rep=1,
+        ),
+    }
+    rows = assemble_rows(schema, cols)
+    out = unwrap_row(schema, rows[0])
+    assert out == {"m": {"map": {1: 7, 2: 8}}}
+
+
 def test_projection_with_nested(tmp_path):
     table = pa.table({
         "id": pa.array([1, 2], pa.int64()),

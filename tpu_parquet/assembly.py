@@ -29,12 +29,35 @@ from .logical import is_string_leaf
 from .schema.core import Schema, SchemaNode
 
 
-class _LeafState:
-    """Per-leaf decoded arrays + python-value materialization."""
+def materialize_leaf_values(leaf: SchemaNode, cd: ColumnData, lo: int = 0,
+                            hi: Optional[int] = None) -> list:
+    """Python values for the defined slots in value-index window [lo, hi).
 
-    __slots__ = ("cd", "defs", "reps", "vals", "val_idx", "record_starts")
+    The single canonical values→pylist conversion (UTF-8 decode for string
+    leaves), shared by row assembly and the reader's columnar pylist API.
+    """
+    if isinstance(cd.values, ByteArrayData):
+        ba = cd.values
+        n = len(ba)
+        hi = n if hi is None else hi
+        heap = ba.heap.tobytes()
+        off = ba.offsets
+        vals = [heap[off[i] : off[i + 1]] for i in range(lo, hi)]
+        if is_string_leaf(leaf):
+            vals = [v.decode("utf-8", errors="replace") for v in vals]
+        return vals
+    arr = cd.values[lo:] if hi is None else cd.values[lo:hi]
+    return arr.tolist()
+
+
+class _LeafState:
+    """Per-leaf decoded arrays + lazily-windowed python-value materialization."""
+
+    __slots__ = ("leaf", "cd", "defs", "reps", "vals", "val_idx", "record_starts",
+                 "_val_base")
 
     def __init__(self, leaf: SchemaNode, cd: ColumnData):
+        self.leaf = leaf
         self.cd = cd
         n = cd.num_leaf_slots
         self.defs = (
@@ -47,17 +70,22 @@ class _LeafState:
             if cd.rep_levels is not None
             else np.zeros(n, dtype=np.int32)
         )
-        if isinstance(cd.values, ByteArrayData):
-            vals = cd.values.to_list()
-            if is_string_leaf(leaf):
-                vals = [v.decode("utf-8", errors="replace") for v in vals]
-            self.vals = vals
-        else:
-            self.vals = cd.values.tolist()
-        # slot -> index into vals (valid only where defs == max_def)
+        self.vals: Optional[list] = None
+        self._val_base = 0
+        # slot -> index into the full defined-value sequence
         defined = self.defs == cd.max_def
         self.val_idx = np.cumsum(defined) - 1
         self.record_starts = np.flatnonzero(self.reps == 0)
+
+    def materialize(self, slot_lo: int, slot_hi: int) -> None:
+        """Convert only the defined values inside the slot window to python."""
+        vlo = int(self.val_idx[slot_lo - 1]) + 1 if slot_lo > 0 else 0
+        vhi = int(self.val_idx[slot_hi - 1]) + 1 if slot_hi > 0 else 0
+        self._val_base = vlo
+        self.vals = materialize_leaf_values(self.leaf, self.cd, vlo, vhi)
+
+    def value_at(self, slot: int):
+        return self.vals[int(self.val_idx[slot]) - self._val_base]
 
 
 def assemble_rows(
@@ -85,6 +113,12 @@ def assemble_rows(
     if start < 0 or start > nrecords:
         raise IndexError(f"record {start} of {nrecords}")
 
+    # materialize python values only for the requested record window
+    for st in states.values():
+        slot_lo = int(st.record_starts[start]) if start < nrecords else len(st.defs)
+        slot_hi = int(st.record_starts[end]) if end < nrecords else len(st.defs)
+        st.materialize(slot_lo, slot_hi)
+
     if all(l.max_rep == 0 and len(l.path) == 1 for l in leaves):
         return _assemble_flat(schema, leaves, states, start, end)
 
@@ -110,15 +144,13 @@ def _assemble_flat(schema, leaves, states, start, end):
         st = states[l.path]
         name = l.path[0]
         if st.cd.def_levels is None:
-            cols[name] = st.vals[start:end]
+            cols[name] = st.vals[start - st._val_base : end - st._val_base]
         else:
             defined = st.defs == st.cd.max_def
             out = [None] * (end - start)
-            vi = st.val_idx
-            vals = st.vals
             for i in range(start, end):
                 if defined[i]:
-                    out[i - start] = vals[vi[i]]
+                    out[i - start] = st.value_at(i)
             cols[name] = out
     names = [l.path[0] for l in leaves]
     return [
@@ -176,7 +208,7 @@ def _instance_value(node: SchemaNode, states, spans):
             (p, sp) for p, sp in spans.items() if p == node.path
         )
         st = states[path]
-        return st.vals[int(st.val_idx[s])]
+        return st.value_at(s)
     return _assemble_group(node, states, spans, is_root=False)
 
 
@@ -194,14 +226,16 @@ def _assemble_group(node: SchemaNode, states, spans, is_root: bool):
 
 class RowIterator:
     """Row-at-a-time cursor over a FileReader (NextRow parity,
-    file_reader.go:258-273): decodes row groups lazily via the reader's
-    preload cache and yields assembled dict rows."""
+    file_reader.go:258-273 + advanceIfNeeded): starts from the reader's
+    current row-group cursor (so seek_to_row_group/skip_row_group are honored,
+    like the reference), consumes the preload cache when it matches, and never
+    mutates the reader's cursor itself."""
 
     def __init__(self, reader):
         self.reader = reader
         self._rows: list[dict] = []
         self._pos = 0
-        self._group = 0
+        self._group = reader._current_row_group
 
     def __iter__(self):
         return self
@@ -210,8 +244,13 @@ class RowIterator:
         while self._pos >= len(self._rows):
             if self._group >= self.reader.num_row_groups:
                 raise StopIteration
-            self.reader.seek_to_row_group(self._group)
-            cols = self.reader.preload()
+            if (
+                self.reader._current_row_group == self._group
+                and self.reader._preloaded is not None
+            ):
+                cols = self.reader._preloaded
+            else:
+                cols = self.reader.read_row_group(self._group)
             self._rows = assemble_rows(self.reader.schema, cols)
             self._pos = 0
             self._group += 1

@@ -80,22 +80,35 @@ def unwrap_value(node: SchemaNode, value: Any) -> Any:
             for item in items
         ]
     if _is_map_node(node) and node.children:
+        if isinstance(value, list):
+            # legacy layout: MAP_KEY_VALUE annotates the repeated group itself;
+            # `value` is already the list of {key,value} items
+            return _pairs_to_map(node, value)
         kv = node.children[0]
         items = value.get(kv.name)
         if items is None:
             return {}
-        key_node = kv.child("key")
-        val_node = kv.child("value")
-        out = {}
-        for item in items:
-            k = unwrap_value(key_node, item.get("key")) if key_node else item.get("key")
-            v = unwrap_value(val_node, item.get("value")) if val_node else item.get("value")
-            out[k] = v
-        return out
+        return _pairs_to_map(kv, items)
     if isinstance(value, list):
         # plain repeated group/leaf (no LIST annotation)
         return [unwrap_group(node, v) if isinstance(v, dict) else v for v in value]
     return unwrap_group(node, value)
+
+
+def _pairs_to_map(kv_node: SchemaNode, items: list):
+    """{key,value} item dicts → python dict, or list of pairs when a key is
+    unhashable (e.g. group-typed keys that unwrap to dicts)."""
+    key_node = kv_node.child("key") if not kv_node.is_leaf else None
+    val_node = kv_node.child("value") if not kv_node.is_leaf else None
+    pairs = []
+    for item in items:
+        k = unwrap_value(key_node, item.get("key")) if key_node else item.get("key")
+        v = unwrap_value(val_node, item.get("value")) if val_node else item.get("value")
+        pairs.append((k, v))
+    try:
+        return dict(pairs)
+    except TypeError:
+        return pairs
 
 
 def unwrap_group(node: SchemaNode, value: dict) -> dict:

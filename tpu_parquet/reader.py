@@ -20,7 +20,7 @@ from .alloc import AllocTracker
 from .chunk_decode import read_chunk
 from .column import ByteArrayData, ColumnData
 from .footer import ParquetError, read_file_metadata
-from .format import ConvertedType, FileMetaData, Type
+from .format import FileMetaData, Type
 from .schema.core import Schema, SchemaNode
 
 
@@ -258,15 +258,11 @@ def column_to_pylist(cd: ColumnData, leaf: Optional[SchemaNode] = None) -> list:
     """
     if cd.max_rep > 0:
         raise ParquetError("column_to_pylist only handles flat columns")
-    from .logical import is_string_leaf
+    from .assembly import materialize_leaf_values
 
-    as_str = leaf is not None and is_string_leaf(leaf)
-    if isinstance(cd.values, ByteArrayData):
-        vals = cd.values.to_list()
-        if as_str:
-            vals = [v.decode("utf-8", errors="replace") for v in vals]
-    else:
-        vals = cd.values.tolist()
+    vals = materialize_leaf_values(leaf, cd) if leaf is not None else (
+        cd.values.to_list() if isinstance(cd.values, ByteArrayData) else cd.values.tolist()
+    )
     if cd.def_levels is None:
         return vals
     out = [None] * cd.num_leaf_slots

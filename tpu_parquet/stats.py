@@ -1,0 +1,107 @@
+"""Column statistics (min/max/null_count/distinct_count).
+
+Equivalent of the reference's stats.go:9-224: per-physical-type min/max trackers
+serialized as little-endian bytes (or raw bytes for BYTE_ARRAY).  Batch-oriented:
+stats are computed over whole value arrays with numpy reductions, not per value.
+Booleans get no min/max (nilStats parity); byte arrays use unsigned lexicographic
+order (the reference's byte-wise compare).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+import numpy as np
+
+from .column import ByteArrayData
+from .format import Statistics, Type
+
+
+def _le_bytes(v, fmt: str) -> bytes:
+    return struct.pack(fmt, v)
+
+
+def compute_statistics(
+    values, ptype: Type, null_count: int, distinct_count: Optional[int] = None
+) -> Statistics:
+    """Stats over the defined values of one page/chunk."""
+    st = Statistics(null_count=null_count)
+    if distinct_count is not None:
+        st.distinct_count = distinct_count
+    n = len(values)
+    if n == 0:
+        return st
+    if ptype == Type.BOOLEAN:
+        return st  # nilStats: no min/max for booleans (stats.go:9-24)
+    if ptype in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
+        items = values.to_list() if isinstance(values, ByteArrayData) else [bytes(v) for v in values]
+        mn = min(items)
+        mx = max(items)
+        st.min, st.max = mn, mx
+        st.min_value, st.max_value = mn, mx
+        return st
+    if ptype == Type.INT96:
+        return st  # no meaningful order; reference tracks none for int96 pages
+    arr = np.asarray(values)
+    if ptype == Type.INT32:
+        mn, mx = int(arr.min()), int(arr.max())
+        st.min = st.min_value = _le_bytes(mn, "<i")
+        st.max = st.max_value = _le_bytes(mx, "<i")
+    elif ptype == Type.INT64:
+        mn, mx = int(arr.min()), int(arr.max())
+        st.min = st.min_value = _le_bytes(mn, "<q")
+        st.max = st.max_value = _le_bytes(mx, "<q")
+    elif ptype == Type.FLOAT:
+        finite = arr[~np.isnan(arr)]
+        if len(finite) == 0:
+            return st
+        st.min = st.min_value = _le_bytes(float(finite.min()), "<f")
+        st.max = st.max_value = _le_bytes(float(finite.max()), "<f")
+    elif ptype == Type.DOUBLE:
+        finite = arr[~np.isnan(arr)]
+        if len(finite) == 0:
+            return st
+        st.min = st.min_value = _le_bytes(float(finite.min()), "<d")
+        st.max = st.max_value = _le_bytes(float(finite.max()), "<d")
+    return st
+
+
+def merge_statistics(a: Optional[Statistics], b: Statistics, ptype: Type) -> Statistics:
+    """Fold page stats into chunk stats."""
+    if a is None:
+        return Statistics(
+            min=b.min, max=b.max, min_value=b.min_value, max_value=b.max_value,
+            null_count=b.null_count, distinct_count=b.distinct_count,
+        )
+    out = Statistics()
+    if a.null_count is not None or b.null_count is not None:
+        out.null_count = (a.null_count or 0) + (b.null_count or 0)
+    # distinct counts don't merge additively; drop at chunk level unless equal
+    key = _compare_key(ptype)
+    for lo_attr, hi_attr in (("min", "max"), ("min_value", "max_value")):
+        alo, blo = getattr(a, lo_attr), getattr(b, lo_attr)
+        ahi, bhi = getattr(a, hi_attr), getattr(b, hi_attr)
+        setattr(out, lo_attr, _pick(alo, blo, key, lambda x, y: x <= y))
+        setattr(out, hi_attr, _pick(ahi, bhi, key, lambda x, y: x >= y))
+    return out
+
+
+def _compare_key(ptype: Type):
+    if ptype == Type.INT32:
+        return lambda b: struct.unpack("<i", b)[0]
+    if ptype == Type.INT64:
+        return lambda b: struct.unpack("<q", b)[0]
+    if ptype == Type.FLOAT:
+        return lambda b: struct.unpack("<f", b)[0]
+    if ptype == Type.DOUBLE:
+        return lambda b: struct.unpack("<d", b)[0]
+    return lambda b: b  # byte-wise
+
+
+def _pick(a, b, key, better):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a if better(key(a), key(b)) else b
