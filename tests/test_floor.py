@@ -1,0 +1,267 @@
+"""High-level object API (floor) tests: dataclass round-trips with logical types,
+marshaller hooks, Time type, INT96, and pyarrow cross-reads.
+
+Mirrors floor/writeread_test.go + floor/writer_test.go + floor/reader_test.go.
+"""
+
+import dataclasses
+import datetime
+import decimal
+import uuid
+from typing import Dict, List, Optional
+
+import pyarrow.parquet as pq
+import pytest
+
+from tpu_parquet.floor import Reader, Time, Writer
+from tpu_parquet.floor.marshal import MarshalError
+from tpu_parquet.schema.autoschema import schema_from_type
+from tpu_parquet.schema.dsl import parse_schema_definition
+
+UTC = datetime.timezone.utc
+
+
+@dataclasses.dataclass
+class Trip:
+    id: int
+    rider: str
+    fare: Optional[float]
+    pickup: datetime.datetime
+    day: datetime.date
+    stops: List[str]
+    meta: Dict[str, int]
+
+
+def sample_trips(n=100):
+    return [
+        Trip(
+            id=i,
+            rider=f"rider_{i % 10}",
+            fare=None if i % 9 == 0 else i * 1.5,
+            pickup=datetime.datetime(2023, 1, 1, tzinfo=UTC)
+            + datetime.timedelta(minutes=i),
+            day=datetime.date(2023, 1, 1) + datetime.timedelta(days=i % 30),
+            stops=[f"s{j}" for j in range(i % 4)],
+            meta={"n": i},
+        )
+        for i in range(n)
+    ]
+
+
+def test_dataclass_roundtrip(tmp_path):
+    p = tmp_path / "trips.parquet"
+    trips = sample_trips()
+    with Writer(p, obj_type=Trip) as w:
+        w.write_many(trips)
+    with Reader(p, obj_type=Trip) as r:
+        assert r.num_rows == 100
+        got = r.scan_all()
+    assert got == trips
+
+
+def test_pyarrow_reads_floor_output(tmp_path):
+    p = tmp_path / "trips.parquet"
+    with Writer(p, obj_type=Trip) as w:
+        w.write_many(sample_trips(10))
+    t = pq.read_table(p)
+    assert t.num_rows == 10
+    row = t.to_pylist()[3]
+    assert row["rider"] == "rider_3"
+    assert row["day"] == datetime.date(2023, 1, 4)
+    assert row["pickup"] == datetime.datetime(2023, 1, 1, 0, 3, tzinfo=UTC)
+
+
+def test_timestamp_units(tmp_path):
+    schema = parse_schema_definition("""message m {
+      optional int64 ms (TIMESTAMP(MILLIS,true));
+      optional int64 us (TIMESTAMP(MICROS,true));
+      optional int64 ns (TIMESTAMP(NANOS,true));
+    }""")
+    dt = datetime.datetime(2024, 6, 15, 12, 30, 45, 123456, tzinfo=UTC)
+    p = tmp_path / "ts.parquet"
+    with Writer(p, schema=schema) as w:
+        w.write({"ms": dt, "us": dt, "ns": dt})
+    with Reader(p) as r:
+        row = next(iter(r))
+    assert row["us"] == dt
+    assert row["ns"] == dt
+    assert row["ms"] == dt.replace(microsecond=123000)  # millis truncation
+
+
+def test_time_type(tmp_path):
+    schema = parse_schema_definition("""message m {
+      optional int32 tm (TIME(MILLIS,true));
+      optional int64 tu (TIME(MICROS,true));
+    }""")
+    t = Time.from_parts(14, 30, 15, 500_000_000)
+    p = tmp_path / "time.parquet"
+    with Writer(p, schema=schema) as w:
+        w.write({"tm": t, "tu": t})
+    with Reader(p) as r:
+        row = next(iter(r))
+    assert row["tm"] == t
+    assert row["tu"] == t
+    assert str(t) == "14:30:15.5Z"
+    assert t.to_datetime_time().hour == 14
+
+
+def test_time_validation():
+    with pytest.raises(ValueError):
+        Time(-1)
+    with pytest.raises(ValueError):
+        Time.from_parts(24, 0)
+    assert Time.from_milliseconds(1000).second == 1
+
+
+def test_uuid_and_decimal(tmp_path):
+    schema = parse_schema_definition("""message m {
+      required fixed_len_byte_array(16) uid (UUID);
+      optional int32 price (DECIMAL(9,2));
+      optional binary big (DECIMAL(20,4));
+    }""")
+    u = uuid.UUID("12345678-1234-5678-1234-567812345678")
+    p = tmp_path / "ud.parquet"
+    with Writer(p, schema=schema) as w:
+        w.write({"uid": u, "price": decimal.Decimal("123.45"),
+                 "big": decimal.Decimal("-99999.1234")})
+    with Reader(p) as r:
+        row = next(iter(r))
+    assert row["uid"] == u
+    assert row["price"] == decimal.Decimal("123.45")
+    assert row["big"] == decimal.Decimal("-99999.1234")
+    # pyarrow agrees on the decimal interpretation
+    t = pq.read_table(p)
+    assert t.column("price").to_pylist() == [decimal.Decimal("123.45")]
+
+
+def test_int96_timestamps(tmp_path):
+    schema = parse_schema_definition(
+        "message m { optional int96 ts; }"
+    )
+    dt = datetime.datetime(2021, 7, 4, 9, 30, 0, 250000, tzinfo=UTC)
+    p = tmp_path / "i96.parquet"
+    with Writer(p, schema=schema, use_dictionary=False) as w:
+        w.write({"ts": dt})
+    with Reader(p) as r:
+        row = next(iter(r))
+    assert row["ts"] == dt
+    # pyarrow reads INT96 as timestamp too
+    assert pq.read_table(p).column("ts").to_pylist()[0] == dt.replace(tzinfo=None)
+
+
+def test_pre_epoch_timestamps(tmp_path):
+    schema = parse_schema_definition(
+        "message m { optional int64 us (TIMESTAMP(MICROS,true)); }"
+    )
+    dt = datetime.datetime(1969, 12, 31, 23, 59, 59, 500000, tzinfo=UTC)
+    p = tmp_path / "pre.parquet"
+    with Writer(p, schema=schema) as w:
+        w.write({"us": dt})
+    with Reader(p) as r:
+        assert next(iter(r))["us"] == dt
+    assert pq.read_table(p).column("us").to_pylist()[0] == dt
+
+
+def test_optional_columnar_write_without_levels(tmp_path):
+    # all-defined shorthand: ColumnData with max_def>0, def_levels=None
+    import numpy as np
+
+    from tpu_parquet.column import ColumnData
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.format import FieldRepetitionType as FRT, Type
+    from tpu_parquet.writer import FileWriter
+
+    schema = build_schema([data_column("v", Type.INT64, FRT.OPTIONAL)])
+    cd = ColumnData(values=np.arange(5, dtype=np.int64), max_def=1, max_rep=0)
+    p = tmp_path / "nolev.parquet"
+    with FileWriter(p, schema) as w:
+        w.write_columns({"v": cd})
+    assert pq.read_table(p).column("v").to_pylist() == [0, 1, 2, 3, 4]
+
+
+def test_decimal_printer_roundtrip_converted_only():
+    # legacy converted-type-only DECIMAL must print parameterized and re-parse
+    from tpu_parquet.format import ConvertedType, SchemaElement, Type as T
+    from tpu_parquet.schema.core import Schema, SchemaNode
+    from tpu_parquet.schema.dsl import schema_to_string as s2s
+
+    elem = SchemaElement(name="d", type=int(T.INT32), repetition_type=1,
+                         converted_type=int(ConvertedType.DECIMAL),
+                         precision=9, scale=2)
+    s = Schema(SchemaNode(SchemaElement(name="m"), [SchemaNode(elem, None)]))
+    text = s2s(s)
+    assert "DECIMAL(9,2)" in text
+    s2 = parse_schema_definition(text)
+    assert s2.leaves[0].element.precision == 9
+
+
+def test_custom_marshaller_hooks(tmp_path):
+    class Point:
+        def __init__(self, x, y):
+            self.x, self.y = x, y
+
+        def to_parquet_row(self):
+            return {"x": self.x, "y": self.y}
+
+        @classmethod
+        def from_parquet_row(cls, row):
+            return cls(row["x"], row["y"])
+
+        def __eq__(self, other):
+            return (self.x, self.y) == (other.x, other.y)
+
+    schema = parse_schema_definition(
+        "message p { required int64 x; required int64 y; }"
+    )
+    p = tmp_path / "pt.parquet"
+    with Writer(p, schema=schema) as w:
+        w.write(Point(3, 4))
+    with Reader(p, obj_type=Point) as r:
+        got = r.scan_all()
+    assert got == [Point(3, 4)]
+
+
+def test_unmarshalable_raises(tmp_path):
+    schema = parse_schema_definition("message m { required int64 x; }")
+    p = tmp_path / "bad.parquet"
+    with Writer(p, schema=schema) as w:
+        with pytest.raises(MarshalError):
+            w.write(42)
+
+
+def test_nested_dataclasses(tmp_path):
+    @dataclasses.dataclass
+    class Addr:
+        city: str
+        zip: Optional[str]
+
+    @dataclasses.dataclass
+    class Person:
+        name: str
+        addr: Optional[Addr]
+        previous: List[Addr]
+
+    people = [
+        Person("ann", Addr("berlin", "10115"), [Addr("munich", None)]),
+        Person("bob", None, []),
+    ]
+    p = tmp_path / "people.parquet"
+    with Writer(p, obj_type=Person) as w:
+        w.write_many(people)
+    with Reader(p, obj_type=Person) as r:
+        got = r.scan_all()
+    assert got == people
+
+
+def test_datetime_time_field(tmp_path):
+    @dataclasses.dataclass
+    class Sched:
+        at: datetime.time
+
+    s = Sched(at=datetime.time(8, 45, 30, tzinfo=UTC))
+    p = tmp_path / "sched.parquet"
+    with Writer(p, obj_type=Sched) as w:
+        w.write(s)
+    with Reader(p, obj_type=Sched) as r:
+        got = r.scan_all()[0]
+    assert got.at.replace(tzinfo=UTC) == s.at

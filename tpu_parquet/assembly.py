@@ -40,9 +40,12 @@ def materialize_leaf_values(leaf: SchemaNode, cd: ColumnData, lo: int = 0,
         ba = cd.values
         n = len(ba)
         hi = n if hi is None else hi
-        heap = ba.heap.tobytes()
+        if lo >= hi:
+            return []
+        base = int(ba.offsets[lo])
+        heap = ba.heap[base : int(ba.offsets[hi])].tobytes()  # window only
         off = ba.offsets
-        vals = [heap[off[i] : off[i + 1]] for i in range(lo, hi)]
+        vals = [heap[off[i] - base : off[i + 1] - base] for i in range(lo, hi)]
         if is_string_leaf(leaf):
             vals = [v.decode("utf-8", errors="replace") for v in vals]
         return vals

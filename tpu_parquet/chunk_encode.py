@@ -191,6 +191,23 @@ class ChunkEncoder:
         """Serialize the chunk into sink (a writable), starting at file offset."""
         leaf = self.leaf
         ptype = leaf.physical_type
+        # normalize the all-defined shorthand (def_levels=None with max_def>0)
+        # that the rest of the codebase accepts
+        if cd.max_def > 0 and cd.def_levels is None:
+            cd = ColumnData(
+                values=cd.values,
+                def_levels=np.full(cd.num_leaf_slots, cd.max_def, dtype=np.int32),
+                rep_levels=cd.rep_levels,
+                max_def=cd.max_def, max_rep=cd.max_rep,
+                num_leaf_slots=cd.num_leaf_slots,
+            )
+        if cd.max_rep > 0 and cd.rep_levels is None:
+            cd = ColumnData(
+                values=cd.values, def_levels=cd.def_levels,
+                rep_levels=np.zeros(cd.num_leaf_slots, dtype=np.int32),
+                max_def=cd.max_def, max_rep=cd.max_rep,
+                num_leaf_slots=cd.num_leaf_slots,
+            )
         out = bytearray()
 
         dict_pair = None

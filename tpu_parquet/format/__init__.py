@@ -167,6 +167,13 @@ class TimeUnit(ThriftStruct):
         3: ("NANOS", NanoSeconds),
     }
 
+    def which(self):
+        """Name of the set union member, or None."""
+        for _, (name, _spec) in self.FIELDS.items():
+            if getattr(self, name) is not None:
+                return name
+        return None
+
     @classmethod
     def millis(cls):
         return cls(MILLIS=MilliSeconds())

@@ -49,7 +49,6 @@ class LeafBuilder:
 
     def to_column_data(self) -> ColumnData:
         leaf = self.leaf
-        ptype = leaf.physical_type
         vals = _coerce_values(self.values, leaf)
         defs = (
             np.asarray(self.defs, dtype=np.int32) if leaf.max_def > 0 else None
@@ -264,7 +263,8 @@ class Shredder:
 
     # -- output ---------------------------------------------------------------
 
-    def harvest(self) -> dict[str, ColumnData]:
+    def harvest(self) -> tuple[dict[str, ColumnData], int]:
+        """Returns (columns, row_count) and resets the builders."""
         out = {
             ".".join(path): b.to_column_data()
             for path, b in self.builders.items()
@@ -273,4 +273,4 @@ class Shredder:
             b.reset()
         n = self.num_rows
         self.num_rows = 0
-        return out
+        return out, n

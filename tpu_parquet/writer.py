@@ -225,7 +225,7 @@ class FileWriter:
         self._check_open()
         cols = self._pending_cols or {}
         if self._shredder.num_rows:
-            shredded = self._shredder.harvest()
+            shredded, _n = self._shredder.harvest()
             cols = shredded if not cols else cols
         num_rows = self._pending_rows
         if num_rows == 0 and not cols:
