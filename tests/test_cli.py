@@ -1,0 +1,192 @@
+"""CLI tool tests (cmd/parquet-tool + cmd/csv2parquet parity).
+
+Driven through subprocess (the real CLI surface) for the happy paths and through
+main(argv) for the matrix.
+"""
+
+import io
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpu_parquet.cli import csv2parquet, pq_tool
+from tpu_parquet.cli.pq_tool import parse_human_size
+
+
+@pytest.fixture()
+def sample(tmp_path):
+    p = tmp_path / "s.parquet"
+    pq.write_table(
+        pa.table({
+            "id": pa.array(range(100), pa.int64()),
+            "name": pa.array([f"n{i}" for i in range(100)]),
+            "lst": pa.array([[i, i + 1] for i in range(100)], pa.list_(pa.int64())),
+        }),
+        p, row_group_size=40,
+    )
+    return p
+
+
+def run_tool(args):
+    out = io.StringIO()
+    parsed = pq_tool.build_parser().parse_args(args)
+    rc = parsed.func(parsed, out=out)
+    return rc, out.getvalue()
+
+
+def test_rowcount(sample):
+    rc, out = run_tool(["rowcount", str(sample)])
+    assert rc == 0 and out.strip() == "100"
+
+
+def test_cat_and_head(sample):
+    rc, out = run_tool(["head", "-n", "3", str(sample)])
+    assert rc == 0
+    lines = [json.loads(l) for l in out.splitlines()]
+    assert lines[0] == {"id": 0, "name": "n0", "lst": [0, 1]}
+    assert len(lines) == 3
+    rc, out = run_tool(["cat", str(sample)])
+    assert len(out.splitlines()) == 100
+
+
+def test_meta(sample):
+    rc, out = run_tool(["meta", str(sample)])
+    assert rc == 0
+    assert "rows: 100" in out
+    assert "row groups: 3" in out
+    assert "R=1 D=3" in out  # lst.list.element levels
+    assert "codec=" in out
+
+
+def test_schema(sample):
+    rc, out = run_tool(["schema", str(sample)])
+    assert rc == 0
+    assert out.startswith("message")
+    assert "optional int64 id" in out  # pyarrow writes columns optional
+    # output must be parseable by our own DSL
+    from tpu_parquet.schema.dsl import parse_schema_definition
+
+    assert parse_schema_definition(out).num_columns == 3
+
+
+def test_split(sample, tmp_path):
+    pattern = str(tmp_path / "part_{}.parquet")
+    rc, out = run_tool(
+        ["split", "--size", "2KiB", "--output-pattern", pattern, str(sample)]
+    )
+    assert rc == 0
+    parts = sorted(tmp_path.glob("part_*.parquet"))
+    assert len(parts) >= 2
+    total = 0
+    for part in parts:
+        t = pq.read_table(part)
+        total += t.num_rows
+    assert total == 100
+
+
+def test_parse_human_size():
+    assert parse_human_size("4096") == 4096
+    assert parse_human_size("100MB") == 100_000_000
+    assert parse_human_size("1GiB") == 1 << 30
+    assert parse_human_size("1.5KiB") == 1536
+    with pytest.raises(ValueError):
+        parse_human_size("ten bytes")
+
+
+def test_cli_subprocess(sample):
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_parquet.cli.pq_tool", "rowcount", str(sample)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 0 and r.stdout.strip() == "100"
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_parquet.cli.pq_tool", "meta", "/nonexistent"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 1
+    assert "pq-tool:" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# csv2parquet
+# ---------------------------------------------------------------------------
+
+def test_csv2parquet_basic(tmp_path):
+    csv_path = tmp_path / "in.csv"
+    csv_path.write_text(
+        "id,name,price,ok,when\n"
+        "1,apple,1.5,true,2024-01-01T10:00:00Z\n"
+        "2,banana,0.75,false,2024-06-15T20:30:00Z\n"
+    )
+    out_path = tmp_path / "out.parquet"
+    n = csv2parquet.convert(
+        str(csv_path), str(out_path),
+        csv2parquet.parse_type_hints("id=int64,price=double,ok=boolean,when=timestamp"),
+    )
+    assert n == 2
+    t = pq.read_table(out_path)
+    assert t.column("id").to_pylist() == [1, 2]
+    assert t.column("name").to_pylist() == ["apple", "banana"]
+    assert t.column("ok").to_pylist() == [True, False]
+    assert t.column("price").to_pylist() == [1.5, 0.75]
+
+
+def test_csv2parquet_optional_nulls(tmp_path):
+    csv_path = tmp_path / "in.csv"
+    csv_path.write_text("a,b\n1,\n,x\n")
+    out_path = tmp_path / "out.parquet"
+    csv2parquet.convert(
+        str(csv_path), str(out_path),
+        csv2parquet.parse_type_hints("a=int64"), wrap="optional",
+    )
+    t = pq.read_table(out_path)
+    assert t.column("a").to_pylist() == [1, None]
+    assert t.column("b").to_pylist() == [None, "x"]
+
+
+def test_csv2parquet_errors(tmp_path):
+    with pytest.raises(ValueError, match="invalid type hint"):
+        csv2parquet.parse_type_hints("justaname")
+    with pytest.raises(ValueError, match="unknown type"):
+        csv2parquet.parse_type_hints("a=quux")
+    csv_path = tmp_path / "bad.csv"
+    csv_path.write_text("a,b\n1\n")
+    with pytest.raises(ValueError, match="line 2"):
+        csv2parquet.convert(str(csv_path), str(tmp_path / "o.parquet"), {})
+    csv_path2 = tmp_path / "bad2.csv"
+    csv_path2.write_text("a\nnot_an_int\n")
+    with pytest.raises(ValueError, match="column 'a'"):
+        csv2parquet.convert(
+            str(csv_path2), str(tmp_path / "o2.parquet"),
+            {"a": "int64"},
+        )
+
+
+def test_csv2parquet_hint_for_unknown_column(tmp_path):
+    csv_path = tmp_path / "in.csv"
+    csv_path.write_text("a\n1\n")
+    with pytest.raises(ValueError, match="unknown column"):
+        csv2parquet.convert(
+            str(csv_path), str(tmp_path / "o.parquet"), {"zzz": "int64"}
+        )
+
+
+def test_csv2parquet_cli(tmp_path):
+    csv_path = tmp_path / "in.csv"
+    csv_path.write_text("x,y\n1,hello\n2,world\n")
+    out_path = tmp_path / "out.parquet"
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_parquet.cli.csv2parquet",
+         "-i", str(csv_path), "-o", str(out_path), "--type-hints", "x=int32"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "wrote 2 rows" in r.stdout
+    assert pq.read_table(out_path).num_rows == 2

@@ -1,0 +1,224 @@
+"""pq-tool: inspect and manipulate parquet files.
+
+Command parity with the reference's parquet-tool (cmd/parquet-tool/cmds/):
+
+    cat       print all records              (cat.go:14-27)
+    head      print the first N records      (head.go:17-30)
+    meta      flat schema + per-column R/D levels + row group info (meta.go)
+    schema    print the textual schema definition  (schema.go:16-37)
+    rowcount  number of rows from the footer       (rowcount.go:16-37)
+    split     re-shard into parts of at most a given size (split.go:31-117)
+
+Usage: python -m tpu_parquet.cli.pq_tool <command> [options] <file>
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import datetime
+import decimal
+import json
+import sys
+import uuid
+
+from ..floor.time import Time
+from ..footer import ParquetError
+from ..format import CompressionCodec, Type
+from ..logical import unwrap_row
+from ..reader import FileReader
+from ..schema.dsl import schema_to_string
+from ..writer import FileWriter
+
+
+def _json_default(v):
+    if isinstance(v, (bytes, bytearray)):
+        try:
+            return bytes(v).decode("utf-8")
+        except UnicodeDecodeError:
+            return base64.b64encode(bytes(v)).decode("ascii")
+    if isinstance(v, (datetime.datetime, datetime.date, Time)):
+        return str(v)
+    if isinstance(v, (decimal.Decimal, uuid.UUID)):
+        return str(v)
+    return repr(v)
+
+
+def cmd_cat(args, out=sys.stdout) -> int:
+    """Shared handler for cat and head (identical modulo the -n default)."""
+    from ..floor import Reader
+
+    count = 0
+    with Reader(args.file) as r:
+        for row in r:
+            if args.n is not None and count >= args.n:
+                break
+            out.write(json.dumps(row, default=_json_default) + "\n")
+            count += 1
+    return 0
+
+
+def cmd_meta(args, out=sys.stdout) -> int:
+    with FileReader(args.file) as r:
+        meta = r.metadata
+        out.write(f"file: {args.file}\n")
+        out.write(f"created by: {meta.created_by}\n")
+        out.write(f"rows: {meta.num_rows}\n")
+        out.write(f"row groups: {len(meta.row_groups)}\n")
+        kv = r.key_value_metadata()
+        if kv:
+            out.write("key-value metadata:\n")
+            for k, v in sorted(kv.items()):
+                if k == "ARROW:schema":
+                    v = "(arrow schema blob)"
+                out.write(f"  {k} = {v}\n")
+        out.write("columns:\n")
+        name_w = max((len(l.flat_name()) for l in r.schema.leaves), default=4)
+        for leaf in r.schema.leaves:
+            t = leaf.physical_type
+            tname = t.name if t is not None else "group"  # BOOLEAN is enum 0
+            out.write(
+                f"  {leaf.flat_name():<{name_w}}  type={tname:<22} "
+                f"R={leaf.max_rep} D={leaf.max_def}\n"
+            )
+        for i, rg in enumerate(meta.row_groups):
+            out.write(
+                f"row group {i}: rows={rg.num_rows} "
+                f"bytes={rg.total_byte_size}\n"
+            )
+            for chunk in rg.columns or []:
+                md = chunk.meta_data
+                if md is None:
+                    continue
+                codec = CompressionCodec(md.codec).name
+                out.write(
+                    f"  {'.'.join(md.path_in_schema):<{name_w}}  "
+                    f"values={md.num_values} codec={codec} "
+                    f"compressed={md.total_compressed_size} "
+                    f"uncompressed={md.total_uncompressed_size}\n"
+                )
+    return 0
+
+
+def cmd_schema(args, out=sys.stdout) -> int:
+    with FileReader(args.file) as r:
+        out.write(schema_to_string(r.schema))
+    return 0
+
+
+def cmd_rowcount(args, out=sys.stdout) -> int:
+    with FileReader(args.file) as r:
+        out.write(f"{r.num_rows}\n")
+    return 0
+
+
+def parse_human_size(s: str) -> int:
+    """'100MB', '1GiB', '4096' → bytes (helpers.go:10-40 parity)."""
+    s = s.strip()
+    units = {
+        "": 1, "B": 1,
+        "KB": 1000, "MB": 1000**2, "GB": 1000**3, "TB": 1000**4,
+        "KIB": 1024, "MIB": 1024**2, "GIB": 1024**3, "TIB": 1024**4,
+        "K": 1024, "M": 1024**2, "G": 1024**3,
+    }
+    num = s
+    unit = ""
+    for i, ch in enumerate(s):
+        if not (ch.isdigit() or ch == "."):
+            num, unit = s[:i], s[i:]
+            break
+    try:
+        value = float(num)
+        mult = units[unit.strip().upper()]
+    except (ValueError, KeyError):
+        raise ValueError(f"cannot parse size {s!r}") from None
+    return int(value * mult)
+
+
+def cmd_split(args, out=sys.stdout) -> int:
+    max_size = parse_human_size(args.size)
+    src = args.file
+    with FileReader(src) as r:
+        schema = r.schema
+        part = 0
+        writer = None
+        written = 0
+
+        def open_part():
+            nonlocal writer, part, written
+            path = args.output_pattern.format(part)
+            writer = FileWriter(path, schema, codec=args_codec)
+            out.write(f"writing {path}\n")
+            part += 1
+            written = 0
+            return writer
+
+        args_codec = getattr(CompressionCodec, args.codec.upper())
+        writer = None
+        for raw in r.iter_rows():
+            if writer is not None and (
+                writer.current_file_size + writer.current_row_group_size >= max_size
+            ):
+                writer.close()
+                writer = None
+            if writer is None:
+                writer = open_part()  # opened lazily: no empty trailing parts
+            writer.write_row(raw)
+        if writer is None:
+            writer = open_part()  # empty input still produces one valid file
+        writer.close()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pq-tool", description="Inspect and manipulate parquet files"
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    c = sub.add_parser("cat", help="print all records as JSON lines")
+    c.add_argument("-n", type=int, default=None, help="limit record count")
+    c.add_argument("file")
+    c.set_defaults(func=cmd_cat)
+
+    h = sub.add_parser("head", help="print the first N records")
+    h.add_argument("-n", type=int, default=5)
+    h.add_argument("file")
+    h.set_defaults(func=cmd_cat)
+
+    m = sub.add_parser("meta", help="print file metadata")
+    m.add_argument("file")
+    m.set_defaults(func=cmd_meta)
+
+    s = sub.add_parser("schema", help="print the schema definition")
+    s.add_argument("file")
+    s.set_defaults(func=cmd_schema)
+
+    rc = sub.add_parser("rowcount", help="print the number of rows")
+    rc.add_argument("file")
+    rc.set_defaults(func=cmd_rowcount)
+
+    sp = sub.add_parser("split", help="split into files of at most SIZE bytes")
+    sp.add_argument("--size", required=True, help="max part size, e.g. 100MB")
+    sp.add_argument(
+        "--output-pattern", default="part_{}.parquet",
+        help="output filename pattern with {} for the part number",
+    )
+    sp.add_argument("--codec", default="snappy",
+                    choices=["uncompressed", "snappy", "gzip", "zstd"])
+    sp.add_argument("file")
+    sp.set_defaults(func=cmd_split)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.func(args)
+    except (ParquetError, ValueError, OSError) as e:
+        print(f"pq-tool: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
