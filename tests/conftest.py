@@ -1,14 +1,19 @@
 """Test harness config.
 
 Force JAX onto a virtual 8-device CPU mesh so multi-chip sharding paths
-(tpu_parquet/parallel) are exercised without TPU hardware, per the driver contract.
-Must run before jax is imported anywhere.
+(tpu_parquet/parallel) are exercised without TPU hardware, per the driver
+contract.  The axon site hook imports jax before this file runs, so the env
+vars alone are not sufficient — the jax.config.update below is load-bearing.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-set (not setdefault): the environment pins JAX_PLATFORMS=axon (real TPU
+# tunnel), but tests must run on the virtual 8-device CPU mesh for determinism
+# and multi-chip sharding coverage.  The axon site hook may import jax before
+# this file runs, so set the config too, not just the env var.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +21,7 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,303 @@
+"""Device chunk-decoder tests: DeviceChunkDecoder vs host ChunkDecoder.
+
+Files are written by our own FileWriter (itself pyarrow-validated in
+test_writer.py); every column chunk is decoded by both paths and compared
+bit-for-bit — values, offsets/heap, and def/rep level arrays.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from tpu_parquet.column import ByteArrayData, ColumnData
+from tpu_parquet.format import (
+    CompressionCodec,
+    ConvertedType,
+    Encoding,
+    FieldRepetitionType as FRT,
+    LogicalType,
+    StringType,
+    Type,
+)
+from tpu_parquet.jax_decode import DeviceChunkDecoder, read_chunk_device
+from tpu_parquet.chunk_decode import read_chunk
+from tpu_parquet.reader import FileReader
+from tpu_parquet.schema.core import (
+    ColumnParameters,
+    build_schema,
+    data_column,
+    list_column,
+)
+from tpu_parquet.writer import FileWriter
+
+RNG = np.random.default_rng(7)
+
+
+def _roundtrip_compare(schema, rows, *, chunks_match=None, **writer_kw):
+    buf = io.BytesIO()
+    with FileWriter(buf, schema, **writer_kw) as w:
+        w.write_rows(rows)
+    buf.seek(0)
+    r = FileReader(buf)
+    leaves = {l.path: l for l in r.schema.leaves}
+    for rg in r.metadata.row_groups:
+        for chunk in rg.columns:
+            path = tuple(chunk.meta_data.path_in_schema)
+            leaf = leaves[path]
+            host = read_chunk(r._f, chunk, leaf)
+            dev = read_chunk_device(r._f, chunk, leaf)
+            _assert_same(host, dev, path)
+
+
+def _assert_same(host: ColumnData, dev, path):
+    if isinstance(host.values, ByteArrayData):
+        got = dev.to_host()
+        assert isinstance(got, ByteArrayData), path
+        np.testing.assert_array_equal(got.offsets, host.values.offsets, err_msg=str(path))
+        np.testing.assert_array_equal(got.heap, host.values.heap, err_msg=str(path))
+    else:
+        got = dev.to_host()
+        if host.values.dtype == np.bool_:
+            got = got.astype(np.bool_)
+        np.testing.assert_array_equal(got, host.values, err_msg=str(path))
+    if host.def_levels is None:
+        assert dev.def_levels is None, path
+    else:
+        np.testing.assert_array_equal(
+            np.asarray(dev.def_levels), host.def_levels, err_msg=str(path)
+        )
+    if host.rep_levels is None:
+        assert dev.rep_levels is None, path
+    else:
+        np.testing.assert_array_equal(
+            np.asarray(dev.rep_levels), host.rep_levels, err_msg=str(path)
+        )
+
+
+def _string_col(name, repetition=FRT.OPTIONAL):
+    return data_column(
+        name, Type.BYTE_ARRAY, repetition,
+        ColumnParameters(
+            logical_type=LogicalType(STRING=StringType()),
+            converted_type=ConvertedType.UTF8,
+        ),
+    )
+
+
+def _mixed_schema():
+    return build_schema([
+        data_column("id", Type.INT64, FRT.REQUIRED),
+        data_column("x", Type.INT32, FRT.OPTIONAL),
+        data_column("score", Type.DOUBLE, FRT.OPTIONAL),
+        data_column("ratio", Type.FLOAT, FRT.REQUIRED),
+        data_column("active", Type.BOOLEAN, FRT.REQUIRED),
+        _string_col("name"),
+    ])
+
+
+def _mixed_rows(n=5000):
+    rows = []
+    for i in range(n):
+        rows.append({
+            "id": i * 3 - 1000,
+            "x": None if i % 7 == 0 else i % 1000,
+            "score": None if i % 11 == 0 else i * 0.25,
+            "ratio": float(i % 13) * 0.5,
+            "active": i % 2 == 0,
+            "name": f"name-{i % 300}".encode(),  # 300 distinct → dictionary
+        })
+    return rows
+
+
+@pytest.mark.parametrize("codec", [
+    CompressionCodec.UNCOMPRESSED,
+    CompressionCodec.SNAPPY,
+    CompressionCodec.GZIP,
+    CompressionCodec.ZSTD,
+])
+def test_device_decode_codecs(codec):
+    _roundtrip_compare(_mixed_schema(), _mixed_rows(1500), codec=codec)
+
+
+@pytest.mark.parametrize("version", [1, 2])
+def test_device_decode_page_versions(version):
+    _roundtrip_compare(
+        _mixed_schema(), _mixed_rows(2000), data_page_version=version
+    )
+
+
+def test_device_decode_no_dictionary_plain():
+    # unique values defeat the dictionary → PLAIN pages
+    schema = build_schema([
+        data_column("a", Type.INT64, FRT.REQUIRED),
+        data_column("b", Type.DOUBLE, FRT.REQUIRED),
+    ])
+    rows = [{"a": i, "b": float(i) * 1.5} for i in range(3000)]
+    _roundtrip_compare(schema, rows, use_dictionary=False)
+
+
+def test_device_decode_delta_bp():
+    schema = build_schema([
+        data_column("i32", Type.INT32, FRT.REQUIRED),
+        data_column("i64", Type.INT64, FRT.REQUIRED),
+    ])
+    rows = [
+        {"i32": int(v32), "i64": int(v64)}
+        for v32, v64 in zip(
+            RNG.integers(-(1 << 30), 1 << 30, 4000),
+            RNG.integers(-(1 << 62), 1 << 62, 4000),
+        )
+    ]
+    _roundtrip_compare(
+        schema, rows,
+        use_dictionary=False,
+        column_encodings={"i32": Encoding.DELTA_BINARY_PACKED,
+                          "i64": Encoding.DELTA_BINARY_PACKED},
+    )
+
+
+def test_device_decode_delta_byte_arrays():
+    schema = build_schema([
+        _string_col("dl", FRT.REQUIRED),
+        _string_col("db", FRT.REQUIRED),
+    ])
+    rows = [
+        {"dl": f"value-{i}".encode(), "db": f"prefix-common-{i:06d}".encode()}
+        for i in range(2000)
+    ]
+    _roundtrip_compare(
+        schema, rows,
+        use_dictionary=False,
+        column_encodings={"dl": Encoding.DELTA_LENGTH_BYTE_ARRAY,
+                          "db": Encoding.DELTA_BYTE_ARRAY},
+    )
+
+
+def test_device_decode_nested_lists():
+    schema = build_schema([
+        list_column("tags", data_column("element", Type.INT64, FRT.OPTIONAL)),
+        _string_col("label"),
+    ])
+    rows = []
+    for i in range(1500):
+        if i % 13 == 0:
+            tags = None
+        elif i % 7 == 0:
+            tags = []
+        else:
+            tags = [int(j) if j % 3 else None for j in range(i % 6)]
+        rows.append({
+            "tags": tags,
+            "label": None if i % 5 == 0 else f"L{i % 40}".encode(),
+        })
+    _roundtrip_compare(schema, rows)
+
+
+def test_device_decode_multi_page():
+    # small page size → many pages per chunk, exercises concat paths
+    _roundtrip_compare(
+        _mixed_schema(), _mixed_rows(4000), page_size=4096,
+    )
+
+
+def test_device_decode_string_dictionary_heavy():
+    schema = build_schema([_string_col("s", FRT.REQUIRED)])
+    rows = [{"s": f"city-{i % 50}".encode()} for i in range(6000)]
+    _roundtrip_compare(schema, rows)
+
+
+def test_device_decode_boolean_rle():
+    schema = build_schema([data_column("f", Type.BOOLEAN, FRT.REQUIRED)])
+    rows = [{"f": (i // 100) % 2 == 0} for i in range(3000)]
+    _roundtrip_compare(
+        schema, rows, column_encodings={"f": Encoding.RLE},
+    )
+
+
+# ---------------------------------------------------------------------------
+# Malformed input: the device path must match the host path's rejections
+# ---------------------------------------------------------------------------
+
+def test_device_rejects_truncated_plain_boolean():
+    from tpu_parquet.footer import ParquetError
+    from tpu_parquet.schema.core import build_schema as _bs
+    schema = _bs([data_column("f", Type.BOOLEAN, FRT.REQUIRED)])
+    leaf = schema.leaves[0]
+    dec = DeviceChunkDecoder(leaf)
+    with pytest.raises(ParquetError, match="truncated"):
+        dec._decode_values_device(int(Encoding.PLAIN), b"\x01", 0, 100)
+
+
+def test_device_rejects_bad_boolean_rle_length():
+    from tpu_parquet.footer import ParquetError
+    schema = build_schema([data_column("f", Type.BOOLEAN, FRT.REQUIRED)])
+    leaf = schema.leaves[0]
+    dec = DeviceChunkDecoder(leaf)
+    # declared RLE stream length exceeds the page
+    bad = (1000).to_bytes(4, "little") + b"\x02\x01"
+    with pytest.raises(ParquetError, match="exceeds page"):
+        dec._decode_values_device(int(Encoding.RLE), bad, 0, 8)
+
+
+def test_device_rejects_truncated_plain_int64():
+    from tpu_parquet.footer import ParquetError
+    schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
+    leaf = schema.leaves[0]
+    dec = DeviceChunkDecoder(leaf)
+    with pytest.raises(ParquetError, match="truncated"):
+        dec._decode_values_device(int(Encoding.PLAIN), b"\x00" * 17, 0, 100)
+
+
+def test_device_v1_level_stream_bounded_by_prefix():
+    """A v1 level stream whose runs need more bytes than its declared size
+    must raise, not read into the value region (host parity)."""
+    import io as _io
+    from tpu_parquet.kernels.rle import RLEError
+    # craft: declared size 1, but run header promises 13 groups of 8 values
+    stream = (1).to_bytes(4, "little") + bytes([0x1B]) + b"\xff" * 20
+    from tpu_parquet.kernels import rle as rle_host
+    with pytest.raises(RLEError):
+        rle_host.decode_prefixed(stream, 1, 104)
+
+
+def test_device_rejects_out_of_range_dict_index():
+    """Corrupt dictionary indices must raise (deferred per-chunk check)."""
+    import jax.numpy as jnp
+    from tpu_parquet.footer import ParquetError
+    from tpu_parquet.kernels import rle as rle_host
+
+    schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
+    leaf = schema.leaves[0]
+    dec = DeviceChunkDecoder(leaf)
+    # fake a 4-entry int64 dictionary
+    dict_vals = np.arange(4, dtype=np.int64)
+    dec.dict_u8 = jnp.asarray(dict_vals.view(np.uint8).reshape(4, 8))
+    dec.dict_dtype = "int64"
+    dec.dict_len = 4
+    dec._idx_maxima = []
+    # index stream containing 9 (out of range), width 4
+    stream = bytes([4]) + rle_host.encode(np.array([1, 9, 2], dtype=np.uint64), 4)
+    v, _, _ = dec._decode_values_device(int(Encoding.RLE_DICTIONARY), stream, 0, 3)
+    assert dec._idx_maxima, "max tracking must record the page"
+    mx = int(jnp.max(jnp.stack(dec._idx_maxima)))
+    assert mx == 9
+    with pytest.raises(ParquetError, match="out of range"):
+        if mx >= dec.dict_len:
+            raise ParquetError(f"dictionary index {mx} out of range ({dec.dict_len})")
+
+
+def test_device_rejects_external_file_path():
+    from tpu_parquet.footer import ParquetError
+    from tpu_parquet.chunk_decode import validate_chunk_meta
+    from tpu_parquet.format import ColumnChunk, ColumnMetaData
+
+    schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
+    leaf = schema.leaves[0]
+    md = ColumnMetaData(
+        type=int(Type.INT64), data_page_offset=4,
+        total_compressed_size=10, num_values=1,
+    )
+    chunk = ColumnChunk(file_path="elsewhere.parquet", meta_data=md)
+    with pytest.raises(ParquetError, match="external file"):
+        validate_chunk_meta(chunk, leaf)

@@ -360,18 +360,14 @@ def _concat_values(parts: list):
     return np.concatenate(parts)
 
 
-def read_chunk(
-    f: BinaryIO,
-    chunk,
-    leaf: SchemaNode,
-    validate_crc: bool = False,
-    alloc: Optional[AllocTracker] = None,
-) -> ColumnData:
-    """Read + decode one column chunk from an open file.
+def validate_chunk_meta(chunk, leaf: SchemaNode):
+    """Validate a ColumnChunk's embedded metadata; returns (md, start_offset).
 
-    Mirrors readChunk (chunk_reader.go:299-330): requires embedded ColumnMetaData
-    (PARQUET-291: file_offset is unreliable), seeks to the dictionary page when
-    present else the first data page, and consumes total_compressed_size bytes.
+    Mirrors readChunk's entry checks (chunk_reader.go:299-330): requires embedded
+    ColumnMetaData (PARQUET-291: file_offset is unreliable), rejects external
+    file_path chunks, verifies the physical type, and picks the dictionary page
+    offset when present else the first data page.  Shared by the host and device
+    chunk readers so both reject the same malformed files.
     """
     md = chunk.meta_data
     if md is None:
@@ -392,11 +388,23 @@ def read_chunk(
     offset = md.data_page_offset
     if md.dictionary_page_offset is not None and md.dictionary_page_offset >= 0:
         offset = min(offset, md.dictionary_page_offset)
-    size = md.total_compressed_size
-    if size is None or size < 0:
-        raise ParquetError(f"invalid chunk size {size}")
+    if md.total_compressed_size is None or md.total_compressed_size < 0:
+        raise ParquetError(f"invalid chunk size {md.total_compressed_size}")
     if md.num_values is None or md.num_values < 0:
         raise ParquetError(f"invalid chunk value count {md.num_values}")
+    return md, offset
+
+
+def read_chunk(
+    f: BinaryIO,
+    chunk,
+    leaf: SchemaNode,
+    validate_crc: bool = False,
+    alloc: Optional[AllocTracker] = None,
+) -> ColumnData:
+    """Read + decode one column chunk from an open file (readChunk parity)."""
+    md, offset = validate_chunk_meta(chunk, leaf)
+    size = md.total_compressed_size
     if alloc is not None:
         alloc.register(size)
     f.seek(offset)

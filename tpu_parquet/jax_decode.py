@@ -1,0 +1,713 @@
+"""Device-path chunk decoding: host structure parse → XLA bulk decode.
+
+This is the TPU twin of chunk_decode.py.  The host walks page headers and the
+sequential, metadata-sized parts of each encoding (run headers, delta block
+headers) with NumPy; the bulky transforms run as jitted XLA programs from
+jax_kernels.py over the raw page bytes staged to device memory.  Decoded columns
+are jax Arrays that stay on device (SURVEY.md §7.1 design stance).
+
+Shapes are static per (geometry) so XLA executables are cached across pages:
+run tables are padded to power-of-two buckets, byte buffers to 64-byte multiples.
+The first page of a new geometry pays a compile; every later page of the same
+shape reuses it — the pipelining SURVEY.md §7.4.7 names as the real perf lever.
+
+Encoding coverage mirrors chunk_reader.go:106-159 where the transform is
+parallelizable; inherently sequential byte-level paths (PLAIN BYTE_ARRAY length
+walking, DELTA_BYTE_ARRAY prefix stitching) parse on host and ship (offsets, heap)
+to device, per SURVEY.md §7.4.2/§7.4.4.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import jax_kernels as K
+from .column import ByteArrayData
+from .compress import decompress_block
+from .footer import ParquetError
+from .format import Encoding, PageType, Type
+from .kernels import bitpack, rle
+from .kernels.rle import RLEError, _read_uvarint
+from .kernels.delta import DeltaError, _read_uvarint as _delta_uvarint, _read_zigzag
+from .chunk_decode import PageSlice, validate_chunk_meta, walk_pages, _check_crc
+from .schema.core import SchemaNode
+
+__all__ = [
+    "DeviceColumnData",
+    "DeviceChunkDecoder",
+    "parse_hybrid_meta",
+    "parse_delta_meta",
+    "decode_hybrid_device",
+    "decode_delta_device",
+    "pad_buffer",
+]
+
+_SLACK = 16  # extract_bits worst-case gather overrun (9 bytes) + alignment
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    """Round up to a power of two (>= floor) to bound the jit cache."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_buffer(raw: bytes | np.ndarray) -> jax.Array:
+    """Stage a byte buffer on device, padded so bit-extract gathers stay in bounds."""
+    arr = np.frombuffer(raw, dtype=np.uint8) if isinstance(raw, (bytes, bytearray, memoryview)) else raw
+    n = len(arr)
+    padded = _bucket(n + _SLACK, 64)
+    out = np.zeros(padded, dtype=np.uint8)
+    out[:n] = arr
+    return jnp.asarray(out)
+
+
+# ---------------------------------------------------------------------------
+# RLE/bit-packed hybrid: host run-header parse → device expansion
+# ---------------------------------------------------------------------------
+
+@dataclass
+class HybridMeta:
+    """Padded per-run tables for jax_kernels.expand_rle_hybrid."""
+
+    run_ends: np.ndarray       # int64[R] cumulative counts (padded: repeat last)
+    run_is_rle: np.ndarray     # bool[R]
+    run_values: np.ndarray     # uint32[R]
+    run_bit_starts: np.ndarray  # int64[R] payload bit start minus start*width
+    count: int
+    consumed: int              # bytes consumed from the stream
+
+
+def parse_hybrid_meta(
+    buf: bytes, width: int, count: int, pos: int = 0, end: Optional[int] = None
+) -> HybridMeta:
+    """Walk run headers only (no payload unpacking) — cheap, O(runs) bytes.
+
+    Mirrors the header walk of hybrid_decoder.go:115-165 but records (kind, span,
+    payload offset) instead of decoding; the payload stays untouched for the
+    device kernel.  ``end`` bounds the stream (v1 length prefix): runs may not
+    extend past it, matching the host decoder's size validation.
+    """
+    if width < 0 or width > 32:
+        raise RLEError(f"invalid hybrid bit width {width} for device path")
+    ends, kinds, vals, starts = [], [], [], []
+    total = 0
+    value_bytes = (width + 7) // 8
+    n = len(buf) if end is None else min(end, len(buf))
+    while total < count:
+        if pos >= n:
+            raise RLEError(f"hybrid stream exhausted: wanted {count}, got {total}")
+        h, pos = _read_uvarint(buf, pos)
+        if h & 1:
+            groups = h >> 1
+            nvals = groups * 8
+            if nvals == 0:
+                continue
+            nbytes = groups * width
+            if pos + nbytes > n:
+                raise RLEError("truncated bit-packed run")
+            take = min(nvals, count - total)
+            kinds.append(False)
+            vals.append(0)
+            starts.append(pos * 8 - total * width)
+            pos += nbytes
+            total += take
+        else:
+            repeats = h >> 1
+            if repeats == 0:
+                continue
+            repeats = min(repeats, count - total)
+            if pos + value_bytes > n:
+                raise RLEError("truncated RLE run value")
+            v = int.from_bytes(buf[pos : pos + value_bytes], "little") if value_bytes else 0
+            pos += value_bytes
+            kinds.append(True)
+            vals.append(v & 0xFFFFFFFF)
+            starts.append(0)
+            total += repeats
+        ends.append(total)
+
+    r = max(len(ends), 1)
+    rp = _bucket(r)
+    run_ends = np.full(rp, count, dtype=np.int64)
+    run_is_rle = np.zeros(rp, dtype=bool)
+    run_values = np.zeros(rp, dtype=np.uint32)
+    run_bit_starts = np.zeros(rp, dtype=np.int64)
+    if ends:
+        run_ends[: len(ends)] = ends
+        run_is_rle[: len(ends)] = kinds
+        run_values[: len(ends)] = vals
+        run_bit_starts[: len(ends)] = starts
+    else:  # count == 0 never reaches here; defensive
+        run_is_rle[0] = True
+    return HybridMeta(run_ends, run_is_rle, run_values, run_bit_starts, count, pos)
+
+
+@functools.partial(jax.jit, static_argnames=("width", "count"))
+def _hybrid_jit(buf, run_ends, run_is_rle, run_values, run_bit_starts, *, width, count):
+    return K.expand_rle_hybrid(
+        buf, run_ends, run_is_rle, run_values, run_bit_starts, width, count
+    )
+
+
+def decode_hybrid_device(buf_dev: jax.Array, meta: HybridMeta, width: int) -> jax.Array:
+    return _hybrid_jit(
+        buf_dev,
+        jnp.asarray(meta.run_ends),
+        jnp.asarray(meta.run_is_rle),
+        jnp.asarray(meta.run_values),
+        jnp.asarray(meta.run_bit_starts),
+        width=width,
+        count=meta.count,
+    )
+
+
+# ---------------------------------------------------------------------------
+# DELTA_BINARY_PACKED: host block-header parse → device extract + cumsum
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DeltaMeta:
+    first_value: int
+    mini_bit_starts: np.ndarray  # int64[M] (padded: repeat last with width 0)
+    mini_widths: np.ndarray      # int32[M]
+    mini_min_delta: np.ndarray   # uint64[M] per-miniblock (block min repeated)
+    values_per_mini: int
+    count: int
+    consumed: int
+
+
+def parse_delta_meta(buf: bytes, bits: int, pos: int = 0) -> DeltaMeta:
+    """Walk DELTA_BINARY_PACKED headers, recording per-miniblock geometry.
+
+    The payload bytes are never touched: only the varint headers and the
+    bit-width byte vectors are read (deltabp_decoder.go:38-103 structure).
+    """
+    block_size, pos = _delta_uvarint(buf, pos)
+    minis_per_block, pos = _delta_uvarint(buf, pos)
+    total, pos = _delta_uvarint(buf, pos)
+    first, pos = _read_zigzag(buf, pos)
+    if block_size == 0 or block_size % 128 != 0:
+        raise DeltaError(f"invalid delta block size {block_size}")
+    if minis_per_block == 0 or block_size % minis_per_block != 0:
+        raise DeltaError(f"invalid miniblock count {minis_per_block}")
+    values_per_mini = block_size // minis_per_block
+    if values_per_mini % 32 != 0:
+        raise DeltaError(f"miniblock size {values_per_mini} not multiple of 32")
+    if total > 1 << 40:
+        raise DeltaError(f"implausible delta value count {total}")
+
+    starts, widths, mins = [], [], []
+    got = 0
+    n_deltas = max(total - 1, 0)
+    mask = 0xFFFFFFFFFFFFFFFF
+    while got < n_deltas:
+        min_delta, pos = _read_zigzag(buf, pos)
+        if pos + minis_per_block > len(buf):
+            raise DeltaError("truncated miniblock bit widths")
+        wvec = buf[pos : pos + minis_per_block]
+        pos += minis_per_block
+        for m in range(minis_per_block):
+            if got >= n_deltas:
+                break
+            w = wvec[m]
+            # widths up to 64 are accepted even for 32-bit columns (host
+            # parity: kernels/delta.py wraps mod 2^32, as does the Go reference)
+            if w > 64:
+                raise DeltaError(f"invalid miniblock bit width {w}")
+            nbytes = (values_per_mini * w + 7) // 8
+            if pos + nbytes > len(buf):
+                raise DeltaError("truncated miniblock data")
+            starts.append(pos * 8)
+            widths.append(w)
+            mins.append(min_delta & mask)
+            pos += nbytes
+            got += min(values_per_mini, n_deltas - got)
+
+    m = max(len(starts), 1)
+    mp = _bucket(m)
+    bs = np.zeros(mp, dtype=np.int64)
+    ws = np.zeros(mp, dtype=np.int32)
+    md = np.zeros(mp, dtype=np.uint64)
+    if starts:
+        bs[: len(starts)] = starts
+        ws[: len(widths)] = widths
+        md[: len(mins)] = mins
+        bs[len(starts):] = starts[-1]
+    return DeltaMeta(first, bs, ws, md, values_per_mini, total, pos)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("values_per_mini", "count", "bits", "max_width")
+)
+def _delta_jit(
+    buf, first, starts, widths, mins, *, values_per_mini, count, bits, max_width
+):
+    return K.delta_reconstruct(
+        buf, first, starts, widths, mins, values_per_mini, count, bits, max_width
+    )
+
+
+def decode_delta_device(buf_dev: jax.Array, meta: DeltaMeta, bits: int) -> jax.Array:
+    return _delta_jit(
+        buf_dev,
+        jnp.asarray(meta.first_value, dtype=jnp.int64),
+        jnp.asarray(meta.mini_bit_starts),
+        jnp.asarray(meta.mini_widths),
+        jnp.asarray(meta.mini_min_delta),
+        values_per_mini=meta.values_per_mini,
+        count=meta.count,
+        bits=bits,
+        max_width=max(int(meta.mini_widths.max(initial=0)), 1),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Whole-chunk device decoder
+# ---------------------------------------------------------------------------
+
+_PTYPE_TO_NAME = {
+    Type.INT32: "int32",
+    Type.INT64: "int64",
+    Type.FLOAT: "float32",
+    Type.DOUBLE: "float64",
+}
+
+
+# The value stream starts at a page-dependent byte offset inside the staged
+# page buffer; the offset is a *traced* scalar so one executable serves every
+# page of the same (dtype, count) geometry — no recompile, no re-staging.
+
+@functools.partial(jax.jit, static_argnames=("dtype", "count"))
+def _plain_jit(buf, off, *, dtype, count):
+    nbytes = 8 if dtype in ("int64", "float64") else 4
+    raw = jax.lax.dynamic_slice(buf, (off,), (count * nbytes,))
+    return K.plain_decode_fixed(raw, dtype, count)
+
+
+@functools.partial(jax.jit, static_argnames=("dtype", "count"))
+def _bss_jit(buf, off, *, dtype, count):
+    nbytes = 8 if dtype in ("int64", "float64") else 4
+    raw = jax.lax.dynamic_slice(buf, (off,), (count * nbytes,))
+    return K.byte_stream_split_decode(raw, dtype, count)
+
+
+@functools.partial(jax.jit, static_argnames=("count",))
+def _bool_plain_jit(buf, off, *, count):
+    bit_pos = off.astype(jnp.int64) * 8 + jnp.arange(count, dtype=jnp.int64)
+    return K.extract_bits(buf, bit_pos, 1, 1).astype(jnp.bool_)
+
+
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def _dict_gather_bytes_jit(dict_u8, indices, *, dtype):
+    return K.dict_gather_bytes(dict_u8, indices, dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_heap_size",))
+def _ragged_take_jit(offsets, heap, indices, *, out_heap_size):
+    return K.ragged_take(offsets, heap, indices, out_heap_size)
+
+
+@dataclass
+class DeviceColumnData:
+    """Decoded column chunk resident on device.
+
+    Fixed-width: ``values`` is a jax Array of the defined values.  BYTE_ARRAY:
+    ``offsets``/``heap`` hold the ragged representation on device instead.
+    Levels (when present) are device uint32 arrays, one per leaf slot.
+    """
+
+    values: Optional[jax.Array] = None
+    offsets: Optional[jax.Array] = None
+    heap: Optional[jax.Array] = None
+    def_levels: Optional[jax.Array] = None
+    rep_levels: Optional[jax.Array] = None
+    max_def: int = 0
+    max_rep: int = 0
+    num_leaf_slots: int = 0
+    # logical dtype when the device representation differs: DOUBLE columns are
+    # uint32[n,2] word pairs on device (TPU f64 emulation rounds real f64 data —
+    # see jax_kernels.plain_decode_fixed) and only become f64 on the host.
+    value_dtype: Optional[str] = None
+
+    def validity(self) -> jax.Array:
+        if self.def_levels is None:
+            return jnp.ones(self.num_leaf_slots, dtype=bool)
+        return K.levels_to_validity(self.def_levels, self.max_def)
+
+    def to_host(self) -> "ByteArrayData | np.ndarray":
+        if self.offsets is not None:
+            return ByteArrayData(
+                offsets=np.asarray(self.offsets), heap=np.asarray(self.heap)
+            )
+        vals = np.asarray(self.values)
+        if self.value_dtype == "float64" and vals.ndim == 2:
+            return np.ascontiguousarray(vals).view("<f8").reshape(len(vals))
+        return vals
+
+
+class DeviceChunkDecoder:
+    """Decode one column chunk into device-resident arrays.
+
+    Mirrors chunk_decode.ChunkDecoder page-for-page; falls back to the host
+    kernels only for the sequential byte-array paths (PLAIN/DELTA BYTE_ARRAY
+    value streams), shipping their (offsets, heap) results to device.
+    """
+
+    def __init__(self, leaf: SchemaNode, validate_crc: bool = False):
+        self.leaf = leaf
+        self.validate_crc = validate_crc
+        self.dict_u8: Optional[jax.Array] = None           # fixed-width dict, u8 rows
+        self.dict_dtype: Optional[str] = None              # target dtype name
+        self.dict_len: int = 0
+        self.dict_offsets: Optional[jax.Array] = None      # ragged dict
+        self.dict_heap: Optional[jax.Array] = None
+        self._dict_host_offsets: Optional[np.ndarray] = None
+        self._idx_maxima: list = []  # per-page device max dict index, checked per chunk
+
+    # -- dictionary ----------------------------------------------------------
+
+    def _decode_dict_page(self, ps: PageSlice, buf: bytes, codec: int) -> None:
+        from .kernels import plain as plain_host
+
+        header = ps.header
+        payload = buf[ps.payload_start : ps.payload_end]
+        _check_crc(header, payload, self.validate_crc)
+        raw = decompress_block(payload, codec, header.uncompressed_page_size)
+        dh = header.dictionary_page_header
+        enc = Encoding(dh.encoding)
+        if enc not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
+            raise ParquetError(f"dictionary page encoding {enc.name} unsupported")
+        count = dh.num_values or 0
+        decoded = plain_host.decode(raw, self.leaf.physical_type, count, self.leaf.type_length)
+        if isinstance(decoded, ByteArrayData):
+            self._dict_host_offsets = decoded.offsets
+            self.dict_offsets = jnp.asarray(decoded.offsets)
+            self.dict_heap = jnp.asarray(decoded.heap)
+            self.dict_len = len(decoded)
+        else:
+            # stage as raw byte rows: gathers must move bits verbatim, and
+            # u8[...,k]→wide bitcasts are the only ones TPU's X64 pass supports
+            arr = np.ascontiguousarray(decoded)
+            n = len(arr)
+            self.dict_len = n
+            row_bytes = (arr.nbytes // n) if n else arr.dtype.itemsize
+            base = arr.dtype.name if arr.ndim == 1 else "uint32"  # INT96: (n,3) u32
+            u8 = arr.view(np.uint8).reshape(n, row_bytes) if n else np.zeros(
+                (0, row_bytes), dtype=np.uint8
+            )
+            self.dict_u8 = jnp.asarray(u8)
+            self.dict_dtype = base
+
+    # -- values --------------------------------------------------------------
+
+    def _decode_values_device(self, enc: int, raw: bytes, pos: int, count: int):
+        """Decode the value stream at byte offset ``pos`` of page bytes ``raw``.
+
+        Returns (values_array, offsets, heap) — exactly one representation set.
+        ``raw`` is staged to device at most once; all kernels address into it
+        with byte/bit offsets instead of re-staging slices.
+        """
+        ptype = self.leaf.physical_type
+        avail = len(raw) - pos
+        enc = Encoding(enc)
+        if enc == Encoding.PLAIN_DICTIONARY:
+            enc = Encoding.RLE_DICTIONARY
+
+        if enc == Encoding.PLAIN:
+            if ptype == Type.BOOLEAN:
+                need = (count + 7) // 8
+                if avail < need:
+                    raise ParquetError(f"PLAIN BOOLEAN truncated: {avail} < {need}")
+                return (
+                    _bool_plain_jit(
+                        pad_buffer(raw), jnp.int64(pos), count=count
+                    ),
+                    None,
+                    None,
+                )
+            name = _PTYPE_TO_NAME.get(ptype)
+            if name is not None:
+                need = count * np.dtype(name).itemsize
+                if avail < need:
+                    raise ParquetError(f"PLAIN data truncated: {avail} < {need}")
+                return (
+                    _plain_jit(pad_buffer(raw), jnp.int64(pos), dtype=name, count=count),
+                    None,
+                    None,
+                )
+            # INT96 / BYTE_ARRAY / FIXED: host parse, device-stage result
+            from .kernels import plain as plain_host
+
+            decoded = plain_host.decode(raw[pos:], ptype, count, self.leaf.type_length)
+            if isinstance(decoded, ByteArrayData):
+                return None, jnp.asarray(decoded.offsets), jnp.asarray(decoded.heap)
+            return jnp.asarray(decoded), None, None
+
+        if enc == Encoding.RLE_DICTIONARY:
+            if self.dict_u8 is None and self.dict_offsets is None:
+                raise ParquetError("dictionary-encoded page but no dictionary page seen")
+            if avail < 1:
+                raise ParquetError("dictionary page data truncated (missing width)")
+            width = raw[pos]
+            if width > 32:
+                raise ParquetError(f"dictionary index width {width} invalid")
+            meta = parse_hybrid_meta(raw, width, count, pos=pos + 1)
+            idx = decode_hybrid_device(pad_buffer(raw), meta, width)
+            if self.dict_u8 is not None:
+                if count and self.dict_len == 0:
+                    raise ParquetError("dictionary indices with empty dictionary")
+                # range check is deferred to the end of the chunk (decode()):
+                # recording the device-side max costs nothing now, and one sync
+                # per chunk validates every page without stalling the pipeline
+                if count:
+                    self._idx_maxima.append(jnp.max(idx))
+                return (
+                    _dict_gather_bytes_jit(self.dict_u8, idx, dtype=self.dict_dtype),
+                    None,
+                    None,
+                )
+            # ragged dictionary: need output heap size on host
+            host_idx = np.asarray(idx, dtype=np.int64)
+            off = self._dict_host_offsets
+            if count and host_idx.max(initial=0) >= len(off) - 1:
+                raise ParquetError(
+                    f"dictionary index {int(host_idx.max())} out of range ({len(off) - 1})"
+                )
+            out_heap = int((off[host_idx + 1] - off[host_idx]).sum())
+            new_off, new_heap = _ragged_take_jit(
+                self.dict_offsets, self.dict_heap, idx,
+                out_heap_size=_bucket(max(out_heap, 1), 64),
+            )
+            return None, new_off, new_heap[:out_heap] if out_heap else jnp.zeros(0, jnp.uint8)
+
+        if enc == Encoding.DELTA_BINARY_PACKED:
+            bits = 32 if ptype == Type.INT32 else 64
+            if ptype not in (Type.INT32, Type.INT64):
+                raise ParquetError(f"DELTA_BINARY_PACKED invalid for {ptype!r}")
+            meta = parse_delta_meta(raw, bits, pos=pos)
+            if meta.count < count:
+                raise ParquetError(f"delta stream yielded {meta.count} of {count} values")
+            vals = decode_delta_device(pad_buffer(raw), meta, bits)
+            return vals[:count], None, None
+
+        if enc == Encoding.BYTE_STREAM_SPLIT:
+            name = _PTYPE_TO_NAME.get(ptype)
+            if name is None:
+                raise ParquetError(f"BYTE_STREAM_SPLIT device path unsupported for {ptype!r}")
+            need = count * np.dtype(name).itemsize
+            if avail < need:
+                raise ParquetError(f"BYTE_STREAM_SPLIT truncated: {avail} < {need}")
+            return (
+                _bss_jit(pad_buffer(raw), jnp.int64(pos), dtype=name, count=count),
+                None,
+                None,
+            )
+
+        if enc == Encoding.RLE:
+            if ptype != Type.BOOLEAN:
+                raise ParquetError(f"RLE value encoding invalid for {ptype!r}")
+            if avail < 4:
+                raise ParquetError("truncated boolean RLE stream")
+            size = int.from_bytes(raw[pos : pos + 4], "little")
+            if pos + 4 + size > len(raw):
+                raise ParquetError(f"boolean RLE length {size} exceeds page")
+            meta = parse_hybrid_meta(raw, 1, count, pos=pos + 4, end=pos + 4 + size)
+            vals = decode_hybrid_device(pad_buffer(raw), meta, 1)
+            return vals.astype(jnp.bool_), None, None
+
+        # DELTA_LENGTH_BYTE_ARRAY / DELTA_BYTE_ARRAY: host decode, stage result
+        from .kernels import bytearray as ba_host
+
+        if enc == Encoding.DELTA_LENGTH_BYTE_ARRAY:
+            d = ba_host.decode_delta_length(raw[pos:], count)
+            return None, jnp.asarray(d.offsets), jnp.asarray(d.heap)
+        if enc == Encoding.DELTA_BYTE_ARRAY:
+            d = ba_host.decode_delta(raw[pos:], count)
+            return None, jnp.asarray(d.offsets), jnp.asarray(d.heap)
+        raise ParquetError(f"unsupported value encoding {enc.name} for {ptype!r}")
+
+    # -- pages ---------------------------------------------------------------
+
+    def _decode_data_page_v1(self, ps: PageSlice, buf: bytes, codec: int):
+        """Level streams decode on host; the value stream decodes on device.
+
+        Levels are metadata-sized and RLE-run dominated (all-defined columns are
+        one run) — host expansion is cheap, yields the defined-count for free,
+        and avoids a blocking device→host sync per page that would serialize the
+        page pipeline.  The device-side *reconstruction* from levels (validity
+        scatter, row starts) still runs as prefix scans in jax_kernels.
+        """
+        header = ps.header
+        dh = header.data_page_header
+        payload = buf[ps.payload_start : ps.payload_end]
+        _check_crc(header, payload, self.validate_crc)
+        raw = decompress_block(payload, codec, header.uncompressed_page_size)
+        num_values = dh.num_values or 0
+        if num_values < 0:
+            raise ParquetError(f"negative page value count {num_values}")
+        pos = 0
+        max_rep, max_def = self.leaf.max_rep, self.leaf.max_def
+        rlv_host = dlv_host = None
+        if max_rep > 0:
+            rlv_host, used = rle.decode_prefixed(
+                raw[pos:], bitpack.bit_width(max_rep), num_values
+            )
+            pos += used
+        if max_def > 0:
+            dlv_host, used = rle.decode_prefixed(
+                raw[pos:], bitpack.bit_width(max_def), num_values
+            )
+            pos += used
+        defined = (
+            int(np.count_nonzero(dlv_host == max_def))
+            if dlv_host is not None
+            else num_values
+        )
+        v, off, heap = self._decode_values_device(dh.encoding, raw, pos, defined)
+        dlv = jnp.asarray(dlv_host) if dlv_host is not None else None
+        rlv = jnp.asarray(rlv_host) if rlv_host is not None else None
+        return v, off, heap, dlv, rlv, num_values
+
+    def _decode_data_page_v2(self, ps: PageSlice, buf: bytes, codec: int):
+        header = ps.header
+        dh = header.data_page_header_v2
+        payload = buf[ps.payload_start : ps.payload_end]
+        _check_crc(header, payload, self.validate_crc)
+        num_values = dh.num_values or 0
+        if num_values < 0:
+            raise ParquetError(f"negative page value count {num_values}")
+        rep_len = dh.repetition_levels_byte_length or 0
+        def_len = dh.definition_levels_byte_length or 0
+        if rep_len < 0 or def_len < 0 or rep_len + def_len > len(payload):
+            raise ParquetError("v2 level lengths exceed page")
+        max_rep, max_def = self.leaf.max_rep, self.leaf.max_def
+        rlv_host = dlv_host = None
+        if max_rep > 0:
+            if rep_len == 0:
+                raise ParquetError("v2 page missing repetition levels")
+            rlv_host = rle.decode(
+                payload[:rep_len], bitpack.bit_width(max_rep), num_values
+            )
+        if max_def > 0:
+            dlv_host = rle.decode(
+                payload[rep_len : rep_len + def_len],
+                bitpack.bit_width(max_def),
+                num_values,
+            )
+        if dh.num_nulls is not None and dlv_host is not None:
+            actual_nulls = int(np.count_nonzero(dlv_host != max_def))
+            if dh.num_nulls != actual_nulls and max_rep == 0:
+                raise ParquetError(
+                    f"v2 page declares {dh.num_nulls} nulls, levels say {actual_nulls}"
+                )
+        values_block = payload[rep_len + def_len :]
+        uncompressed_values = header.uncompressed_page_size - rep_len - def_len
+        if dh.is_compressed is None or dh.is_compressed:
+            raw = decompress_block(values_block, codec, uncompressed_values)
+        else:
+            raw = values_block
+        defined = (
+            int(np.count_nonzero(dlv_host == max_def))
+            if dlv_host is not None
+            else num_values
+        )
+        v, off, heap = self._decode_values_device(dh.encoding, raw, 0, defined)
+        dlv = jnp.asarray(dlv_host) if dlv_host is not None else None
+        rlv = jnp.asarray(rlv_host) if rlv_host is not None else None
+        return v, off, heap, dlv, rlv, num_values
+
+    # -- chunk ---------------------------------------------------------------
+
+    def decode(self, buf: bytes, codec: int, total_values: int) -> DeviceColumnData:
+        pages = walk_pages(buf, total_values)
+        vals_parts, off_parts, heap_parts = [], [], []
+        def_parts, rep_parts = [], []
+        slots = 0
+        self._idx_maxima = []
+        for ps in pages:
+            pt = ps.header.type
+            if pt == PageType.DICTIONARY_PAGE:
+                self._decode_dict_page(ps, buf, codec)
+                continue
+            if pt == PageType.DATA_PAGE:
+                v, off, heap, d, r, n = self._decode_data_page_v1(ps, buf, codec)
+            elif pt == PageType.DATA_PAGE_V2:
+                v, off, heap, d, r, n = self._decode_data_page_v2(ps, buf, codec)
+            else:
+                continue
+            slots += n
+            if v is not None:
+                vals_parts.append(v)
+            else:
+                off_parts.append(off)
+                heap_parts.append(heap)
+            if d is not None:
+                def_parts.append(d)
+            if r is not None:
+                rep_parts.append(r)
+
+        if self._idx_maxima:
+            mx = int(jnp.max(jnp.stack(self._idx_maxima)))
+            if mx >= self.dict_len:
+                raise ParquetError(
+                    f"dictionary index {mx} out of range ({self.dict_len})"
+                )
+
+        out = DeviceColumnData(
+            max_def=self.leaf.max_def,
+            max_rep=self.leaf.max_rep,
+            num_leaf_slots=slots,
+            value_dtype=(
+                "float64" if self.leaf.physical_type == Type.DOUBLE else None
+            ),
+        )
+        if off_parts:
+            if len(off_parts) == 1:
+                out.offsets, out.heap = off_parts[0], heap_parts[0]
+            else:
+                bases = np.cumsum([0] + [int(o[-1]) for o in off_parts[:-1]])
+                out.offsets = jnp.concatenate(
+                    [off_parts[0]]
+                    + [o[1:] + int(b) for o, b in zip(off_parts[1:], bases[1:])]
+                )
+                out.heap = jnp.concatenate(heap_parts)
+        elif vals_parts:
+            out.values = (
+                vals_parts[0] if len(vals_parts) == 1 else jnp.concatenate(vals_parts)
+            )
+        else:
+            out.values = jnp.zeros(0, dtype=jnp.int64)
+        if def_parts:
+            out.def_levels = (
+                def_parts[0] if len(def_parts) == 1 else jnp.concatenate(def_parts)
+            )
+        if rep_parts:
+            out.rep_levels = (
+                rep_parts[0] if len(rep_parts) == 1 else jnp.concatenate(rep_parts)
+            )
+        return out
+
+
+def read_chunk_device(
+    f, chunk, leaf: SchemaNode, validate_crc: bool = False
+) -> DeviceColumnData:
+    """Device twin of chunk_decode.read_chunk (same seek/size/meta discipline)."""
+    md, offset = validate_chunk_meta(chunk, leaf)
+    f.seek(offset)
+    buf = f.read(md.total_compressed_size)
+    if len(buf) != md.total_compressed_size:
+        raise ParquetError(
+            f"chunk truncated: wanted {md.total_compressed_size} bytes at {offset}, "
+            f"got {len(buf)}"
+        )
+    dec = DeviceChunkDecoder(leaf, validate_crc=validate_crc)
+    return dec.decode(buf, md.codec, md.num_values)
