@@ -1,0 +1,171 @@
+"""Benchmark: device (TPU) columnar decode vs host (NumPy) columnar decode.
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N}
+Everything else goes to stderr.
+
+Workload (BASELINE.md configs 1-3 folded into one lineitem-like file):
+    l_orderkey   INT64  DELTA_BINARY_PACKED   (sorted keys: small deltas)
+    l_quantity   INT64  PLAIN
+    l_shipdate   INT32  DELTA_BINARY_PACKED
+    l_returnflag BYTE_ARRAY dictionary (3 distinct, RLE_DICTIONARY)
+compressed with SNAPPY (native C++ codec in tree).
+
+"value" is end-to-end device-path decode throughput: file open → footer → per
+chunk IO → host decompress + structure parse → XLA kernels → device arrays,
+blocked until ready (columns stay on device; that is the product).
+"vs_baseline" divides by the host NumPy columnar decoder measured on the same
+file — a *stricter* denominator than the pure-Go reference (value-at-a-time,
+interface-dispatched, one boxed value per datum; see SURVEY.md §3.1 hot loops),
+which cannot run here (no Go toolchain in the image).
+
+Env knobs: BENCH_ROWS (default 10_000_000), BENCH_DEVICE_REPS (default 3).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+ROWS = int(os.environ.get("BENCH_ROWS", 10_000_000))
+REPS = int(os.environ.get("BENCH_DEVICE_REPS", 3))
+CACHE = f"/tmp/tpq_bench_lineitem_{ROWS}.parquet"
+
+
+def generate(path):
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tpu_parquet.format import (
+        CompressionCodec, ConvertedType, Encoding,
+        FieldRepetitionType as FRT, LogicalType, StringType, Type,
+    )
+    from tpu_parquet.schema.core import (
+        ColumnParameters, build_schema, data_column,
+    )
+    from tpu_parquet.writer import FileWriter
+
+    rng = np.random.default_rng(42)
+    schema = build_schema([
+        data_column("l_orderkey", Type.INT64, FRT.REQUIRED),
+        data_column("l_quantity", Type.INT64, FRT.REQUIRED),
+        data_column("l_shipdate", Type.INT32, FRT.REQUIRED),
+        data_column(
+            "l_returnflag", Type.BYTE_ARRAY, FRT.REQUIRED,
+            ColumnParameters(
+                logical_type=LogicalType(STRING=StringType()),
+                converted_type=ConvertedType.UTF8,
+            ),
+        ),
+    ])
+    t0 = time.perf_counter()
+    with FileWriter(
+        path, schema,
+        codec=CompressionCodec.SNAPPY,
+        column_encodings={
+            "l_orderkey": Encoding.DELTA_BINARY_PACKED,
+            "l_shipdate": Encoding.DELTA_BINARY_PACKED,
+        },
+        use_dictionary=True,
+        row_group_size=128 << 20,
+    ) as w:
+        step = 2_000_000
+        key = 0
+        flags = np.array([b"A", b"N", b"R"], dtype=object)
+        for lo in range(0, ROWS, step):
+            n = min(step, ROWS - lo)
+            keys = key + np.cumsum(rng.integers(1, 5, n))
+            key = int(keys[-1])
+            from tpu_parquet.column import ByteArrayData, ColumnData
+
+            flag_idx = rng.integers(0, 3, n)
+            flag_col = ByteArrayData(
+                offsets=np.arange(n + 1, dtype=np.int64),
+                heap=np.frombuffer(
+                    b"".join(flags[flag_idx]), dtype=np.uint8
+                ).copy(),
+            )
+            w.write_columns({
+                "l_orderkey": keys.astype(np.int64),
+                "l_quantity": rng.integers(1, 51, n).astype(np.int64),
+                "l_shipdate": (8035 + rng.integers(0, 2526, n)).astype(np.int32),
+                "l_returnflag": ColumnData(values=flag_col),
+            })
+    log(f"generated {path}: {os.path.getsize(path)/1e6:.1f} MB "
+        f"in {time.perf_counter()-t0:.1f}s")
+
+
+def bench_device(path):
+    import jax
+    from tpu_parquet.reader import FileReader
+    from tpu_parquet.jax_decode import read_chunk_device
+
+    def run():
+        r = FileReader(path)
+        leaves = {l.path: l for l in r.schema.leaves}
+        outs = []
+        for rg in r.metadata.row_groups:
+            for chunk in rg.columns:
+                leaf = leaves[tuple(chunk.meta_data.path_in_schema)]
+                outs.append(read_chunk_device(r._f, chunk, leaf))
+        arrs = []
+        for o in outs:
+            arrs.extend(a for a in (o.values, o.offsets, o.heap) if a is not None)
+        jax.block_until_ready(arrs)
+        r.close()
+
+    run()  # warm: XLA compiles cached after this
+    best = float("inf")
+    for i in range(REPS):
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        log(f"device rep {i}: {dt:.3f}s ({ROWS/dt/1e6:.2f} M rows/s)")
+        best = min(best, dt)
+    return ROWS / best
+
+
+def bench_host(path):
+    from tpu_parquet.reader import FileReader
+
+    def run():
+        r = FileReader(path)
+        for rg in r.iter_row_groups():
+            pass
+        r.close()
+
+    run()
+    best = float("inf")
+    for i in range(max(REPS - 1, 1)):
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        log(f"host rep {i}: {dt:.3f}s ({ROWS/dt/1e6:.2f} M rows/s)")
+        best = min(best, dt)
+    return ROWS / best
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.exists(CACHE):
+        generate(CACHE)
+    import jax
+
+    log(f"jax devices: {jax.devices()}")
+    dev = bench_device(CACHE)
+    host = bench_host(CACHE)
+    print(json.dumps({
+        "metric": "lineitem4_decode_rows_per_sec_device",
+        "value": round(dev, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(dev / host, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

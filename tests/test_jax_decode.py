@@ -34,7 +34,7 @@ from tpu_parquet.writer import FileWriter
 RNG = np.random.default_rng(7)
 
 
-def _roundtrip_compare(schema, rows, *, chunks_match=None, **writer_kw):
+def _roundtrip_compare(schema, rows, **writer_kw):
     buf = io.BytesIO()
     with FileWriter(buf, schema, **writer_kw) as w:
         w.write_rows(rows)
@@ -261,30 +261,59 @@ def test_device_v1_level_stream_bounded_by_prefix():
         rle_host.decode_prefixed(stream, 1, 104)
 
 
-def test_device_rejects_out_of_range_dict_index():
-    """Corrupt dictionary indices must raise (deferred per-chunk check)."""
-    import jax.numpy as jnp
-    from tpu_parquet.footer import ParquetError
+def _craft_dict_chunk(indices, dict_vals):
+    """Build raw chunk bytes: dict page (PLAIN int64) + one v1 data page of
+    RLE_DICTIONARY indices, uncompressed."""
+    from tpu_parquet.format import (
+        CompressionCodec, DataPageHeader, DictionaryPageHeader, PageHeader,
+        PageType,
+    )
     from tpu_parquet.kernels import rle as rle_host
+    from tpu_parquet.thrift import write_struct
+
+    dict_payload = np.asarray(dict_vals, dtype="<i8").tobytes()
+    dict_header = write_struct(PageHeader(
+        type=PageType.DICTIONARY_PAGE,
+        uncompressed_page_size=len(dict_payload),
+        compressed_page_size=len(dict_payload),
+        dictionary_page_header=DictionaryPageHeader(
+            num_values=len(dict_vals), encoding=int(Encoding.PLAIN),
+        ),
+    ))
+    width = max(int(np.asarray(indices).max()).bit_length(), 1)
+    data_payload = bytes([width]) + rle_host.encode(
+        np.asarray(indices, dtype=np.uint64), width
+    )
+    data_header = write_struct(PageHeader(
+        type=PageType.DATA_PAGE,
+        uncompressed_page_size=len(data_payload),
+        compressed_page_size=len(data_payload),
+        data_page_header=DataPageHeader(
+            num_values=len(indices),
+            encoding=int(Encoding.RLE_DICTIONARY),
+            definition_level_encoding=int(Encoding.RLE),
+            repetition_level_encoding=int(Encoding.RLE),
+        ),
+    ))
+    buf = dict_header + dict_payload + data_header + data_payload
+    return buf, int(CompressionCodec.UNCOMPRESSED)
+
+
+def test_device_rejects_out_of_range_dict_index():
+    """Corrupt dictionary indices must raise from decode() itself — the
+    deferred per-chunk check, driven end-to-end through a crafted chunk."""
+    from tpu_parquet.footer import ParquetError
 
     schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
     leaf = schema.leaves[0]
+    buf, codec = _craft_dict_chunk([1, 9, 2], np.arange(4))  # 9 out of range
     dec = DeviceChunkDecoder(leaf)
-    # fake a 4-entry int64 dictionary
-    dict_vals = np.arange(4, dtype=np.int64)
-    dec.dict_u8 = jnp.asarray(dict_vals.view(np.uint8).reshape(4, 8))
-    dec.dict_dtype = "int64"
-    dec.dict_len = 4
-    dec._idx_maxima = []
-    # index stream containing 9 (out of range), width 4
-    stream = bytes([4]) + rle_host.encode(np.array([1, 9, 2], dtype=np.uint64), 4)
-    v, _, _ = dec._decode_values_device(int(Encoding.RLE_DICTIONARY), stream, 0, 3)
-    assert dec._idx_maxima, "max tracking must record the page"
-    mx = int(jnp.max(jnp.stack(dec._idx_maxima)))
-    assert mx == 9
     with pytest.raises(ParquetError, match="out of range"):
-        if mx >= dec.dict_len:
-            raise ParquetError(f"dictionary index {mx} out of range ({dec.dict_len})")
+        dec.decode(buf, codec, 3)
+    # the same chunk with in-range indices decodes fine
+    buf, codec = _craft_dict_chunk([1, 3, 2], np.arange(4) * 10)
+    out = DeviceChunkDecoder(leaf).decode(buf, codec, 3)
+    np.testing.assert_array_equal(out.to_host(), [10, 30, 20])
 
 
 def test_device_rejects_external_file_path():

@@ -418,7 +418,10 @@ class DeviceChunkDecoder:
         """
         ptype = self.leaf.physical_type
         avail = len(raw) - pos
-        enc = Encoding(enc)
+        try:
+            enc = Encoding(enc)
+        except (ValueError, TypeError):
+            raise ParquetError(f"unknown value encoding {enc!r}") from None
         if enc == Encoding.PLAIN_DICTIONARY:
             enc = Encoding.RLE_DICTIONARY
 
@@ -502,7 +505,16 @@ class DeviceChunkDecoder:
         if enc == Encoding.BYTE_STREAM_SPLIT:
             name = _PTYPE_TO_NAME.get(ptype)
             if name is None:
-                raise ParquetError(f"BYTE_STREAM_SPLIT device path unsupported for {ptype!r}")
+                # FIXED_LEN_BYTE_ARRAY etc.: host decode, stage the result
+                # (same fallback pattern as the sequential byte-array paths)
+                from .chunk_decode import _byte_stream_split_decode
+
+                decoded = _byte_stream_split_decode(
+                    raw[pos:], ptype, count, self.leaf.type_length
+                )
+                if isinstance(decoded, ByteArrayData):
+                    return None, jnp.asarray(decoded.offsets), jnp.asarray(decoded.heap)
+                return jnp.asarray(decoded), None, None
             need = count * np.dtype(name).itemsize
             if avail < need:
                 raise ParquetError(f"BYTE_STREAM_SPLIT truncated: {avail} < {need}")

@@ -1,0 +1,381 @@
+"""Multi-chip / multi-host parallel decode (SPMD over a jax.sharding.Mesh).
+
+The reference is strictly single-threaded value-at-a-time (TODO.md:15 — the
+reader is not concurrent); its natural block hierarchy (file → row group →
+column chunk → page, SURVEY.md §5.7) is what this module turns into parallel
+axes:
+
+- **pages** of identical geometry batch under ``vmap`` and shard over the mesh's
+  ``data`` axis with ``shard_map`` — each device decodes its slice of the page
+  batch, and cross-device reductions (global stats) ride ICI collectives
+  (``psum``/``pmin``/``pmax``), never the host;
+- **row groups** are embarrassingly parallel and are *assigned*, not exchanged:
+  a greedy LPT plan balances compressed bytes across shards (hosts or chips) —
+  the §5.8 stance that the decode path needs sharded work lists, not an
+  NCCL-analog exchange;
+- **multi-host**: each process decodes the row groups its shard owns;
+  ``jax.make_array_from_process_local_data`` assembles the global sharded array
+  view when a training step consumes the columns.
+
+Everything compiles once per page geometry: within a mesh the per-device page
+count is static, so the same executable serves every batch of that shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import jax_kernels as K
+from ..jax_decode import HybridMeta, DeltaMeta, parse_hybrid_meta, parse_delta_meta, _bucket, _SLACK
+
+__all__ = [
+    "make_mesh",
+    "plan_shards",
+    "PageBatch",
+    "pack_hybrid_pages",
+    "pack_delta_pages",
+    "sharded_dict_decode",
+    "sharded_dict_decode_2d",
+    "sharded_delta_decode",
+    "sharded_plain_decode",
+    "column_stats",
+]
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None, axis: str = "data"
+) -> Mesh:
+    """1-D data mesh over all (or given) devices — the decode path needs no
+    model axis; re-sharding decoded columns onto a 2-D mesh is the consumer's
+    pjit's job (XLA inserts the all-to-all)."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs, (axis,))
+
+
+# ---------------------------------------------------------------------------
+# Work-list sharding (row groups → shards)
+# ---------------------------------------------------------------------------
+
+def plan_shards(sizes: Sequence[int], n_shards: int) -> list[list[int]]:
+    """Greedy LPT assignment of row groups to shards, balanced by byte size.
+
+    ``sizes[i]`` is row group i's total_compressed_size (or total_byte_size).
+    Returns per-shard lists of row-group indices.  Deterministic, so every
+    host computes the identical plan from the shared footer — no coordination
+    traffic (DCN only ships the footer, per SURVEY.md §5.8).
+    """
+    if n_shards <= 0:
+        raise ValueError(f"n_shards must be positive, got {n_shards}")
+    order = sorted(range(len(sizes)), key=lambda i: -int(sizes[i]))
+    loads = [0] * n_shards
+    plan: list[list[int]] = [[] for _ in range(n_shards)]
+    for i in order:
+        s = loads.index(min(loads))
+        plan[s].append(i)
+        loads[s] += int(sizes[i])
+    for shard in plan:
+        shard.sort()
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Page batching: N same-geometry pages → stacked device arrays
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PageBatch:
+    """A batch of same-geometry encoded pages, stacked for vmap/shard_map.
+
+    ``bufs`` u8[B, S]: padded page bytes.  Hybrid (dictionary-index) batches
+    carry run tables [B, R]; delta batches carry miniblock tables [B, M].
+    ``count`` values per page is uniform; a short tail page is padded with a
+    synthetic zero run via pack_hybrid_pages(counts=...) and callers slice the
+    decoded tail back (delta batches require equal counts — pack_delta_pages
+    raises otherwise).
+    """
+
+    bufs: jax.Array
+    count: int
+    width: int = 0                      # hybrid: index bit width
+    run_ends: Optional[jax.Array] = None
+    run_is_rle: Optional[jax.Array] = None
+    run_values: Optional[jax.Array] = None
+    run_bit_starts: Optional[jax.Array] = None
+    first_values: Optional[jax.Array] = None    # delta: per-page seed
+    mini_bit_starts: Optional[jax.Array] = None
+    mini_widths: Optional[jax.Array] = None
+    mini_min_delta: Optional[jax.Array] = None
+    values_per_mini: int = 0
+    max_width: int = 0
+
+    @property
+    def num_pages(self) -> int:
+        return int(self.bufs.shape[0])
+
+
+def _stack_padded_bufs(raws: list[bytes]) -> np.ndarray:
+    size = _bucket(max(len(r) for r in raws) + _SLACK, 64)
+    out = np.zeros((len(raws), size), dtype=np.uint8)
+    for i, r in enumerate(raws):
+        out[i, : len(r)] = np.frombuffer(r, dtype=np.uint8)
+    return out
+
+
+def pack_hybrid_pages(
+    raws: list[bytes],
+    width: int,
+    count: int,
+    pos: int = 0,
+    counts: Optional[Sequence[int]] = None,
+) -> PageBatch:
+    """Parse + stack N hybrid (RLE/bit-packed) streams of ``count`` values each.
+
+    ``counts`` gives per-page actual value counts when they differ (the usual
+    short tail page): shorter pages are padded to ``count`` with a synthetic
+    zero-value RLE run, and callers slice the decoded tail back to its real
+    length.  Host cost is O(total run headers); run tables are padded to the
+    batch max (power-of-two bucketed) so one executable serves all batches of
+    this shape.
+    """
+    per_page = list(counts) if counts is not None else [count] * len(raws)
+    if len(per_page) != len(raws):
+        raise ValueError(f"{len(per_page)} counts for {len(raws)} pages")
+    if any(c > count for c in per_page):
+        raise ValueError(f"page count exceeds batch count {count}")
+    metas = [
+        parse_hybrid_meta(r, width, c, pos=pos) for r, c in zip(raws, per_page)
+    ]
+    for m, c in zip(metas, per_page):
+        if c < count:  # pad: one RLE run of zeros fills the tail
+            # run_ends stays sorted: real runs end ≤ c, bucket padding == c,
+            # the synthetic run == count, so searchsorted routes tail slots here
+            m.run_ends = np.concatenate([m.run_ends, [count]]).astype(np.int64)
+            m.run_is_rle = np.concatenate([m.run_is_rle, [True]])
+            m.run_values = np.concatenate([m.run_values, [0]]).astype(np.uint32)
+            m.run_bit_starts = np.concatenate([m.run_bit_starts, [0]]).astype(np.int64)
+    r_max = max(m.run_ends.shape[0] for m in metas)
+    ends = np.full((len(metas), r_max), count, dtype=np.int64)
+    is_rle = np.zeros((len(metas), r_max), dtype=bool)
+    vals = np.zeros((len(metas), r_max), dtype=np.uint32)
+    starts = np.zeros((len(metas), r_max), dtype=np.int64)
+    for i, m in enumerate(metas):
+        r = m.run_ends.shape[0]
+        ends[i, :r] = m.run_ends
+        is_rle[i, :r] = m.run_is_rle
+        vals[i, :r] = m.run_values
+        starts[i, :r] = m.run_bit_starts
+    return PageBatch(
+        bufs=jnp.asarray(_stack_padded_bufs(raws)),
+        count=count,
+        width=width,
+        run_ends=jnp.asarray(ends),
+        run_is_rle=jnp.asarray(is_rle),
+        run_values=jnp.asarray(vals),
+        run_bit_starts=jnp.asarray(starts),
+    )
+
+
+def pack_delta_pages(raws: list[bytes], bits: int, count: int) -> PageBatch:
+    """Parse + stack N DELTA_BINARY_PACKED streams of ``count`` values each."""
+    metas = [parse_delta_meta(r, bits) for r in raws]
+    for m in metas:
+        if m.count != count:
+            raise ValueError(f"page holds {m.count} values, batch expects {count}")
+    m_max = max(m.mini_bit_starts.shape[0] for m in metas)
+    starts = np.zeros((len(metas), m_max), dtype=np.int64)
+    widths = np.zeros((len(metas), m_max), dtype=np.int32)
+    mins = np.zeros((len(metas), m_max), dtype=np.uint64)
+    firsts = np.zeros(len(metas), dtype=np.int64)
+    for i, m in enumerate(metas):
+        k = m.mini_bit_starts.shape[0]
+        starts[i, :k] = m.mini_bit_starts
+        widths[i, :k] = m.mini_widths
+        mins[i, :k] = m.mini_min_delta
+        firsts[i] = m.first_value
+    return PageBatch(
+        bufs=jnp.asarray(_stack_padded_bufs(raws)),
+        count=count,
+        first_values=jnp.asarray(firsts),
+        mini_bit_starts=jnp.asarray(starts),
+        mini_widths=jnp.asarray(widths),
+        mini_min_delta=jnp.asarray(mins),
+        values_per_mini=metas[0].values_per_mini,
+        max_width=max(1, *(int(m.mini_widths.max(initial=0)) for m in metas)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sharded decode steps (shard_map over the data axis)
+# ---------------------------------------------------------------------------
+
+def sharded_dict_decode(
+    batch: PageBatch, dict_u8: jax.Array, dtype: str, mesh: Mesh,
+    axis: str = "data", with_stats: bool = False,
+):
+    """Decode a batch of dictionary-index pages and gather values, sharded.
+
+    Pages shard across ``axis``; the dictionary replicates (it is per-chunk and
+    small — ≤ 32767 entries by the format's own fallback rule).  Returns the
+    decoded values [B, count, ...] with the same sharding, so a downstream pjit
+    consumes them without a host round-trip; XLA inserts any re-shard
+    collectives.  ``with_stats`` adds a psum/pmin/pmax over ICI — the global
+    column statistics every shard sees identically.
+    """
+    width, count = batch.width, batch.count
+
+    def shard_fn(bufs, ends, is_rle, vals, starts, d_u8):
+        idx = jax.vmap(
+            lambda b, e, r, v, s: K.expand_rle_hybrid(b, e, r, v, s, width, count)
+        )(bufs, ends, is_rle, vals, starts)
+        flat = K.dict_gather_bytes(d_u8, idx.reshape(-1), dtype)
+        out = flat.reshape(idx.shape + flat.shape[1:])
+        if not with_stats:
+            return out, jnp.zeros(3, dtype=jnp.int64)
+        stats = jnp.stack([
+            jax.lax.psum(jnp.int64(idx.size), axis),
+            jax.lax.pmin(jnp.min(idx).astype(jnp.int64), axis),
+            jax.lax.pmax(jnp.max(idx).astype(jnp.int64), axis),
+        ])
+        return out, stats
+
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis, None), P(axis, None),
+                  P(axis, None), P(None, None)),
+        out_specs=(P(axis, None), P()),
+        check_vma=False,
+    )
+    return fn(
+        batch.bufs, batch.run_ends, batch.run_is_rle, batch.run_values,
+        batch.run_bit_starts, dict_u8,
+    )
+
+
+def sharded_dict_decode_2d(
+    batch: PageBatch, dict_u8: jax.Array, dtype: str, mesh: Mesh,
+    data_axis: str = "data", model_axis: str = "model",
+):
+    """Dict decode on a 2-D mesh: pages shard over ``data``, the *dictionary*
+    shards over ``model`` — the expert-parallel-shaped variant for dictionaries
+    too large to replicate.
+
+    Each device gathers only the indices that fall in its dictionary shard
+    (masked local gather) and a psum over ``model`` assembles full values: the
+    index-routing pattern of MoE dispatch, with the reduction riding ICI.
+    Requires an integer ``dtype`` (psum assembles words exactly; float dicts
+    replicate via :func:`sharded_dict_decode` instead).
+    """
+    width, count = batch.width, batch.count
+    n_model = mesh.shape[model_axis]
+    k = int(dict_u8.shape[0])
+    shard_rows = (k + n_model - 1) // n_model
+    pad_rows = shard_rows * n_model - k
+    if pad_rows:
+        dict_u8 = jnp.concatenate(
+            [dict_u8, jnp.zeros((pad_rows, dict_u8.shape[1]), dtype=jnp.uint8)]
+        )
+
+    def shard_fn(bufs, ends, is_rle, vals, starts, d_u8_local):
+        m = jax.lax.axis_index(model_axis)
+        lo = m.astype(jnp.int64) * shard_rows
+        idx = jax.vmap(
+            lambda b, e, r, v, s: K.expand_rle_hybrid(b, e, r, v, s, width, count)
+        )(bufs, ends, is_rle, vals, starts)
+        flat = idx.reshape(-1).astype(jnp.int64)
+        local = flat - lo
+        mine = (local >= 0) & (local < shard_rows)
+        safe = jnp.clip(local, 0, shard_rows - 1).astype(jnp.int32)
+        gathered = K.dict_gather_bytes(d_u8_local, safe, dtype)
+        gathered = jnp.where(
+            mine.reshape(mine.shape + (1,) * (gathered.ndim - 1)),
+            gathered,
+            jnp.zeros((), dtype=gathered.dtype),
+        )
+        full = jax.lax.psum(gathered, model_axis)
+        return full.reshape(idx.shape + full.shape[1:])
+
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(data_axis, None), P(data_axis, None), P(data_axis, None),
+                  P(data_axis, None), P(data_axis, None), P(model_axis, None)),
+        out_specs=P(data_axis, None),
+        check_vma=False,
+    )
+    return fn(
+        batch.bufs, batch.run_ends, batch.run_is_rle, batch.run_values,
+        batch.run_bit_starts, dict_u8,
+    )
+
+
+def sharded_delta_decode(
+    batch: PageBatch, bits: int, mesh: Mesh, axis: str = "data",
+):
+    """Decode a batch of DELTA_BINARY_PACKED pages, sharded over the mesh."""
+    count = batch.count
+    vpm, mw = batch.values_per_mini, batch.max_width
+
+    def shard_fn(bufs, firsts, starts, widths, mins):
+        return jax.vmap(
+            lambda b, f, s, w, m: K.delta_reconstruct(
+                b, f, s, w, m, vpm, count, bits, mw
+            )
+        )(bufs, firsts, starts, widths, mins)
+
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(axis, None), P(axis, None),
+                  P(axis, None)),
+        out_specs=P(axis, None),
+        check_vma=False,
+    )
+    return fn(
+        batch.bufs, batch.first_values, batch.mini_bit_starts,
+        batch.mini_widths, batch.mini_min_delta,
+    )
+
+
+def sharded_plain_decode(
+    bufs: jax.Array, dtype: str, count: int, mesh: Mesh, axis: str = "data",
+):
+    """PLAIN fixed-width pages [B, S] → values [B, count], sharded bitcast."""
+
+    def shard_fn(b):
+        return jax.vmap(lambda x: K.plain_decode_fixed(x, dtype, count))(b)
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(axis, None),), out_specs=P(axis, None),
+        check_vma=False,
+    )
+    return fn(bufs)
+
+
+def column_stats(values: jax.Array, mesh: Mesh, axis: str = "data"):
+    """Global min/max/count over a sharded int column — one ICI reduction.
+
+    The device-side analog of the reference's write-side stats trackers
+    (stats.go): every shard computes local extrema, psum/pmin/pmax make them
+    global without gathering the data anywhere.
+    """
+
+    def shard_fn(v):
+        return jnp.stack([
+            jax.lax.psum(jnp.int64(v.size), axis),
+            jax.lax.pmin(jnp.min(v).astype(jnp.int64), axis),
+            jax.lax.pmax(jnp.max(v).astype(jnp.int64), axis),
+        ])
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(axis, None),), out_specs=P(),
+        check_vma=False,
+    )
+    return fn(values)
