@@ -102,20 +102,20 @@ def generate(path):
 
 def bench_device(path):
     import jax
-    from tpu_parquet.reader import FileReader
-    from tpu_parquet.jax_decode import read_chunk_device
+    from tpu_parquet.device_reader import DeviceFileReader
 
     def run():
-        r = FileReader(path)
-        leaves = {l.path: l for l in r.schema.leaves}
+        r = DeviceFileReader(path)
         outs = []
-        for rg in r.metadata.row_groups:
-            for chunk in rg.columns:
-                leaf = leaves[tuple(chunk.meta_data.path_in_schema)]
-                outs.append(read_chunk_device(r._f, chunk, leaf))
+        for cols in r.iter_row_groups():
+            outs.extend(cols.values())
         arrs = []
         for o in outs:
-            arrs.extend(a for a in (o.values, o.offsets, o.heap) if a is not None)
+            arrs.extend(
+                a for a in (o.values, o.offsets, o.heap,
+                            getattr(o, "indices", None))
+                if a is not None
+            )
         jax.block_until_ready(arrs)
         r.close()
 

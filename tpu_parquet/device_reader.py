@@ -1,0 +1,583 @@
+"""Batched device reader: one staged buffer + one fused dispatch per chunk.
+
+The page-at-a-time DeviceChunkDecoder (jax_decode.py) is correct but transfer-
+latency-bound: every page pays several host→device staging calls, and over a
+tunneled TPU each blocking transfer costs milliseconds regardless of size.
+This reader restructures the decode around the transfer economics
+(SURVEY.md §7.4.7 — pipelining beats any single kernel):
+
+- per chunk, ALL pages' decompressed value bytes are assembled into ONE host
+  buffer and staged with ONE async transfer;
+- per-page stream structure is folded into chunk-global metadata tables
+  (hybrid run tables with global bit offsets; per-page delta miniblock tables
+  stacked for vmap), so each column decodes with ONE fused XLA dispatch;
+- nothing blocks until ``finalize()``: staging and dispatches are async, the
+  deferred dictionary-index range checks sync once at the end;
+- dictionary string columns stay dictionary-encoded on device — (dict bytes,
+  indices) like an Arrow DictionaryArray — and materialize lazily, because the
+  gather output size is data-dependent and forcing it would sync per chunk.
+
+Encoding coverage matches DeviceChunkDecoder; byte-array value streams decode
+on host (inherently sequential, SURVEY.md §7.4.2/§7.4.4) and stage their
+(offsets, heap) result in two async transfers.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import jax_kernels as K
+from .chunk_decode import (
+    PageSlice, _check_crc, validate_chunk_meta, walk_pages,
+)
+from .column import ByteArrayData
+from .compress import decompress_block
+from .footer import ParquetError
+from .format import Encoding, PageType, Type
+from .jax_decode import (
+    DeviceColumnData, ParsedDataPage, _bucket, _SLACK,
+    _dict_gather_bytes_jit, _hybrid_jit, _plain_jit, _PTYPE_TO_NAME,
+    host_decode_dictionary, pad_buffer, parse_data_page,
+    parse_hybrid_meta, parse_delta_meta,
+)
+from .kernels import bitpack, rle
+from .schema.core import SchemaNode
+
+__all__ = ["DeviceFileReader", "decode_chunk_batched", "DeviceDictColumn"]
+
+
+@dataclass
+class DeviceDictColumn(DeviceColumnData):
+    """A dictionary-encoded device column: values stay as (dictionary, indices).
+
+    ``indices`` uint32[n_defined]; the dictionary is either fixed-width byte
+    rows (``dict_u8`` + ``dict_dtype``) or ragged (``dict_offsets``/``dict_heap``).
+    ``materialize()`` gathers on device (fixed-width) or host (ragged).
+    """
+
+    indices: Optional[jax.Array] = None
+    dict_u8: Optional[jax.Array] = None
+    dict_dtype: Optional[str] = None
+    dict_offsets: Optional[jax.Array] = None
+    dict_heap: Optional[jax.Array] = None
+
+    def materialize(self) -> DeviceColumnData:
+        if self.dict_u8 is not None:
+            vals = _dict_gather_jit(self.dict_u8, self.indices, dtype=self.dict_dtype)
+            return DeviceColumnData(
+                values=vals, def_levels=self.def_levels, rep_levels=self.rep_levels,
+                max_def=self.max_def, max_rep=self.max_rep,
+                num_leaf_slots=self.num_leaf_slots, value_dtype=self.value_dtype,
+            )
+        off = np.asarray(self.dict_offsets)
+        heap = np.asarray(self.dict_heap)
+        idx = np.asarray(self.indices, dtype=np.int64)
+        host = ByteArrayData(offsets=off, heap=heap).take(idx)
+        return DeviceColumnData(
+            offsets=jnp.asarray(host.offsets), heap=jnp.asarray(host.heap),
+            def_levels=self.def_levels, rep_levels=self.rep_levels,
+            max_def=self.max_def, max_rep=self.max_rep,
+            num_leaf_slots=self.num_leaf_slots,
+        )
+
+    def to_host(self):
+        off_or_none = self.dict_offsets
+        idx = np.asarray(self.indices, dtype=np.int64)
+        if self.dict_u8 is not None:
+            rows = np.asarray(self.dict_u8)
+            n, nb = rows.shape
+            if self.dict_dtype == "uint32":  # INT96
+                return rows.view("<u4").reshape(n, -1)[idx]
+            return rows[idx].copy().view(f"<{np.dtype(self.dict_dtype).str[1:]}").reshape(len(idx))
+        return ByteArrayData(
+            offsets=np.asarray(off_or_none), heap=np.asarray(self.dict_heap)
+        ).take(idx)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("values_per_mini", "count", "bits", "max_width")
+)
+def _delta_pages_jit(buf, firsts, starts, widths, mins, *, values_per_mini,
+                     count, bits, max_width):
+    return jax.vmap(
+        lambda f, s, w, m: K.delta_reconstruct(
+            buf, f, s, w, m, values_per_mini, count, bits, max_width
+        )
+    )(firsts, starts, widths, mins)
+
+
+@functools.partial(jax.jit, static_argnames=("count",))
+def _bool_pages_jit(buf, page_byte_base, page_val_start, *, count):
+    """PLAIN booleans across pages: bit position restarts at each page base."""
+    i = jnp.arange(count, dtype=jnp.int64)
+    p = jnp.searchsorted(page_val_start, i, side="right") - 1
+    p = jnp.clip(p, 0, page_val_start.shape[0] - 1)
+    bit_pos = page_byte_base[p] * 8 + (i - page_val_start[p])
+    return K.extract_bits(buf, bit_pos, 1, 1).astype(jnp.bool_)
+
+
+class _ChunkAssembler:
+    """Collects a chunk's pages, then emits one fused device decode."""
+
+    def __init__(self, leaf: SchemaNode, deferred_checks: list):
+        self.leaf = leaf
+        self.pages: list[_PageData] = []
+        self.dict_u8: Optional[np.ndarray] = None
+        self.dict_dtype: Optional[str] = None
+        self.dict_ragged: Optional[ByteArrayData] = None
+        self.dict_len = 0
+        self._deferred = deferred_checks  # (maxima_device_scalar, dict_len, path)
+
+    # -- dictionary ----------------------------------------------------------
+
+    def set_dictionary(self, raw: bytes, count: int) -> None:
+        from .kernels import plain as plain_host
+
+        decoded = plain_host.decode(
+            raw, self.leaf.physical_type, count, self.leaf.type_length
+        )
+        if isinstance(decoded, ByteArrayData):
+            self.dict_ragged = decoded
+            self.dict_len = len(decoded)
+        else:
+            arr = np.ascontiguousarray(decoded)
+            n = len(arr)
+            self.dict_len = n
+            row_bytes = (arr.nbytes // n) if n else arr.dtype.itemsize
+            self.dict_dtype = arr.dtype.name if arr.ndim == 1 else "uint32"
+            self.dict_u8 = (
+                arr.view(np.uint8).reshape(n, row_bytes)
+                if n else np.zeros((0, row_bytes), np.uint8)
+            )
+
+    # -- finish: fused decode -------------------------------------------------
+
+    def finish(self) -> DeviceColumnData:
+        leaf = self.leaf
+        slots = sum(p.num_values for p in self.pages)
+        encs = {Encoding(p.encoding) for p in self.pages}
+        encs = {
+            Encoding.RLE_DICTIONARY if e == Encoding.PLAIN_DICTIONARY else e
+            for e in encs
+        }
+        dlv = rlv = None
+        if leaf.max_def > 0:
+            dlv = jnp.asarray(np.concatenate([p.def_levels for p in self.pages]))
+        if leaf.max_rep > 0:
+            rlv = jnp.asarray(np.concatenate([p.rep_levels for p in self.pages]))
+
+        common = dict(
+            def_levels=dlv, rep_levels=rlv, max_def=leaf.max_def,
+            max_rep=leaf.max_rep, num_leaf_slots=slots,
+            value_dtype=(
+                "float64" if leaf.physical_type == Type.DOUBLE else None
+            ),
+        )
+
+        if len(encs) == 1:
+            enc = next(iter(encs))
+            if enc == Encoding.RLE_DICTIONARY:
+                return self._finish_dict(common)
+            if enc == Encoding.PLAIN and leaf.physical_type in _PTYPE_TO_NAME:
+                return self._finish_plain_fixed(common)
+            if enc == Encoding.PLAIN and leaf.physical_type == Type.BOOLEAN:
+                return self._finish_plain_bool(common)
+            if enc == Encoding.DELTA_BINARY_PACKED:
+                return self._finish_delta(common)
+        # everything else (byte arrays, BSS, INT96, boolean RLE, mixed
+        # encodings): host decode per page, stage once
+        return self._finish_host(common)
+
+    def _value_buffer(self) -> tuple[np.ndarray, np.ndarray]:
+        """Concatenate all pages' value streams; returns (buffer, byte_bases)."""
+        sizes = [len(p.raw) - p.value_pos for p in self.pages]
+        bases = np.zeros(len(sizes), dtype=np.int64)
+        total = 0
+        for i, s in enumerate(sizes):
+            bases[i] = total
+            total += s
+        buf = np.zeros(_bucket(total + _SLACK, 64), dtype=np.uint8)
+        for p, b, s in zip(self.pages, bases, sizes):
+            buf[b : b + s] = np.frombuffer(p.raw, np.uint8, s, p.value_pos)
+        return buf, bases
+
+    def _finish_plain_fixed(self, common) -> DeviceColumnData:
+        name = _PTYPE_TO_NAME[self.leaf.physical_type]
+        itemsize = np.dtype(name).itemsize
+        defined = sum(p.defined for p in self.pages)
+        for p in self.pages:
+            if len(p.raw) - p.value_pos < p.defined * itemsize:
+                raise ParquetError(
+                    f"PLAIN data truncated: {len(p.raw) - p.value_pos} "
+                    f"< {p.defined * itemsize}"
+                )
+        # copy exactly the value bytes back-to-back → one contiguous bitcast
+        total = defined * itemsize
+        buf = np.zeros(_bucket(total + _SLACK, 64), dtype=np.uint8)
+        pos = 0
+        for p in self.pages:
+            n = p.defined * itemsize
+            buf[pos : pos + n] = np.frombuffer(p.raw, np.uint8, n, p.value_pos)
+            pos += n
+        vals = _plain_contig_jit(
+            jnp.asarray(buf), jnp.int64(0), dtype=name, count=defined
+        )
+        return DeviceColumnData(values=vals, **common)
+
+    def _finish_plain_bool(self, common) -> DeviceColumnData:
+        defined = sum(p.defined for p in self.pages)
+        for p in self.pages:
+            need = (p.defined + 7) // 8
+            if len(p.raw) - p.value_pos < need:
+                raise ParquetError(
+                    f"PLAIN BOOLEAN truncated: {len(p.raw) - p.value_pos} < {need}"
+                )
+        buf, bases = self._value_buffer()
+        starts = np.zeros(len(self.pages), dtype=np.int64)
+        acc = 0
+        for i, p in enumerate(self.pages):
+            starts[i] = acc
+            acc += p.defined
+        vals = _bool_pages_jit(
+            jnp.asarray(buf), jnp.asarray(bases), jnp.asarray(starts),
+            count=defined,
+        )
+        return DeviceColumnData(values=vals, **common)
+
+    def _finish_dict(self, common) -> DeviceColumnData:
+        if self.dict_u8 is None and self.dict_ragged is None:
+            raise ParquetError("dictionary-encoded page but no dictionary page seen")
+        widths = set()
+        for p in self.pages:
+            stream = p.raw[p.value_pos :]
+            if len(stream) < 1:
+                raise ParquetError("dictionary page data truncated (missing width)")
+            if stream[0] > 32:
+                raise ParquetError(f"dictionary index width {stream[0]} invalid")
+            widths.add(stream[0])
+        if len(widths) > 1:
+            # spec-legal but rare: per-page index widths differ; page-at-a-time
+            return self._finish_host(common)
+        width = widths.pop()
+        buf, bases = self._value_buffer()
+        ends_l, rle_l, vals_l, starts_l = [], [], [], []
+        prefix = 0
+        for p, base in zip(self.pages, bases):
+            stream = p.raw[p.value_pos :]
+            meta = parse_hybrid_meta(stream, width, p.defined, pos=1)
+            n = meta.n_runs
+            ends_l.append(meta.run_ends[:n] + prefix)
+            rle_l.append(meta.run_is_rle[:n])
+            vals_l.append(meta.run_values[:n])
+            # global bit base: page byte base within buf, re-zeroed for the
+            # global value position (see jax_kernels.expand_rle_hybrid)
+            starts_l.append(
+                meta.run_bit_starts[:n] + base * 8 - prefix * width
+            )
+            prefix += p.defined
+        r = max(sum(len(e) for e in ends_l), 1)
+        rp = _bucket(r)
+        ends = np.full(rp, prefix, dtype=np.int64)
+        is_rle = np.zeros(rp, dtype=bool)
+        rvals = np.zeros(rp, dtype=np.uint32)
+        starts = np.zeros(rp, dtype=np.int64)
+        k = 0
+        for e, ir, v, s in zip(ends_l, rle_l, vals_l, starts_l):
+            ends[k : k + len(e)] = e
+            is_rle[k : k + len(e)] = ir
+            rvals[k : k + len(e)] = v
+            starts[k : k + len(e)] = s
+            k += len(e)
+        idx = _hybrid_global_jit(
+            jnp.asarray(buf), jnp.asarray(ends), jnp.asarray(is_rle),
+            jnp.asarray(rvals), jnp.asarray(starts), width=width, count=prefix,
+        )
+        if prefix and self.dict_len == 0:
+            raise ParquetError("dictionary indices with empty dictionary")
+        if prefix:
+            self._deferred.append(
+                (jnp.max(idx), self.dict_len, ".".join(self.leaf.path))
+            )
+        col = DeviceDictColumn(indices=idx, **common)
+        if self.dict_u8 is not None:
+            col.dict_u8 = jnp.asarray(self.dict_u8)
+            col.dict_dtype = self.dict_dtype
+        else:
+            col.dict_offsets = jnp.asarray(self.dict_ragged.offsets)
+            col.dict_heap = jnp.asarray(self.dict_ragged.heap)
+        return col
+
+    def _finish_delta(self, common) -> DeviceColumnData:
+        ptype = self.leaf.physical_type
+        if ptype not in (Type.INT32, Type.INT64):
+            raise ParquetError(f"DELTA_BINARY_PACKED invalid for {ptype!r}")
+        bits = 32 if ptype == Type.INT32 else 64
+        buf, bases = self._value_buffer()
+        metas = []
+        for p, base in zip(self.pages, bases):
+            m = parse_delta_meta(p.raw[p.value_pos :], bits)
+            if m.count < p.defined:
+                raise ParquetError(
+                    f"delta stream yielded {m.count} of {p.defined} values"
+                )
+            metas.append(m)
+        count = max(m.count for m in metas)
+        m_max = max(m.mini_bit_starts.shape[0] for m in metas)
+        starts = np.zeros((len(metas), m_max), dtype=np.int64)
+        widths = np.zeros((len(metas), m_max), dtype=np.int32)
+        mins = np.zeros((len(metas), m_max), dtype=np.uint64)
+        firsts = np.zeros(len(metas), dtype=np.int64)
+        for i, (m, base) in enumerate(zip(metas, bases)):
+            kk = m.mini_bit_starts.shape[0]
+            starts[i, :kk] = m.mini_bit_starts + base * 8
+            widths[i, :kk] = m.mini_widths
+            mins[i, :kk] = m.mini_min_delta
+            firsts[i] = m.first_value
+        vals = _delta_pages_jit(
+            jnp.asarray(buf), jnp.asarray(firsts), jnp.asarray(starts),
+            jnp.asarray(widths), jnp.asarray(mins),
+            values_per_mini=metas[0].values_per_mini, count=count, bits=bits,
+            max_width=max(1, int(widths.max(initial=0))),
+        )  # [P, count]
+        # slice each page's real extent and flatten
+        if all(m.count == count and p.defined == count
+               for m, p in zip(metas, self.pages)):
+            flat = vals.reshape(-1)
+        else:
+            flat = jnp.concatenate(
+                [vals[i, : p.defined] for i, p in enumerate(self.pages)]
+            )
+        return DeviceColumnData(values=flat, **common)
+
+    def _finish_host(self, common) -> DeviceColumnData:
+        """Host decode per page (byte arrays, INT96, BSS, boolean RLE, mixed)."""
+        from .jax_decode import DeviceChunkDecoder
+
+        helper = DeviceChunkDecoder(self.leaf)
+        helper.dict_u8 = (
+            jnp.asarray(self.dict_u8) if self.dict_u8 is not None else None
+        )
+        helper.dict_dtype = self.dict_dtype
+        helper.dict_len = self.dict_len
+        if self.dict_ragged is not None:
+            helper._dict_host_offsets = self.dict_ragged.offsets
+            helper.dict_offsets = jnp.asarray(self.dict_ragged.offsets)
+            helper.dict_heap = jnp.asarray(self.dict_ragged.heap)
+        vals_parts, off_parts, heap_parts = [], [], []
+        for p in self.pages:
+            v, off, heap = helper._decode_values_device(
+                p.encoding, p.raw, p.value_pos, p.defined
+            )
+            if v is not None:
+                vals_parts.append(v)
+            else:
+                off_parts.append(off)
+                heap_parts.append(heap)
+        for mx in helper._idx_maxima:
+            self._deferred.append((mx, self.dict_len, ".".join(self.leaf.path)))
+        out = DeviceColumnData(**common)
+        if off_parts:
+            if len(off_parts) == 1:
+                out.offsets, out.heap = off_parts[0], heap_parts[0]
+            else:
+                bases2 = np.cumsum([0] + [int(o[-1]) for o in off_parts[:-1]])
+                out.offsets = jnp.concatenate(
+                    [off_parts[0]]
+                    + [o[1:] + int(b) for o, b in zip(off_parts[1:], bases2[1:])]
+                )
+                out.heap = jnp.concatenate(heap_parts)
+        elif vals_parts:
+            out.values = (
+                vals_parts[0] if len(vals_parts) == 1 else jnp.concatenate(vals_parts)
+            )
+        else:
+            out.values = jnp.zeros(0, dtype=jnp.int64)
+        return out
+
+
+def decode_chunk_batched(
+    buf: bytes, codec: int, total_values: int, leaf: SchemaNode,
+    deferred_checks: list, validate_crc: bool = False,
+) -> DeviceColumnData:
+    """Decode one chunk with per-chunk fused dispatch (no blocking syncs)."""
+    asm = _ChunkAssembler(leaf, deferred_checks)
+    for ps in walk_pages(buf, total_values):
+        header = ps.header
+        pt = header.type
+        payload = buf[ps.payload_start : ps.payload_end]
+        if pt == PageType.DICTIONARY_PAGE:
+            _check_crc(header, payload, validate_crc)
+            raw = decompress_block(payload, codec, header.uncompressed_page_size)
+            dh = header.dictionary_page_header
+            enc = Encoding(dh.encoding)
+            if enc not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
+                raise ParquetError(
+                    f"dictionary page encoding {enc.name} unsupported"
+                )
+            asm.set_dictionary(raw, dh.num_values or 0)
+            continue
+        if pt == PageType.DATA_PAGE:
+            dh = header.data_page_header
+            _check_crc(header, payload, validate_crc)
+            raw = decompress_block(payload, codec, header.uncompressed_page_size)
+            num_values = dh.num_values or 0
+            if num_values < 0:
+                raise ParquetError(f"negative page value count {num_values}")
+            pos = 0
+            dlv = rlv = None
+            if leaf.max_rep > 0:
+                rlv, used = rle.decode_prefixed(
+                    raw[pos:], bitpack.bit_width(leaf.max_rep), num_values
+                )
+                pos += used
+            if leaf.max_def > 0:
+                dlv, used = rle.decode_prefixed(
+                    raw[pos:], bitpack.bit_width(leaf.max_def), num_values
+                )
+                pos += used
+            defined = (
+                int(np.count_nonzero(dlv == leaf.max_def))
+                if dlv is not None else num_values
+            )
+            asm.pages.append(_PageData(
+                raw=raw, value_pos=pos, num_values=num_values,
+                defined=defined, encoding=dh.encoding,
+                def_levels=dlv, rep_levels=rlv,
+            ))
+            continue
+        if pt == PageType.DATA_PAGE_V2:
+            dh = header.data_page_header_v2
+            _check_crc(header, payload, validate_crc)
+            num_values = dh.num_values or 0
+            if num_values < 0:
+                raise ParquetError(f"negative page value count {num_values}")
+            rep_len = dh.repetition_levels_byte_length or 0
+            def_len = dh.definition_levels_byte_length or 0
+            if rep_len < 0 or def_len < 0 or rep_len + def_len > len(payload):
+                raise ParquetError("v2 level lengths exceed page")
+            dlv = rlv = None
+            if leaf.max_rep > 0:
+                if rep_len == 0:
+                    raise ParquetError("v2 page missing repetition levels")
+                rlv = rle.decode(
+                    payload[:rep_len], bitpack.bit_width(leaf.max_rep), num_values
+                )
+            if leaf.max_def > 0:
+                dlv = rle.decode(
+                    payload[rep_len : rep_len + def_len],
+                    bitpack.bit_width(leaf.max_def), num_values,
+                )
+            if dh.num_nulls is not None and dlv is not None:
+                actual = int(np.count_nonzero(dlv != leaf.max_def))
+                if dh.num_nulls != actual and leaf.max_rep == 0:
+                    raise ParquetError(
+                        f"v2 page declares {dh.num_nulls} nulls, levels say {actual}"
+                    )
+            values_block = payload[rep_len + def_len :]
+            uncompressed = header.uncompressed_page_size - rep_len - def_len
+            if dh.is_compressed is None or dh.is_compressed:
+                raw = decompress_block(values_block, codec, uncompressed)
+            else:
+                raw = values_block
+            defined = (
+                int(np.count_nonzero(dlv == leaf.max_def))
+                if dlv is not None else num_values
+            )
+            asm.pages.append(_PageData(
+                raw=raw, value_pos=0, num_values=num_values,
+                defined=defined, encoding=dh.encoding,
+                def_levels=dlv, rep_levels=rlv,
+            ))
+            continue
+        # index/unknown pages: skip
+    if not asm.pages:
+        return DeviceColumnData(
+            values=jnp.zeros(0, dtype=jnp.int64),
+            max_def=leaf.max_def, max_rep=leaf.max_rep, num_leaf_slots=0,
+        )
+    return asm.finish()
+
+
+class DeviceFileReader:
+    """Columnar file reader decoding straight to device arrays.
+
+    The device twin of reader.FileReader: same options (projection, CRC), row
+    groups as the work unit, nothing blocks until ``finalize()`` (called by
+    ``read_row_group``; pass ``finalize=False`` to pipeline several row groups
+    and call it once).
+    """
+
+    def __init__(self, source, columns=None, validate_crc: bool = False):
+        from .reader import FileReader
+
+        self._host = FileReader(source, columns=columns, validate_crc=validate_crc)
+        self.metadata = self._host.metadata
+        self.schema = self._host.schema
+        self.validate_crc = validate_crc
+        self._deferred: list = []
+
+    def close(self):
+        self._host.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @property
+    def num_row_groups(self) -> int:
+        return self._host.num_row_groups
+
+    def read_row_group(self, index: int, finalize: bool = True):
+        rg = self.metadata.row_groups[index]
+        leaves = {l.path: l for l in self.schema.selected_leaves()}
+        out: dict[str, DeviceColumnData] = {}
+        f = self._host._f
+        for chunk in rg.columns or []:
+            md = chunk.meta_data
+            if md is None or md.path_in_schema is None:
+                raise ParquetError("column chunk missing metadata/path")
+            path = tuple(md.path_in_schema)
+            leaf = leaves.get(path)
+            if leaf is None:
+                continue
+            md, offset = validate_chunk_meta(chunk, leaf)
+            f.seek(offset)
+            buf = f.read(md.total_compressed_size)
+            if len(buf) != md.total_compressed_size:
+                raise ParquetError("chunk truncated")
+            out[".".join(path)] = decode_chunk_batched(
+                buf, md.codec, md.num_values, leaf, self._deferred,
+                validate_crc=self.validate_crc,
+            )
+        if finalize:
+            self.finalize()
+        return out
+
+    def finalize(self) -> None:
+        """Run deferred validity checks (one device sync for all chunks)."""
+        if not self._deferred:
+            return
+        maxima = jnp.stack([m for m, _, _ in self._deferred])
+        host_max = np.asarray(maxima)
+        for mx, dict_len, path in zip(host_max, (d for _, d, _ in self._deferred),
+                                      (p for _, _, p in self._deferred)):
+            if int(mx) >= dict_len:
+                raise ParquetError(
+                    f"dictionary index {int(mx)} out of range ({dict_len}) "
+                    f"in column {path}"
+                )
+        self._deferred = []
+
+    def iter_row_groups(self, finalize_each: bool = False):
+        for i in range(self.num_row_groups):
+            yield self.read_row_group(i, finalize=finalize_each)
+        self.finalize()

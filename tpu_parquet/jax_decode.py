@@ -84,6 +84,7 @@ class HybridMeta:
     run_bit_starts: np.ndarray  # int64[R] payload bit start minus start*width
     count: int
     consumed: int              # bytes consumed from the stream
+    n_runs: int = 0            # real (unpadded) run count
 
 
 def parse_hybrid_meta(
@@ -148,7 +149,10 @@ def parse_hybrid_meta(
         run_bit_starts[: len(ends)] = starts
     else:  # count == 0 never reaches here; defensive
         run_is_rle[0] = True
-    return HybridMeta(run_ends, run_is_rle, run_values, run_bit_starts, count, pos)
+    return HybridMeta(
+        run_ends, run_is_rle, run_values, run_bit_starts, count, pos,
+        n_runs=len(ends),
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("width", "count"))
@@ -282,6 +286,132 @@ _PTYPE_TO_NAME = {
 }
 
 
+@dataclass
+class ParsedDataPage:
+    """Host-parsed data page: decompressed bytes + levels + defined count.
+
+    The shared front half of both device decode paths (page-at-a-time
+    DeviceChunkDecoder and the batched device_reader): CRC, decompression,
+    host level decode, num_nulls validation.
+    """
+
+    raw: bytes            # decompressed page bytes (value stream at value_pos)
+    value_pos: int
+    num_values: int
+    defined: int
+    encoding: int
+    def_levels: Optional[np.ndarray] = None
+    rep_levels: Optional[np.ndarray] = None
+
+
+def parse_data_page(
+    ps: PageSlice, buf: bytes, codec: int, leaf: SchemaNode,
+    validate_crc: bool = False,
+) -> ParsedDataPage:
+    """Parse one v1/v2 data page on host (no device work).
+
+    Levels are metadata-sized and RLE-run dominated — host expansion is cheap,
+    yields the defined-count for free, and avoids a blocking device→host sync
+    per page that would serialize the page pipeline.  The device-side
+    *reconstruction* from levels (validity scatter, row starts) runs as prefix
+    scans in jax_kernels.
+    """
+    header = ps.header
+    payload = buf[ps.payload_start : ps.payload_end]
+    _check_crc(header, payload, validate_crc)
+    max_rep, max_def = leaf.max_rep, leaf.max_def
+    if header.type == PageType.DATA_PAGE:
+        dh = header.data_page_header
+        raw = decompress_block(payload, codec, header.uncompressed_page_size)
+        num_values = dh.num_values or 0
+        if num_values < 0:
+            raise ParquetError(f"negative page value count {num_values}")
+        pos = 0
+        rlv = dlv = None
+        if max_rep > 0:
+            rlv, used = rle.decode_prefixed(
+                raw[pos:], bitpack.bit_width(max_rep), num_values
+            )
+            pos += used
+        if max_def > 0:
+            dlv, used = rle.decode_prefixed(
+                raw[pos:], bitpack.bit_width(max_def), num_values
+            )
+            pos += used
+        defined = (
+            int(np.count_nonzero(dlv == max_def)) if dlv is not None else num_values
+        )
+        return ParsedDataPage(
+            raw=raw, value_pos=pos, num_values=num_values, defined=defined,
+            encoding=dh.encoding, def_levels=dlv, rep_levels=rlv,
+        )
+
+    dh = header.data_page_header_v2
+    num_values = dh.num_values or 0
+    if num_values < 0:
+        raise ParquetError(f"negative page value count {num_values}")
+    rep_len = dh.repetition_levels_byte_length or 0
+    def_len = dh.definition_levels_byte_length or 0
+    if rep_len < 0 or def_len < 0 or rep_len + def_len > len(payload):
+        raise ParquetError("v2 level lengths exceed page")
+    rlv = dlv = None
+    if max_rep > 0:
+        if rep_len == 0:
+            raise ParquetError("v2 page missing repetition levels")
+        rlv = rle.decode(payload[:rep_len], bitpack.bit_width(max_rep), num_values)
+    if max_def > 0:
+        dlv = rle.decode(
+            payload[rep_len : rep_len + def_len],
+            bitpack.bit_width(max_def), num_values,
+        )
+    if dh.num_nulls is not None and dlv is not None:
+        actual_nulls = int(np.count_nonzero(dlv != max_def))
+        if dh.num_nulls != actual_nulls and max_rep == 0:
+            raise ParquetError(
+                f"v2 page declares {dh.num_nulls} nulls, levels say {actual_nulls}"
+            )
+    values_block = payload[rep_len + def_len :]
+    uncompressed_values = header.uncompressed_page_size - rep_len - def_len
+    if dh.is_compressed is None or dh.is_compressed:
+        raw = decompress_block(values_block, codec, uncompressed_values)
+    else:
+        raw = values_block
+    defined = (
+        int(np.count_nonzero(dlv == max_def)) if dlv is not None else num_values
+    )
+    return ParsedDataPage(
+        raw=raw, value_pos=0, num_values=num_values, defined=defined,
+        encoding=dh.encoding, def_levels=dlv, rep_levels=rlv,
+    )
+
+
+def host_decode_dictionary(raw: bytes, leaf: SchemaNode, encoding: int, count: int):
+    """Decode a dictionary page's values on host.
+
+    Returns ByteArrayData for ragged dictionaries, else (u8_rows, dtype_name, n)
+    — the byte-row staging form dict_gather_bytes consumes.
+    """
+    from .kernels import plain as plain_host
+
+    enc = Encoding(encoding)
+    if enc not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
+        raise ParquetError(f"dictionary page encoding {enc.name} unsupported")
+    if count < 0:
+        raise ParquetError(f"negative dictionary size {count}")
+    decoded = plain_host.decode(raw, leaf.physical_type, count, leaf.type_length)
+    if isinstance(decoded, ByteArrayData):
+        return decoded
+    arr = np.ascontiguousarray(decoded)
+    n = len(arr)
+    row_bytes = (arr.nbytes // n) if n else arr.dtype.itemsize
+    base = arr.dtype.name if arr.ndim == 1 else "uint32"  # INT96: (n,3) u32
+    u8 = (
+        arr.view(np.uint8).reshape(n, row_bytes)
+        if n else np.zeros((0, row_bytes), dtype=np.uint8)
+    )
+    return u8, base, n
+
+
 # The value stream starts at a page-dependent byte offset inside the staged
 # page buffer; the offset is a *traced* scalar so one executable serves every
 # page of the same (dtype, count) geometry — no recompile, no re-staging.
@@ -376,36 +506,26 @@ class DeviceChunkDecoder:
     # -- dictionary ----------------------------------------------------------
 
     def _decode_dict_page(self, ps: PageSlice, buf: bytes, codec: int) -> None:
-        from .kernels import plain as plain_host
-
         header = ps.header
         payload = buf[ps.payload_start : ps.payload_end]
         _check_crc(header, payload, self.validate_crc)
         raw = decompress_block(payload, codec, header.uncompressed_page_size)
         dh = header.dictionary_page_header
-        enc = Encoding(dh.encoding)
-        if enc not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
-            raise ParquetError(f"dictionary page encoding {enc.name} unsupported")
-        count = dh.num_values or 0
-        decoded = plain_host.decode(raw, self.leaf.physical_type, count, self.leaf.type_length)
+        decoded = host_decode_dictionary(
+            raw, self.leaf, dh.encoding, dh.num_values or 0
+        )
         if isinstance(decoded, ByteArrayData):
             self._dict_host_offsets = decoded.offsets
             self.dict_offsets = jnp.asarray(decoded.offsets)
             self.dict_heap = jnp.asarray(decoded.heap)
             self.dict_len = len(decoded)
         else:
-            # stage as raw byte rows: gathers must move bits verbatim, and
-            # u8[...,k]→wide bitcasts are the only ones TPU's X64 pass supports
-            arr = np.ascontiguousarray(decoded)
-            n = len(arr)
-            self.dict_len = n
-            row_bytes = (arr.nbytes // n) if n else arr.dtype.itemsize
-            base = arr.dtype.name if arr.ndim == 1 else "uint32"  # INT96: (n,3) u32
-            u8 = arr.view(np.uint8).reshape(n, row_bytes) if n else np.zeros(
-                (0, row_bytes), dtype=np.uint8
-            )
+            # raw byte rows: gathers must move bits verbatim, and u8[...,k]→wide
+            # bitcasts are the only ones TPU's X64 pass supports
+            u8, base, n = decoded
             self.dict_u8 = jnp.asarray(u8)
             self.dict_dtype = base
+            self.dict_len = n
 
     # -- values --------------------------------------------------------------
 
@@ -549,93 +669,15 @@ class DeviceChunkDecoder:
 
     # -- pages ---------------------------------------------------------------
 
-    def _decode_data_page_v1(self, ps: PageSlice, buf: bytes, codec: int):
-        """Level streams decode on host; the value stream decodes on device.
-
-        Levels are metadata-sized and RLE-run dominated (all-defined columns are
-        one run) — host expansion is cheap, yields the defined-count for free,
-        and avoids a blocking device→host sync per page that would serialize the
-        page pipeline.  The device-side *reconstruction* from levels (validity
-        scatter, row starts) still runs as prefix scans in jax_kernels.
-        """
-        header = ps.header
-        dh = header.data_page_header
-        payload = buf[ps.payload_start : ps.payload_end]
-        _check_crc(header, payload, self.validate_crc)
-        raw = decompress_block(payload, codec, header.uncompressed_page_size)
-        num_values = dh.num_values or 0
-        if num_values < 0:
-            raise ParquetError(f"negative page value count {num_values}")
-        pos = 0
-        max_rep, max_def = self.leaf.max_rep, self.leaf.max_def
-        rlv_host = dlv_host = None
-        if max_rep > 0:
-            rlv_host, used = rle.decode_prefixed(
-                raw[pos:], bitpack.bit_width(max_rep), num_values
-            )
-            pos += used
-        if max_def > 0:
-            dlv_host, used = rle.decode_prefixed(
-                raw[pos:], bitpack.bit_width(max_def), num_values
-            )
-            pos += used
-        defined = (
-            int(np.count_nonzero(dlv_host == max_def))
-            if dlv_host is not None
-            else num_values
+    def _decode_data_page(self, ps: PageSlice, buf: bytes, codec: int):
+        """Shared host parse (parse_data_page) + device value decode."""
+        p = parse_data_page(ps, buf, codec, self.leaf, self.validate_crc)
+        v, off, heap = self._decode_values_device(
+            p.encoding, p.raw, p.value_pos, p.defined
         )
-        v, off, heap = self._decode_values_device(dh.encoding, raw, pos, defined)
-        dlv = jnp.asarray(dlv_host) if dlv_host is not None else None
-        rlv = jnp.asarray(rlv_host) if rlv_host is not None else None
-        return v, off, heap, dlv, rlv, num_values
-
-    def _decode_data_page_v2(self, ps: PageSlice, buf: bytes, codec: int):
-        header = ps.header
-        dh = header.data_page_header_v2
-        payload = buf[ps.payload_start : ps.payload_end]
-        _check_crc(header, payload, self.validate_crc)
-        num_values = dh.num_values or 0
-        if num_values < 0:
-            raise ParquetError(f"negative page value count {num_values}")
-        rep_len = dh.repetition_levels_byte_length or 0
-        def_len = dh.definition_levels_byte_length or 0
-        if rep_len < 0 or def_len < 0 or rep_len + def_len > len(payload):
-            raise ParquetError("v2 level lengths exceed page")
-        max_rep, max_def = self.leaf.max_rep, self.leaf.max_def
-        rlv_host = dlv_host = None
-        if max_rep > 0:
-            if rep_len == 0:
-                raise ParquetError("v2 page missing repetition levels")
-            rlv_host = rle.decode(
-                payload[:rep_len], bitpack.bit_width(max_rep), num_values
-            )
-        if max_def > 0:
-            dlv_host = rle.decode(
-                payload[rep_len : rep_len + def_len],
-                bitpack.bit_width(max_def),
-                num_values,
-            )
-        if dh.num_nulls is not None and dlv_host is not None:
-            actual_nulls = int(np.count_nonzero(dlv_host != max_def))
-            if dh.num_nulls != actual_nulls and max_rep == 0:
-                raise ParquetError(
-                    f"v2 page declares {dh.num_nulls} nulls, levels say {actual_nulls}"
-                )
-        values_block = payload[rep_len + def_len :]
-        uncompressed_values = header.uncompressed_page_size - rep_len - def_len
-        if dh.is_compressed is None or dh.is_compressed:
-            raw = decompress_block(values_block, codec, uncompressed_values)
-        else:
-            raw = values_block
-        defined = (
-            int(np.count_nonzero(dlv_host == max_def))
-            if dlv_host is not None
-            else num_values
-        )
-        v, off, heap = self._decode_values_device(dh.encoding, raw, 0, defined)
-        dlv = jnp.asarray(dlv_host) if dlv_host is not None else None
-        rlv = jnp.asarray(rlv_host) if rlv_host is not None else None
-        return v, off, heap, dlv, rlv, num_values
+        dlv = jnp.asarray(p.def_levels) if p.def_levels is not None else None
+        rlv = jnp.asarray(p.rep_levels) if p.rep_levels is not None else None
+        return v, off, heap, dlv, rlv, p.num_values
 
     # -- chunk ---------------------------------------------------------------
 
@@ -650,10 +692,8 @@ class DeviceChunkDecoder:
             if pt == PageType.DICTIONARY_PAGE:
                 self._decode_dict_page(ps, buf, codec)
                 continue
-            if pt == PageType.DATA_PAGE:
-                v, off, heap, d, r, n = self._decode_data_page_v1(ps, buf, codec)
-            elif pt == PageType.DATA_PAGE_V2:
-                v, off, heap, d, r, n = self._decode_data_page_v2(ps, buf, codec)
+            if pt in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+                v, off, heap, d, r, n = self._decode_data_page(ps, buf, codec)
             else:
                 continue
             slots += n
