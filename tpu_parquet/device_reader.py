@@ -25,7 +25,7 @@ on host (inherently sequential, SURVEY.md §7.4.2/§7.4.4) and stage their
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
@@ -34,9 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from . import jax_kernels as K
-from .chunk_decode import (
-    PageSlice, _check_crc, validate_chunk_meta, walk_pages,
-)
+from .chunk_decode import _check_crc, validate_chunk_meta, walk_pages
 from .column import ByteArrayData
 from .compress import decompress_block
 from .footer import ParquetError
@@ -44,10 +42,8 @@ from .format import Encoding, PageType, Type
 from .jax_decode import (
     DeviceColumnData, ParsedDataPage, _bucket, _SLACK,
     _dict_gather_bytes_jit, _hybrid_jit, _plain_jit, _PTYPE_TO_NAME,
-    host_decode_dictionary, pad_buffer, parse_data_page,
-    parse_hybrid_meta, parse_delta_meta,
+    parse_data_page, parse_hybrid_meta, parse_delta_meta,
 )
-from .kernels import bitpack, rle
 from .schema.core import SchemaNode
 
 __all__ = ["DeviceFileReader", "decode_chunk_batched", "DeviceDictColumn"]
@@ -70,7 +66,7 @@ class DeviceDictColumn(DeviceColumnData):
 
     def materialize(self) -> DeviceColumnData:
         if self.dict_u8 is not None:
-            vals = _dict_gather_jit(self.dict_u8, self.indices, dtype=self.dict_dtype)
+            vals = _dict_gather_bytes_jit(self.dict_u8, self.indices, dtype=self.dict_dtype)
             return DeviceColumnData(
                 values=vals, def_levels=self.def_levels, rep_levels=self.rep_levels,
                 max_def=self.max_def, max_rep=self.max_rep,
@@ -128,7 +124,7 @@ class _ChunkAssembler:
 
     def __init__(self, leaf: SchemaNode, deferred_checks: list):
         self.leaf = leaf
-        self.pages: list[_PageData] = []
+        self.pages: list[ParsedDataPage] = []
         self.dict_u8: Optional[np.ndarray] = None
         self.dict_dtype: Optional[str] = None
         self.dict_ragged: Optional[ByteArrayData] = None
@@ -226,7 +222,7 @@ class _ChunkAssembler:
             n = p.defined * itemsize
             buf[pos : pos + n] = np.frombuffer(p.raw, np.uint8, n, p.value_pos)
             pos += n
-        vals = _plain_contig_jit(
+        vals = _plain_jit(
             jnp.asarray(buf), jnp.int64(0), dtype=name, count=defined
         )
         return DeviceColumnData(values=vals, **common)
@@ -295,7 +291,7 @@ class _ChunkAssembler:
             rvals[k : k + len(e)] = v
             starts[k : k + len(e)] = s
             k += len(e)
-        idx = _hybrid_global_jit(
+        idx = _hybrid_jit(
             jnp.asarray(buf), jnp.asarray(ends), jnp.asarray(is_rle),
             jnp.asarray(rvals), jnp.asarray(starts), width=width, count=prefix,
         )
@@ -411,8 +407,8 @@ def decode_chunk_batched(
     for ps in walk_pages(buf, total_values):
         header = ps.header
         pt = header.type
-        payload = buf[ps.payload_start : ps.payload_end]
         if pt == PageType.DICTIONARY_PAGE:
+            payload = buf[ps.payload_start : ps.payload_end]
             _check_crc(header, payload, validate_crc)
             raw = decompress_block(payload, codec, header.uncompressed_page_size)
             dh = header.dictionary_page_header
@@ -423,78 +419,10 @@ def decode_chunk_batched(
                 )
             asm.set_dictionary(raw, dh.num_values or 0)
             continue
-        if pt == PageType.DATA_PAGE:
-            dh = header.data_page_header
-            _check_crc(header, payload, validate_crc)
-            raw = decompress_block(payload, codec, header.uncompressed_page_size)
-            num_values = dh.num_values or 0
-            if num_values < 0:
-                raise ParquetError(f"negative page value count {num_values}")
-            pos = 0
-            dlv = rlv = None
-            if leaf.max_rep > 0:
-                rlv, used = rle.decode_prefixed(
-                    raw[pos:], bitpack.bit_width(leaf.max_rep), num_values
-                )
-                pos += used
-            if leaf.max_def > 0:
-                dlv, used = rle.decode_prefixed(
-                    raw[pos:], bitpack.bit_width(leaf.max_def), num_values
-                )
-                pos += used
-            defined = (
-                int(np.count_nonzero(dlv == leaf.max_def))
-                if dlv is not None else num_values
+        if pt in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+            asm.pages.append(
+                parse_data_page(ps, buf, codec, leaf, validate_crc=validate_crc)
             )
-            asm.pages.append(_PageData(
-                raw=raw, value_pos=pos, num_values=num_values,
-                defined=defined, encoding=dh.encoding,
-                def_levels=dlv, rep_levels=rlv,
-            ))
-            continue
-        if pt == PageType.DATA_PAGE_V2:
-            dh = header.data_page_header_v2
-            _check_crc(header, payload, validate_crc)
-            num_values = dh.num_values or 0
-            if num_values < 0:
-                raise ParquetError(f"negative page value count {num_values}")
-            rep_len = dh.repetition_levels_byte_length or 0
-            def_len = dh.definition_levels_byte_length or 0
-            if rep_len < 0 or def_len < 0 or rep_len + def_len > len(payload):
-                raise ParquetError("v2 level lengths exceed page")
-            dlv = rlv = None
-            if leaf.max_rep > 0:
-                if rep_len == 0:
-                    raise ParquetError("v2 page missing repetition levels")
-                rlv = rle.decode(
-                    payload[:rep_len], bitpack.bit_width(leaf.max_rep), num_values
-                )
-            if leaf.max_def > 0:
-                dlv = rle.decode(
-                    payload[rep_len : rep_len + def_len],
-                    bitpack.bit_width(leaf.max_def), num_values,
-                )
-            if dh.num_nulls is not None and dlv is not None:
-                actual = int(np.count_nonzero(dlv != leaf.max_def))
-                if dh.num_nulls != actual and leaf.max_rep == 0:
-                    raise ParquetError(
-                        f"v2 page declares {dh.num_nulls} nulls, levels say {actual}"
-                    )
-            values_block = payload[rep_len + def_len :]
-            uncompressed = header.uncompressed_page_size - rep_len - def_len
-            if dh.is_compressed is None or dh.is_compressed:
-                raw = decompress_block(values_block, codec, uncompressed)
-            else:
-                raw = values_block
-            defined = (
-                int(np.count_nonzero(dlv == leaf.max_def))
-                if dlv is not None else num_values
-            )
-            asm.pages.append(_PageData(
-                raw=raw, value_pos=0, num_values=num_values,
-                defined=defined, encoding=dh.encoding,
-                def_levels=dlv, rep_levels=rlv,
-            ))
             continue
         # index/unknown pages: skip
     if not asm.pages:
