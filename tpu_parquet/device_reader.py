@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from . import jax_kernels as K
+from .jax_kernels import scoped_x64
 from .chunk_decode import _check_crc, validate_chunk_meta, walk_pages
 from .column import ByteArrayData
 from .compress import decompress_block
@@ -42,7 +43,7 @@ from .format import Encoding, PageType, Type
 from .jax_decode import (
     DeviceColumnData, ParsedDataPage, _bucket, _SLACK,
     _dict_gather_bytes_jit, _hybrid_jit, _plain_jit, _PTYPE_TO_NAME,
-    parse_data_page, parse_hybrid_meta, parse_delta_meta,
+    host_decode_dictionary, parse_data_page, parse_hybrid_meta, parse_delta_meta,
 )
 from .schema.core import SchemaNode
 
@@ -64,6 +65,7 @@ class DeviceDictColumn(DeviceColumnData):
     dict_offsets: Optional[jax.Array] = None
     dict_heap: Optional[jax.Array] = None
 
+    @scoped_x64
     def materialize(self) -> DeviceColumnData:
         if self.dict_u8 is not None:
             vals = _dict_gather_bytes_jit(self.dict_u8, self.indices, dtype=self.dict_dtype)
@@ -133,28 +135,18 @@ class _ChunkAssembler:
 
     # -- dictionary ----------------------------------------------------------
 
-    def set_dictionary(self, raw: bytes, count: int) -> None:
-        from .kernels import plain as plain_host
-
-        decoded = plain_host.decode(
-            raw, self.leaf.physical_type, count, self.leaf.type_length
-        )
+    @scoped_x64
+    def set_dictionary(self, raw: bytes, encoding: int, count: int) -> None:
+        decoded = host_decode_dictionary(raw, self.leaf, encoding, count)
         if isinstance(decoded, ByteArrayData):
             self.dict_ragged = decoded
             self.dict_len = len(decoded)
         else:
-            arr = np.ascontiguousarray(decoded)
-            n = len(arr)
-            self.dict_len = n
-            row_bytes = (arr.nbytes // n) if n else arr.dtype.itemsize
-            self.dict_dtype = arr.dtype.name if arr.ndim == 1 else "uint32"
-            self.dict_u8 = (
-                arr.view(np.uint8).reshape(n, row_bytes)
-                if n else np.zeros((0, row_bytes), np.uint8)
-            )
+            self.dict_u8, self.dict_dtype, self.dict_len = decoded
 
     # -- finish: fused decode -------------------------------------------------
 
+    @scoped_x64
     def finish(self) -> DeviceColumnData:
         leaf = self.leaf
         slots = sum(p.num_values for p in self.pages)
@@ -398,6 +390,7 @@ class _ChunkAssembler:
         return out
 
 
+@scoped_x64
 def decode_chunk_batched(
     buf: bytes, codec: int, total_values: int, leaf: SchemaNode,
     deferred_checks: list, validate_crc: bool = False,
@@ -412,12 +405,7 @@ def decode_chunk_batched(
             _check_crc(header, payload, validate_crc)
             raw = decompress_block(payload, codec, header.uncompressed_page_size)
             dh = header.dictionary_page_header
-            enc = Encoding(dh.encoding)
-            if enc not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
-                raise ParquetError(
-                    f"dictionary page encoding {enc.name} unsupported"
-                )
-            asm.set_dictionary(raw, dh.num_values or 0)
+            asm.set_dictionary(raw, dh.encoding, dh.num_values or 0)
             continue
         if pt in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
             asm.pages.append(
@@ -464,6 +452,7 @@ class DeviceFileReader:
     def num_row_groups(self) -> int:
         return self._host.num_row_groups
 
+    @scoped_x64
     def read_row_group(self, index: int, finalize: bool = True):
         rg = self.metadata.row_groups[index]
         leaves = {l.path: l for l in self.schema.selected_leaves()}
@@ -490,6 +479,7 @@ class DeviceFileReader:
             self.finalize()
         return out
 
+    @scoped_x64
     def finalize(self) -> None:
         """Run deferred validity checks (one device sync for all chunks)."""
         if not self._deferred:

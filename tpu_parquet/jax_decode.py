@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from . import jax_kernels as K
+from .jax_kernels import scoped_x64
 from .column import ByteArrayData
 from .compress import decompress_block
 from .footer import ParquetError
@@ -162,6 +163,7 @@ def _hybrid_jit(buf, run_ends, run_is_rle, run_values, run_bit_starts, *, width,
     )
 
 
+@scoped_x64
 def decode_hybrid_device(buf_dev: jax.Array, meta: HybridMeta, width: int) -> jax.Array:
     return _hybrid_jit(
         buf_dev,
@@ -260,6 +262,7 @@ def _delta_jit(
     )
 
 
+@scoped_x64
 def decode_delta_device(buf_dev: jax.Array, meta: DeltaMeta, bits: int) -> jax.Array:
     return _delta_jit(
         buf_dev,
@@ -681,6 +684,7 @@ class DeviceChunkDecoder:
 
     # -- chunk ---------------------------------------------------------------
 
+    @scoped_x64
     def decode(self, buf: bytes, codec: int, total_values: int) -> DeviceColumnData:
         pages = walk_pages(buf, total_values)
         vals_parts, off_parts, heap_parts = [], [], []
@@ -749,6 +753,7 @@ class DeviceChunkDecoder:
         return out
 
 
+@scoped_x64
 def read_chunk_device(
     f, chunk, leaf: SchemaNode, validate_crc: bool = False
 ) -> DeviceColumnData:

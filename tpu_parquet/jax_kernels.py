@@ -18,29 +18,47 @@ value-at-a-time run loops (hybrid_decoder.go:81-113) with gathers the VPU execut
 All functions here are jit-compatible with static output shapes: ``count`` and
 padded run-table sizes are Python ints at trace time, so XLA sees fixed shapes and
 the per-(page-geometry) executable is cached.  int64 work uses 32-bit lane pairs
-where possible; full-width paths need ``jax.config.update("jax_enable_x64", True)``
-which this module applies on import (the framework is a data tool — 64-bit values
-are not optional).
+where possible; full-width paths need 64-bit lanes, which every public entry
+point enables for the duration of the call via ``scoped_x64`` (the global
+``jax_enable_x64`` setting of the importing application is never modified).
 """
 
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 
-# The device decode path needs 64-bit lanes (INT64 columns, byte offsets).
-# Importing this module (not the base package) enables x64 process-wide — a
-# deliberate, documented side effect on co-resident JAX code (dtype promotion
-# changes, jit caches invalidate).  Applications that must keep x32 semantics
-# can set TPU_PARQUET_NO_X64=1 and manage jax_enable_x64 themselves; INT64 and
-# DELTA 64 decoding raise under x32.
-if not os.environ.get("TPU_PARQUET_NO_X64"):
-    jax.config.update("jax_enable_x64", True)
+# The device decode path needs 64-bit lanes (INT64 columns, byte offsets), but
+# flipping ``jax_enable_x64`` process-wide at import time would change dtype
+# semantics for any co-resident JAX program (a training pipeline importing this
+# library).  Instead every public kernel and reader entry point is wrapped in
+# ``scoped_x64`` below, which enters ``jax.enable_x64()`` only for the duration
+# of the call: traces happen with 64-bit lanes on, returned arrays keep their
+# 64-bit dtypes, and the caller's global x64 setting is never touched.
+
+
+def scoped_x64(fn):
+    """Run ``fn`` with ``jax_enable_x64`` active, without touching global state.
+
+    Applied to every public device-path entry point so that jit traces see
+    64-bit dtypes while the importing application keeps its own x64 setting
+    (the reference's int64 columns are not optional — hybrid_decoder.go,
+    deltabp_decoder.go:176-333 are 64-bit paths).  Re-entrant: nesting under an
+    already-active context (an outer decorated caller) is a cheap no-op flip.
+    """
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with jax.enable_x64():
+            return fn(*args, **kwargs)
+
+    return wrapper
+
 
 __all__ = [
+    "scoped_x64",
     "extract_bits",
     "unpack_bits",
     "expand_rle_hybrid",
@@ -60,6 +78,7 @@ __all__ = [
 # Bit extraction primitive
 # ---------------------------------------------------------------------------
 
+@scoped_x64
 def extract_bits(buf: jax.Array, bit_pos: jax.Array, width: jax.Array, max_width: int):
     """Extract unsigned bit fields from an LSB-first byte stream.
 
@@ -122,6 +141,7 @@ def extract_bits(buf: jax.Array, bit_pos: jax.Array, width: jax.Array, max_width
     return out & mask
 
 
+@scoped_x64
 def unpack_bits(buf: jax.Array, width: int, count: int):
     """Device twin of kernels.bitpack.unpack: fixed-width LSB-first unpack."""
     if width == 0:
@@ -135,6 +155,7 @@ def unpack_bits(buf: jax.Array, width: int, count: int):
 # RLE / bit-packed hybrid expansion
 # ---------------------------------------------------------------------------
 
+@scoped_x64
 def expand_rle_hybrid(
     buf: jax.Array,
     run_ends: jax.Array,
@@ -179,6 +200,7 @@ def expand_rle_hybrid(
 # DELTA_BINARY_PACKED reconstruction
 # ---------------------------------------------------------------------------
 
+@scoped_x64
 def delta_reconstruct(
     buf: jax.Array,
     first_value: jax.Array,
@@ -231,6 +253,7 @@ def delta_reconstruct(
 # Dictionary / ragged gathers
 # ---------------------------------------------------------------------------
 
+@scoped_x64
 def dict_gather(dictionary: jax.Array, indices: jax.Array):
     """Fixed-width dictionary expansion (type_dict.go:10-60 read path).
 
@@ -242,6 +265,7 @@ def dict_gather(dictionary: jax.Array, indices: jax.Array):
     return jnp.take(dictionary, indices.astype(jnp.int32), axis=0)
 
 
+@scoped_x64
 def dict_gather_bytes(dict_u8_rows: jax.Array, indices: jax.Array, dtype: str):
     """Gather dictionary rows as raw bytes, then bitcast into ``dtype``.
 
@@ -267,6 +291,7 @@ def dict_gather_bytes(dict_u8_rows: jax.Array, indices: jax.Array, dtype: str):
     ).reshape(n, total // itemsize)
 
 
+@scoped_x64
 def ragged_take(
     offsets: jax.Array, heap: jax.Array, indices: jax.Array, out_heap_size: int
 ):
@@ -295,11 +320,13 @@ def ragged_take(
 # Dremel level reconstruction (prefix scans)
 # ---------------------------------------------------------------------------
 
+@scoped_x64
 def levels_to_validity(def_levels: jax.Array, max_def: int):
     """validity[i] = slot i holds a real leaf value (def == max_def)."""
     return def_levels == max_def
 
 
+@scoped_x64
 def scatter_defined(values: jax.Array, validity: jax.Array, fill):
     """Expand dense defined values to one-per-slot with ``fill`` at null slots.
 
@@ -320,6 +347,7 @@ def scatter_defined(values: jax.Array, validity: jax.Array, fill):
     )
 
 
+@scoped_x64
 def row_starts_from_rep(rep_levels: jax.Array):
     """Row-boundary mask from repetition levels: a slot with rep==0 starts a row.
 
@@ -344,6 +372,7 @@ _PLAIN_DTYPES = {
 }
 
 
+@scoped_x64
 def plain_decode_fixed(buf: jax.Array, dtype: str, count: int):
     """PLAIN decode of a fixed-width type: reshape + bitcast, zero compute.
 
@@ -364,6 +393,7 @@ def plain_decode_fixed(buf: jax.Array, dtype: str, count: int):
     return jax.lax.bitcast_convert_type(raw, dt).reshape(count)
 
 
+@scoped_x64
 def byte_stream_split_decode(buf: jax.Array, dtype: str, count: int):
     """BYTE_STREAM_SPLIT: de-interleave K byte streams then bitcast.
 

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import jax_kernels as K
+from ..jax_kernels import scoped_x64
 from ..jax_decode import HybridMeta, DeltaMeta, parse_hybrid_meta, parse_delta_meta, _bucket, _SLACK
 
 __all__ = [
@@ -128,6 +129,7 @@ def _stack_padded_bufs(raws: list[bytes]) -> np.ndarray:
     return out
 
 
+@scoped_x64
 def pack_hybrid_pages(
     raws: list[bytes],
     width: int,
@@ -182,6 +184,7 @@ def pack_hybrid_pages(
     )
 
 
+@scoped_x64
 def pack_delta_pages(raws: list[bytes], bits: int, count: int) -> PageBatch:
     """Parse + stack N DELTA_BINARY_PACKED streams of ``count`` values each."""
     metas = [parse_delta_meta(r, bits) for r in raws]
@@ -215,6 +218,7 @@ def pack_delta_pages(raws: list[bytes], bits: int, count: int) -> PageBatch:
 # Sharded decode steps (shard_map over the data axis)
 # ---------------------------------------------------------------------------
 
+@scoped_x64
 def sharded_dict_decode(
     batch: PageBatch, dict_u8: jax.Array, dtype: str, mesh: Mesh,
     axis: str = "data", with_stats: bool = False,
@@ -259,6 +263,7 @@ def sharded_dict_decode(
     )
 
 
+@scoped_x64
 def sharded_dict_decode_2d(
     batch: PageBatch, dict_u8: jax.Array, dtype: str, mesh: Mesh,
     data_axis: str = "data", model_axis: str = "model",
@@ -316,6 +321,7 @@ def sharded_dict_decode_2d(
     )
 
 
+@scoped_x64
 def sharded_delta_decode(
     batch: PageBatch, bits: int, mesh: Mesh, axis: str = "data",
 ):
@@ -344,6 +350,7 @@ def sharded_delta_decode(
     )
 
 
+@scoped_x64
 def sharded_plain_decode(
     bufs: jax.Array, dtype: str, count: int, mesh: Mesh, axis: str = "data",
 ):
@@ -359,6 +366,7 @@ def sharded_plain_decode(
     return fn(bufs)
 
 
+@scoped_x64
 def column_stats(values: jax.Array, mesh: Mesh, axis: str = "data"):
     """Global min/max/count over a sharded int column — one ICI reduction.
 
