@@ -207,12 +207,41 @@ def test_batched_reader_column_projection():
     assert set(cols) == {"id", "name"}
 
 
-def test_batched_reader_corrupt_dict_index_deferred():
-    """The deferred finalize() check catches corrupt indices end-to-end."""
+def test_batched_reader_corrupt_dict_index_host_check():
+    """Out-of-range dictionary indices are rejected at decode time.
+
+    With the native header walk, the stream max is computed on host during
+    parse (meta_parse.cpp want_max) and the error raises eagerly — the decode
+    path needs zero device→host syncs.
+    """
     from tpu_parquet.footer import ParquetError
     from tests.test_jax_decode import _craft_dict_chunk
     from tpu_parquet.device_reader import decode_chunk_batched
 
+    schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
+    leaf = schema.leaves[0]
+    buf, codec = _craft_dict_chunk([1, 9, 2], np.arange(4))
+    deferred = []
+    with pytest.raises(ParquetError, match="out of range"):
+        decode_chunk_batched(buf, codec, 3, leaf, deferred)
+        # pure-Python walk defers to device: drain the check like finalize()
+        for mx, dict_len, path in deferred:
+            if int(np.asarray(mx)) >= dict_len:
+                raise ParquetError(
+                    f"dictionary index {int(np.asarray(mx))} out of range "
+                    f"({dict_len}) in column {path}"
+                )
+
+
+def test_batched_reader_corrupt_dict_index_deferred_fallback(monkeypatch):
+    """Without the native library, the deferred finalize() check still catches
+    corrupt indices (the no-toolchain fallback path)."""
+    from tpu_parquet.footer import ParquetError
+    from tpu_parquet import native
+    from tests.test_jax_decode import _craft_dict_chunk
+    from tpu_parquet.device_reader import decode_chunk_batched
+
+    monkeypatch.setattr(native, "hybrid_meta", lambda *a, **k: None)
     schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
     leaf = schema.leaves[0]
     buf, codec = _craft_dict_chunk([1, 9, 2], np.arange(4))

@@ -295,3 +295,40 @@ def test_scoped_x64_leaves_global_setting_alone():
     # the global flag is still off, and new arrays still get x32 semantics
     assert not jax.config.jax_enable_x64
     assert jnp.asarray(np.int64(1)).dtype == jnp.int32
+
+
+# ---------------------------------------------------------------------------
+# native meta-parser hostile-input regressions (meta_parse.cpp)
+# ---------------------------------------------------------------------------
+
+def _varint(v):
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out += bytes([b | (0x80 if v else 0)])
+        if not v:
+            return out
+
+
+def test_delta_meta_huge_block_size_rejected():
+    """block_size=2^63 once segfaulted the C walk via i64 truncation; both
+    walks must reject it as a DeltaError (decompression-bomb guard)."""
+    evil = (_varint(1 << 63) + _varint(1) + _varint(100) + _varint(0)
+            + _varint(0) + bytes(16))
+    for fn in (lambda b: jd._native_delta_meta(b, 0),
+               lambda b: jd._parse_delta_meta_py(b, 64, 0)):
+        with pytest.raises(jd.DeltaError):
+            fn(evil)
+
+
+def test_hybrid_meta_width0_huge_groups_parity():
+    """width-0 bit-packed run with groups=2^61: (i64)(groups*8) once
+    truncated to 0 and stalled the C walk where Python accepted the run."""
+    evil = _varint((1 << 61 << 1) | 1)
+    a = jd._native_hybrid_meta(evil, len(evil), 0, 0, 5, False)
+    b = jd._parse_hybrid_meta_py(evil, 0, 5, 0, len(evil))
+    if a is None:
+        pytest.skip("native library unavailable")
+    assert a.n_runs == b.n_runs and a.consumed == b.consumed
+    np.testing.assert_array_equal(a.run_ends, b.run_ends)

@@ -42,7 +42,8 @@ from .footer import ParquetError
 from .format import Encoding, PageType, Type
 from .jax_decode import (
     DeviceColumnData, ParsedDataPage, _bucket, _SLACK,
-    _dict_gather_bytes_jit, _hybrid_jit, _plain_jit, _PTYPE_TO_NAME,
+    _concat_jit, _concat_ragged_jit, _dict_gather_bytes_jit, _hybrid_jit,
+    _max_jit, _plain_jit, _PTYPE_TO_NAME, _stack_jit,
     host_decode_dictionary, parse_data_page, parse_hybrid_meta, parse_delta_meta,
 )
 from .schema.core import SchemaNode
@@ -100,15 +101,25 @@ class DeviceDictColumn(DeviceColumnData):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("values_per_mini", "count", "bits", "max_width")
+    jax.jit,
+    static_argnames=("values_per_mini", "count", "bits", "max_width", "defined"),
 )
 def _delta_pages_jit(buf, firsts, starts, widths, mins, *, values_per_mini,
-                     count, bits, max_width):
-    return jax.vmap(
+                     count, bits, max_width, defined):
+    """Decode P delta pages; flatten to the per-page real extents in-graph.
+
+    ``defined`` (static tuple of per-page value counts) keeps the tail
+    slice/concat inside the executable — an eager slice per page would pay the
+    tunneled backend's first-dispatch compile cost instead.
+    """
+    vals = jax.vmap(
         lambda f, s, w, m: K.delta_reconstruct(
             buf, f, s, w, m, values_per_mini, count, bits, max_width
         )
     )(firsts, starts, widths, mins)
+    if all(d == count for d in defined):
+        return vals.reshape(-1)
+    return jnp.concatenate([vals[i, :d] for i, d in enumerate(defined)])
 
 
 @functools.partial(jax.jit, static_argnames=("count",))
@@ -215,7 +226,7 @@ class _ChunkAssembler:
             buf[pos : pos + n] = np.frombuffer(p.raw, np.uint8, n, p.value_pos)
             pos += n
         vals = _plain_jit(
-            jnp.asarray(buf), jnp.int64(0), dtype=name, count=defined
+            jnp.asarray(buf), np.int64(0), dtype=name, count=defined
         )
         return DeviceColumnData(values=vals, **common)
 
@@ -257,9 +268,17 @@ class _ChunkAssembler:
         buf, bases = self._value_buffer()
         ends_l, rle_l, vals_l, starts_l = [], [], [], []
         prefix = 0
+        host_max = 0 if self.pages else None
         for p, base in zip(self.pages, bases):
             stream = p.raw[p.value_pos :]
-            meta = parse_hybrid_meta(stream, width, p.defined, pos=1)
+            meta = parse_hybrid_meta(stream, width, p.defined, pos=1,
+                                     compute_max=True)
+            if p.defined == 0:
+                pass  # no indices: nothing to fold into the max
+            elif host_max is not None and meta.max_value is not None:
+                host_max = max(host_max, meta.max_value)
+            else:
+                host_max = None  # Python fallback walk: defer check to device
             n = meta.n_runs
             ends_l.append(meta.run_ends[:n] + prefix)
             rle_l.append(meta.run_is_rle[:n])
@@ -283,15 +302,22 @@ class _ChunkAssembler:
             rvals[k : k + len(e)] = v
             starts[k : k + len(e)] = s
             k += len(e)
+        if prefix and self.dict_len == 0:
+            raise ParquetError("dictionary indices with empty dictionary")
+        if prefix and host_max is not None and host_max >= self.dict_len:
+            raise ParquetError(
+                f"dictionary index {host_max} out of range ({self.dict_len}) "
+                f"in column {'.'.join(self.leaf.path)}"
+            )
         idx = _hybrid_jit(
             jnp.asarray(buf), jnp.asarray(ends), jnp.asarray(is_rle),
             jnp.asarray(rvals), jnp.asarray(starts), width=width, count=prefix,
         )
-        if prefix and self.dict_len == 0:
-            raise ParquetError("dictionary indices with empty dictionary")
-        if prefix:
+        if prefix and host_max is None:
+            # no native walk: fall back to the deferred on-device range check
+            # (one extra executable + one sync at finalize)
             self._deferred.append(
-                (jnp.max(idx), self.dict_len, ".".join(self.leaf.path))
+                (_max_jit(idx), self.dict_len, ".".join(self.leaf.path))
             )
         col = DeviceDictColumn(indices=idx, **common)
         if self.dict_u8 is not None:
@@ -328,20 +354,13 @@ class _ChunkAssembler:
             widths[i, :kk] = m.mini_widths
             mins[i, :kk] = m.mini_min_delta
             firsts[i] = m.first_value
-        vals = _delta_pages_jit(
+        flat = _delta_pages_jit(
             jnp.asarray(buf), jnp.asarray(firsts), jnp.asarray(starts),
             jnp.asarray(widths), jnp.asarray(mins),
             values_per_mini=metas[0].values_per_mini, count=count, bits=bits,
             max_width=max(1, int(widths.max(initial=0))),
-        )  # [P, count]
-        # slice each page's real extent and flatten
-        if all(m.count == count and p.defined == count
-               for m, p in zip(metas, self.pages)):
-            flat = vals.reshape(-1)
-        else:
-            flat = jnp.concatenate(
-                [vals[i, : p.defined] for i, p in enumerate(self.pages)]
-            )
+            defined=tuple(p.defined for p in self.pages),
+        )
         return DeviceColumnData(values=flat, **common)
 
     def _finish_host(self, common) -> DeviceColumnData:
@@ -375,18 +394,13 @@ class _ChunkAssembler:
             if len(off_parts) == 1:
                 out.offsets, out.heap = off_parts[0], heap_parts[0]
             else:
-                bases2 = np.cumsum([0] + [int(o[-1]) for o in off_parts[:-1]])
-                out.offsets = jnp.concatenate(
-                    [off_parts[0]]
-                    + [o[1:] + int(b) for o, b in zip(off_parts[1:], bases2[1:])]
-                )
-                out.heap = jnp.concatenate(heap_parts)
+                out.offsets, out.heap = _concat_ragged_jit(off_parts, heap_parts)
         elif vals_parts:
             out.values = (
-                vals_parts[0] if len(vals_parts) == 1 else jnp.concatenate(vals_parts)
+                vals_parts[0] if len(vals_parts) == 1 else _concat_jit(vals_parts)
             )
         else:
-            out.values = jnp.zeros(0, dtype=jnp.int64)
+            out.values = jnp.asarray(np.zeros(0, dtype=np.int64))
         return out
 
 
@@ -415,7 +429,7 @@ def decode_chunk_batched(
         # index/unknown pages: skip
     if not asm.pages:
         return DeviceColumnData(
-            values=jnp.zeros(0, dtype=jnp.int64),
+            values=jnp.asarray(np.zeros(0, dtype=np.int64)),
             max_def=leaf.max_def, max_rep=leaf.max_rep, num_leaf_slots=0,
         )
     return asm.finish()
@@ -484,10 +498,11 @@ class DeviceFileReader:
         """Run deferred validity checks (one device sync for all chunks)."""
         if not self._deferred:
             return
-        maxima = jnp.stack([m for m, _, _ in self._deferred])
-        host_max = np.asarray(maxima)
-        for mx, dict_len, path in zip(host_max, (d for _, d, _ in self._deferred),
-                                      (p for _, _, p in self._deferred)):
+        # ONE device_get round trip for every deferred scalar: the tunneled
+        # backend charges ~100ms per device->host transfer regardless of size,
+        # so per-scalar np.asarray syncs would dominate the whole decode
+        host_max = np.asarray(_stack_jit([m for m, _, _ in self._deferred]))
+        for mx, (_, dict_len, path) in zip(host_max, self._deferred):
             if int(mx) >= dict_len:
                 raise ParquetError(
                     f"dictionary index {int(mx)} out of range ({dict_len}) "

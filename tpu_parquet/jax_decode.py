@@ -86,10 +86,29 @@ class HybridMeta:
     count: int
     consumed: int              # bytes consumed from the stream
     n_runs: int = 0            # real (unpadded) run count
+    max_value: Optional[int] = None  # stream max (native walk only, on request)
+
+
+# meta_parse.cpp error codes → messages (kept aligned with the C enum)
+_NATIVE_ERRORS = {
+    -1: "truncated varint in stream header",
+    -2: "varint too long in stream header",
+    -3: "invalid delta block size",
+    -4: "invalid miniblock count",
+    -5: "miniblock size not multiple of 32",
+    -6: "implausible delta value count",
+    -7: "truncated miniblock bit widths",
+    -8: "invalid miniblock bit width",
+    -9: "truncated miniblock data",
+    -11: "truncated bit-packed run",
+    -12: "truncated RLE run value",
+    -13: "hybrid stream exhausted",
+}
 
 
 def parse_hybrid_meta(
-    buf: bytes, width: int, count: int, pos: int = 0, end: Optional[int] = None
+    buf: bytes, width: int, count: int, pos: int = 0, end: Optional[int] = None,
+    compute_max: bool = False,
 ) -> HybridMeta:
     """Walk run headers only (no payload unpacking) — cheap, O(runs) bytes.
 
@@ -97,13 +116,64 @@ def parse_hybrid_meta(
     payload offset) instead of decoding; the payload stays untouched for the
     device kernel.  ``end`` bounds the stream (v1 length prefix): runs may not
     extend past it, matching the host decoder's size validation.
+
+    ``compute_max`` additionally reports the stream's maximum value when the
+    native walk is available (``max_value``; None otherwise) — dictionary
+    callers use it to range-check indices on host with zero device syncs.
+
+    The walk itself runs in C when the native library is available
+    (native/meta_parse.cpp, identical semantics); this Python loop is the
+    reference implementation and the no-toolchain fallback.
     """
     if width < 0 or width > 32:
         raise RLEError(f"invalid hybrid bit width {width} for device path")
+    n = len(buf) if end is None else min(end, len(buf))
+    if count > 0:
+        got = _native_hybrid_meta(buf, n, pos, width, count, compute_max)
+        if got is not None:
+            return got
+    return _parse_hybrid_meta_py(buf, width, count, pos, n)
+
+
+def _native_hybrid_meta(buf, n, pos, width, count, compute_max=False) -> Optional[HybridMeta]:
+    from . import native
+
+    cap = min(count, max(n - pos, 0) + 1, 4096)
+    while True:
+        res = native.hybrid_meta(buf, n, pos, width, count, cap,
+                                 want_max=compute_max)
+        if res is None:
+            return None
+        if isinstance(res, int):
+            if res == -10:  # cap exceeded: worst case one run per value/byte
+                full_cap = min(count, max(n - pos, 0) + 1)
+                if cap >= full_cap:
+                    return None  # defensive: let the Python walk diagnose
+                cap = full_cap
+                continue
+            raise RLEError(_NATIVE_ERRORS.get(res, f"hybrid parse error {res}"))
+        n_runs, consumed, ends, kinds, vals, starts, max_value = res
+        rp = _bucket(max(n_runs, 1))
+        run_ends = np.full(rp, count, dtype=np.int64)
+        run_is_rle = np.zeros(rp, dtype=bool)
+        run_values = np.zeros(rp, dtype=np.uint32)
+        run_bit_starts = np.zeros(rp, dtype=np.int64)
+        run_ends[:n_runs] = ends
+        run_is_rle[:n_runs] = kinds.astype(bool)
+        run_values[:n_runs] = vals
+        run_bit_starts[:n_runs] = starts
+        return HybridMeta(
+            run_ends, run_is_rle, run_values, run_bit_starts, count, consumed,
+            n_runs=n_runs, max_value=max_value,
+        )
+
+
+def _parse_hybrid_meta_py(
+    buf: bytes, width: int, count: int, pos: int, n: int
+) -> HybridMeta:
     ends, kinds, vals, starts = [], [], [], []
     total = 0
     value_bytes = (width + 7) // 8
-    n = len(buf) if end is None else min(end, len(buf))
     while total < count:
         if pos >= n:
             raise RLEError(f"hybrid stream exhausted: wanted {count}, got {total}")
@@ -196,13 +266,53 @@ def parse_delta_meta(buf: bytes, bits: int, pos: int = 0) -> DeltaMeta:
 
     The payload bytes are never touched: only the varint headers and the
     bit-width byte vectors are read (deltabp_decoder.go:38-103 structure).
+    Runs in C when the native library is available (native/meta_parse.cpp,
+    identical semantics); the Python walk below is the reference
+    implementation and the no-toolchain fallback.
     """
+    got = _native_delta_meta(buf, pos)
+    if got is not None:
+        return got
+    return _parse_delta_meta_py(buf, bits, pos)
+
+
+def _native_delta_meta(buf: bytes, pos: int) -> Optional[DeltaMeta]:
+    from . import native
+
+    # one miniblock costs >= its width-vector byte, so len(buf) bounds the
+    # miniblock count even for hostile headers; +4 covers tiny streams
+    cap = len(buf) - pos + 4
+    res = native.delta_meta(buf, pos, cap)
+    if res is None:
+        return None
+    if isinstance(res, int):
+        if res == -10:
+            return None  # cannot happen given cap bound; let Python diagnose
+        raise DeltaError(_NATIVE_ERRORS.get(res, f"delta parse error {res}"))
+    header, starts, widths, mins = res
+    _, minis_per_block, total, first, consumed, n_minis = (int(x) for x in header)
+    values_per_mini = int(header[0]) // minis_per_block
+    mp = _bucket(max(n_minis, 1))
+    bs = np.zeros(mp, dtype=np.int64)
+    ws = np.zeros(mp, dtype=np.int32)
+    md = np.zeros(mp, dtype=np.uint64)
+    if n_minis:
+        bs[:n_minis] = starts
+        ws[:n_minis] = widths
+        md[:n_minis] = mins
+        bs[n_minis:] = starts[-1]
+    return DeltaMeta(first, bs, ws, md, values_per_mini, total, consumed)
+
+
+def _parse_delta_meta_py(buf: bytes, bits: int, pos: int = 0) -> DeltaMeta:
     block_size, pos = _delta_uvarint(buf, pos)
     minis_per_block, pos = _delta_uvarint(buf, pos)
     total, pos = _delta_uvarint(buf, pos)
     first, pos = _read_zigzag(buf, pos)
     if block_size == 0 or block_size % 128 != 0:
         raise DeltaError(f"invalid delta block size {block_size}")
+    if block_size > 1 << 30:  # decompression-bomb guard (parity: meta_parse.cpp)
+        raise DeltaError(f"implausible delta block size {block_size}")
     if minis_per_block == 0 or block_size % minis_per_block != 0:
         raise DeltaError(f"invalid miniblock count {minis_per_block}")
     values_per_mini = block_size // minis_per_block
@@ -449,6 +559,49 @@ def _ragged_take_jit(offsets, heap, indices, *, out_heap_size):
     return K.ragged_take(offsets, heap, indices, out_heap_size)
 
 
+# Eager (non-jit) ops are poison on a tunneled TPU backend: the FIRST dispatch
+# of every distinct eager op/shape pays a full XLA compile (~0.7-3s measured on
+# axon), so even a handful of stray jnp.max / slice / concatenate calls in the
+# decode path dwarfs the actual decode.  Everything below keeps those tail ops
+# inside jit; np scalars and np.zeros feed jit/device_put directly so no eager
+# broadcast is ever dispatched.
+
+_max_jit = jax.jit(jnp.max)
+
+
+@jax.jit
+def _concat_jit(parts):
+    return jnp.concatenate(parts)
+
+
+@jax.jit
+def _concat_ragged_jit(offs, heaps):
+    """Concatenate per-page (offsets, heap) pairs into one ragged column.
+
+    Offsets are rebased by the running heap length entirely on device — no
+    host sync on the per-page heap sizes.
+    """
+    out_offs = [offs[0]]
+    base = offs[0][-1]
+    for o in offs[1:]:
+        out_offs.append(o[1:] + base)
+        base = base + o[-1]
+    return jnp.concatenate(out_offs), jnp.concatenate(heaps)
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def _slice_jit(x, *, size):
+    return x[:size]
+
+
+@jax.jit
+def _stack_jit(xs):
+    # stack deferred-check scalars on device so syncing them costs ONE host
+    # transfer: the tunneled backend charges a full round trip per transfer,
+    # and jax.device_get fetches list leaves one by one
+    return jnp.stack(xs)
+
+
 @dataclass
 class DeviceColumnData:
     """Decoded column chunk resident on device.
@@ -555,7 +708,7 @@ class DeviceChunkDecoder:
                     raise ParquetError(f"PLAIN BOOLEAN truncated: {avail} < {need}")
                 return (
                     _bool_plain_jit(
-                        pad_buffer(raw), jnp.int64(pos), count=count
+                        pad_buffer(raw), np.int64(pos), count=count
                     ),
                     None,
                     None,
@@ -566,7 +719,7 @@ class DeviceChunkDecoder:
                 if avail < need:
                     raise ParquetError(f"PLAIN data truncated: {avail} < {need}")
                 return (
-                    _plain_jit(pad_buffer(raw), jnp.int64(pos), dtype=name, count=count),
+                    _plain_jit(pad_buffer(raw), np.int64(pos), dtype=name, count=count),
                     None,
                     None,
                 )
@@ -586,16 +739,23 @@ class DeviceChunkDecoder:
             width = raw[pos]
             if width > 32:
                 raise ParquetError(f"dictionary index width {width} invalid")
-            meta = parse_hybrid_meta(raw, width, count, pos=pos + 1)
+            meta = parse_hybrid_meta(raw, width, count, pos=pos + 1,
+                                     compute_max=True)
             idx = decode_hybrid_device(pad_buffer(raw), meta, width)
             if self.dict_u8 is not None:
                 if count and self.dict_len == 0:
                     raise ParquetError("dictionary indices with empty dictionary")
-                # range check is deferred to the end of the chunk (decode()):
-                # recording the device-side max costs nothing now, and one sync
-                # per chunk validates every page without stalling the pipeline
-                if count:
-                    self._idx_maxima.append(jnp.max(idx))
+                # range check: on host when the native walk reported the max;
+                # otherwise deferred to the end of the chunk (decode()) as one
+                # on-device max + one sync
+                if count and meta.max_value is not None:
+                    if meta.max_value >= self.dict_len:
+                        raise ParquetError(
+                            f"dictionary index {meta.max_value} out of range "
+                            f"({self.dict_len})"
+                        )
+                elif count:
+                    self._idx_maxima.append(_max_jit(idx))
                 return (
                     _dict_gather_bytes_jit(self.dict_u8, idx, dtype=self.dict_dtype),
                     None,
@@ -613,7 +773,9 @@ class DeviceChunkDecoder:
                 self.dict_offsets, self.dict_heap, idx,
                 out_heap_size=_bucket(max(out_heap, 1), 64),
             )
-            return None, new_off, new_heap[:out_heap] if out_heap else jnp.zeros(0, jnp.uint8)
+            if not out_heap:
+                return None, new_off, jnp.asarray(np.zeros(0, dtype=np.uint8))
+            return None, new_off, _slice_jit(new_heap, size=out_heap)
 
         if enc == Encoding.DELTA_BINARY_PACKED:
             bits = 32 if ptype == Type.INT32 else 64
@@ -623,7 +785,9 @@ class DeviceChunkDecoder:
             if meta.count < count:
                 raise ParquetError(f"delta stream yielded {meta.count} of {count} values")
             vals = decode_delta_device(pad_buffer(raw), meta, bits)
-            return vals[:count], None, None
+            if meta.count == count:
+                return vals, None, None
+            return _slice_jit(vals, size=count), None, None
 
         if enc == Encoding.BYTE_STREAM_SPLIT:
             name = _PTYPE_TO_NAME.get(ptype)
@@ -642,7 +806,7 @@ class DeviceChunkDecoder:
             if avail < need:
                 raise ParquetError(f"BYTE_STREAM_SPLIT truncated: {avail} < {need}")
             return (
-                _bss_jit(pad_buffer(raw), jnp.int64(pos), dtype=name, count=count),
+                _bss_jit(pad_buffer(raw), np.int64(pos), dtype=name, count=count),
                 None,
                 None,
             )
@@ -712,7 +876,7 @@ class DeviceChunkDecoder:
                 rep_parts.append(r)
 
         if self._idx_maxima:
-            mx = int(jnp.max(jnp.stack(self._idx_maxima)))
+            mx = int(np.asarray(_stack_jit(self._idx_maxima)).max())
             if mx >= self.dict_len:
                 raise ParquetError(
                     f"dictionary index {mx} out of range ({self.dict_len})"
@@ -730,25 +894,20 @@ class DeviceChunkDecoder:
             if len(off_parts) == 1:
                 out.offsets, out.heap = off_parts[0], heap_parts[0]
             else:
-                bases = np.cumsum([0] + [int(o[-1]) for o in off_parts[:-1]])
-                out.offsets = jnp.concatenate(
-                    [off_parts[0]]
-                    + [o[1:] + int(b) for o, b in zip(off_parts[1:], bases[1:])]
-                )
-                out.heap = jnp.concatenate(heap_parts)
+                out.offsets, out.heap = _concat_ragged_jit(off_parts, heap_parts)
         elif vals_parts:
             out.values = (
-                vals_parts[0] if len(vals_parts) == 1 else jnp.concatenate(vals_parts)
+                vals_parts[0] if len(vals_parts) == 1 else _concat_jit(vals_parts)
             )
         else:
-            out.values = jnp.zeros(0, dtype=jnp.int64)
+            out.values = jnp.asarray(np.zeros(0, dtype=np.int64))
         if def_parts:
             out.def_levels = (
-                def_parts[0] if len(def_parts) == 1 else jnp.concatenate(def_parts)
+                def_parts[0] if len(def_parts) == 1 else _concat_jit(def_parts)
             )
         if rep_parts:
             out.rep_levels = (
-                rep_parts[0] if len(rep_parts) == 1 else jnp.concatenate(rep_parts)
+                rep_parts[0] if len(rep_parts) == 1 else _concat_jit(rep_parts)
             )
         return out
 

@@ -69,6 +69,8 @@ def decode(buf: bytes, bits: int = 64) -> tuple[np.ndarray, int]:
 
     if block_size == 0 or block_size % 128 != 0:
         raise DeltaError(f"invalid delta block size {block_size}")
+    if block_size > 1 << 30:  # decompression-bomb guard (parity: meta_parse.cpp)
+        raise DeltaError(f"implausible delta block size {block_size}")
     if minis_per_block == 0 or block_size % minis_per_block != 0:
         raise DeltaError(f"invalid miniblock count {minis_per_block}")
     values_per_mini = block_size // minis_per_block

@@ -16,7 +16,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["snappy.cpp"]
+_SOURCES = ["snappy.cpp", "meta_parse.cpp"]
 _LIB_BASENAME = "_libtpq_native.so"
 
 _lock = threading.Lock()
@@ -76,6 +76,21 @@ def load():
             lib.tpq_snappy_compress.argtypes = [
                 ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
             ]
+            c_ll = ctypes.c_longlong
+            p = ctypes.POINTER
+            lib.tpq_delta_meta.restype = c_ll
+            lib.tpq_delta_meta.argtypes = [
+                ctypes.c_char_p, c_ll, c_ll, p(ctypes.c_longlong),
+                p(ctypes.c_longlong), p(ctypes.c_int32), p(ctypes.c_uint64),
+                c_ll,
+            ]
+            lib.tpq_hybrid_meta.restype = c_ll
+            lib.tpq_hybrid_meta.argtypes = [
+                ctypes.c_char_p, c_ll, c_ll, c_ll, c_ll,
+                p(ctypes.c_longlong), p(ctypes.c_uint8), p(ctypes.c_uint32),
+                p(ctypes.c_longlong), c_ll, p(ctypes.c_longlong),
+                c_ll, p(ctypes.c_uint64),
+            ]
             _lib = lib
         except Exception:
             _load_failed = True
@@ -106,6 +121,78 @@ def snappy_compress(data: bytes) -> bytes:
     if n < 0:
         raise ValueError("snappy compression failed")
     return out.raw[:n]
+
+
+def delta_meta(buf: bytes, pos: int, cap: int):
+    """Walk DELTA_BINARY_PACKED headers natively (meta_parse.cpp).
+
+    Returns (header, starts, widths, mins) on success where header is
+    int64[6] = [block_size, minis_per_block, total, first_value, consumed,
+    n_minis] and the arrays are trimmed to n_minis — or a negative error code
+    (int) the caller maps to its DeltaError messages.  Returns None when the
+    native library is unavailable (caller falls back to the Python walk).
+    """
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    header = np.zeros(6, dtype=np.int64)
+    starts = np.empty(cap, dtype=np.int64)
+    widths = np.empty(cap, dtype=np.int32)
+    mins = np.empty(cap, dtype=np.uint64)
+    pll = ctypes.POINTER(ctypes.c_longlong)
+    rc = lib.tpq_delta_meta(
+        buf, len(buf), pos,
+        header.ctypes.data_as(pll),
+        starts.ctypes.data_as(pll),
+        widths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        mins.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        cap,
+    )
+    if rc < 0:
+        return int(rc)
+    n = int(header[5])
+    return header, starts[:n], widths[:n], mins[:n]
+
+
+def hybrid_meta(buf: bytes, n: int, pos: int, width: int, count: int, cap: int,
+                want_max: bool = False):
+    """Walk RLE/bit-packed hybrid run headers natively (meta_parse.cpp).
+
+    Returns (n_runs, consumed, ends, kinds, vals, starts, max_value) trimmed
+    to n_runs (max_value is None unless want_max), a negative error code
+    (int; -10 = cap exceeded, retry bigger), or None when the native library
+    is unavailable.
+    """
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    ends = np.empty(cap, dtype=np.int64)
+    kinds = np.empty(cap, dtype=np.uint8)
+    vals = np.empty(cap, dtype=np.uint32)
+    starts = np.empty(cap, dtype=np.int64)
+    consumed = np.zeros(1, dtype=np.int64)
+    max_out = np.zeros(1, dtype=np.uint64)
+    pll = ctypes.POINTER(ctypes.c_longlong)
+    rc = lib.tpq_hybrid_meta(
+        buf, n, pos, width, count,
+        ends.ctypes.data_as(pll),
+        kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        starts.ctypes.data_as(pll),
+        cap,
+        consumed.ctypes.data_as(pll),
+        1 if want_max else 0,
+        max_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    if rc < 0:
+        return int(rc)
+    r = int(rc)
+    mx = int(max_out[0]) if want_max else None
+    return r, int(consumed[0]), ends[:r], kinds[:r], vals[:r], starts[:r], mx
 
 
 def available() -> bool:

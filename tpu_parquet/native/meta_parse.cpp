@@ -1,0 +1,220 @@
+// Host-side stream-structure parsers: DELTA_BINARY_PACKED block headers and
+// RLE/bit-packed hybrid run headers.
+//
+// The device decode path splits every encoded stream into (structure, bulk):
+// structure — varint headers, a few bytes per block/run — is walked on the
+// host; bulk bytes go to the TPU untouched (jax_decode.parse_delta_meta /
+// parse_hybrid_meta docstrings).  The structure walk is sequential byte
+// chasing, the one shape Python is worst at: on a 10M-row DELTA column the
+// pure-Python walk costs ~10x the actual XLA decode.  These C functions do the
+// identical walk at memory speed; the Python versions remain as the reference
+// implementation and the fallback when no C++ toolchain is available.
+//
+// Semantics mirror the reference decoders' header validation:
+// deltabp_decoder.go:38-103 (block geometry + bit-width bounds) and
+// hybrid_decoder.go:115-165 (run headers, truncation checks).  Varints follow
+// helpers.go readUVariant64: at most 10 bytes (continuation past shift 63 is
+// an error); values may exceed 64 bits transiently, so accumulation is 128-bit
+// to match the Python parser bit for bit on hostile inputs.
+
+#include <cstdint>
+
+typedef uint8_t u8;
+typedef int32_t i32;
+typedef uint32_t u32;
+typedef int64_t i64;
+typedef uint64_t u64;
+typedef unsigned __int128 u128;
+
+namespace {
+
+// error codes shared with the ctypes wrapper (tpu_parquet/native/__init__.py)
+enum {
+    ERR_TRUNC_VARINT = -1,
+    ERR_VARINT_LONG = -2,
+    ERR_BLOCK_SIZE = -3,
+    ERR_MINI_COUNT = -4,
+    ERR_MINI_MULT = -5,
+    ERR_COUNT_BOMB = -6,
+    ERR_TRUNC_WIDTHS = -7,
+    ERR_BAD_WIDTH = -8,
+    ERR_TRUNC_MINI = -9,
+    ERR_CAP = -10,
+    ERR_TRUNC_RUN = -11,
+    ERR_TRUNC_RLE_VALUE = -12,
+    ERR_EXHAUSTED = -13,
+};
+
+int read_uvarint(const u8 *buf, i64 n, i64 *pos, u128 *out) {
+    u128 result = 0;
+    int shift = 0;
+    for (;;) {
+        if (*pos >= n) return ERR_TRUNC_VARINT;
+        u8 b = buf[(*pos)++];
+        result |= (u128)(b & 0x7F) << shift;
+        if (!(b & 0x80)) {
+            *out = result;
+            return 0;
+        }
+        shift += 7;
+        if (shift > 63) return ERR_VARINT_LONG;
+    }
+}
+
+int read_zigzag(const u8 *buf, i64 n, i64 *pos, u128 *out) {
+    u128 v;
+    int rc = read_uvarint(buf, n, pos, &v);
+    if (rc) return rc;
+    // (v >> 1) ^ -(v & 1) in 128-bit, exactly like the Python reference
+    *out = (v >> 1) ^ (~(u128)0 * (v & 1));
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a DELTA_BINARY_PACKED stream's headers starting at buf[pos].
+//
+// header_out[6]: block_size, minis_per_block, total, first_value (two's
+// complement low 64 bits), consumed byte position, n_minis written.
+// starts/widths/mins: per-miniblock payload bit offset, bit width, and block
+// min-delta (low 64 bits), cap entries each.  Returns 0 or a negative error.
+i64 tpq_delta_meta(const u8 *buf, i64 len, i64 pos, i64 *header_out,
+                   i64 *starts, i32 *widths, u64 *mins, i64 cap) {
+    u128 block_size, minis_per_block, total, first;
+    int rc;
+    if ((rc = read_uvarint(buf, len, &pos, &block_size))) return rc;
+    if ((rc = read_uvarint(buf, len, &pos, &minis_per_block))) return rc;
+    if ((rc = read_uvarint(buf, len, &pos, &total))) return rc;
+    if ((rc = read_zigzag(buf, len, &pos, &first))) return rc;
+    if (block_size == 0 || block_size % 128 != 0) return ERR_BLOCK_SIZE;
+    if (block_size > ((u128)1 << 30)) return ERR_BLOCK_SIZE;  // decompression-bomb guard
+    if (minis_per_block == 0 || block_size % minis_per_block != 0)
+        return ERR_MINI_COUNT;
+    u128 values_per_mini = block_size / minis_per_block;
+    if (values_per_mini % 32 != 0) return ERR_MINI_MULT;
+    if (total > ((u128)1 << 40)) return ERR_COUNT_BOMB;
+
+    i64 n_deltas = total > 0 ? (i64)total - 1 : 0;
+    i64 got = 0, n_minis = 0;
+    // values_per_mini/minis_per_block stay 128-bit through the size math:
+    // hostile headers can make them exceed i64, and a narrowing cast would
+    // turn the bound checks below into out-of-bounds reads (the Python
+    // reference walk does this arithmetic in unbounded ints)
+    u128 vpm128 = values_per_mini;
+    if (minis_per_block > (u128)len + 1) return ERR_TRUNC_WIDTHS;
+    i64 mpb = (i64)minis_per_block;
+    while (got < n_deltas) {
+        u128 min_delta;
+        if ((rc = read_zigzag(buf, len, &pos, &min_delta))) return rc;
+        if (pos + mpb > len) return ERR_TRUNC_WIDTHS;
+        const u8 *wvec = buf + pos;
+        pos += mpb;
+        for (i64 m = 0; m < mpb && got < n_deltas; m++) {
+            i64 w = wvec[m];
+            if (w > 64) return ERR_BAD_WIDTH;
+            u128 nbytes128 = (vpm128 * (u128)w + 7) / 8;
+            if ((u128)pos + nbytes128 > (u128)len) return ERR_TRUNC_MINI;
+            if (n_minis >= cap) return ERR_CAP;
+            starts[n_minis] = pos * 8;
+            widths[n_minis] = (i32)w;
+            mins[n_minis] = (u64)min_delta;
+            n_minis++;
+            pos += (i64)nbytes128;
+            u128 take = (u128)(n_deltas - got);
+            got += (i64)(take < vpm128 ? take : vpm128);
+        }
+    }
+    header_out[0] = (i64)block_size;
+    header_out[1] = mpb;
+    header_out[2] = (i64)total;
+    header_out[3] = (i64)(u64)first;
+    header_out[4] = pos;
+    header_out[5] = n_minis;
+    return 0;
+}
+
+// Parse RLE/bit-packed hybrid run headers for `count` values starting at
+// buf[pos], bounded by n (the v1 length prefix, or the buffer end).
+//
+// ends/kinds/vals/starts: per-run cumulative value count, is-RLE flag, RLE
+// value, and bit-packed payload bit offset minus run_start*width (the uniform
+// position form expand_rle_hybrid consumes), cap entries each.
+// consumed_out[0] receives the final byte position.  When want_max is nonzero
+// the stream's maximum value (RLE run values + a scan of every bit-packed
+// field up to each run's real extent) is written to max_out[0] — this lets
+// dictionary-index range validation happen entirely on the host, so the
+// device decode path needs zero device→host syncs.  Returns n_runs >= 0, or
+// a negative error (ERR_CAP: caller retries with a larger cap).
+i64 tpq_hybrid_meta(const u8 *buf, i64 n, i64 pos, i64 width, i64 count,
+                    i64 *ends, u8 *kinds, u32 *vals, i64 *starts, i64 cap,
+                    i64 *consumed_out, i64 want_max, u64 *max_out) {
+    i64 value_bytes = (width + 7) / 8;
+    i64 total = 0, n_runs = 0;
+    u64 max_val = 0;
+    const u64 mask = width >= 64 ? ~(u64)0 : (((u64)1 << width) - 1);
+    while (total < count) {
+        if (pos >= n) return ERR_EXHAUSTED;
+        u128 h;
+        int rc = read_uvarint(buf, n, &pos, &h);
+        if (rc) return rc;
+        if (h & 1) {
+            u128 groups = h >> 1;
+            if (groups == 0) continue;
+            u128 nbytes128 = groups * (u128)width;
+            if ((u128)pos + nbytes128 > (u128)n) return ERR_TRUNC_RUN;
+            // nvals in 128-bit: for width 0 the byte bound above doesn't cap
+            // groups, and (i64)(groups*8) could truncate to 0 and stall the
+            // walk where the Python reference accepts the run
+            u128 nvals128 = groups * 8;
+            i64 take = count - total;
+            if (nvals128 < (u128)take) take = (i64)nvals128;
+            if (n_runs >= cap) return ERR_CAP;
+            kinds[n_runs] = 0;
+            vals[n_runs] = 0;
+            starts[n_runs] = pos * 8 - total * width;
+            if (want_max && width > 0) {
+                // scan the run's real extent (padding past `take` is ignored,
+                // matching the device expansion's idx[:count] semantics)
+                for (i64 k = 0; k < take; k++) {
+                    i64 bit = pos * 8 + k * width;
+                    i64 byte0 = bit >> 3;
+                    int sh = (int)(bit & 7);
+                    u64 acc = 0;
+                    i64 nb = (width + sh + 7) / 8;
+                    for (i64 b = 0; b < nb && byte0 + b < n; b++)
+                        acc |= (u64)buf[byte0 + b] << (8 * b);
+                    u64 v = (acc >> sh) & mask;
+                    if (v > max_val) max_val = v;
+                }
+            }
+            pos += (i64)nbytes128;
+            total += take;
+        } else {
+            u128 repeats128 = h >> 1;
+            if (repeats128 == 0) continue;
+            i64 repeats = repeats128 > (u128)(count - total)
+                              ? count - total
+                              : (i64)repeats128;
+            if (pos + value_bytes > n) return ERR_TRUNC_RLE_VALUE;
+            u64 v = 0;
+            for (i64 k = 0; k < value_bytes; k++)
+                v |= (u64)buf[pos + k] << (8 * k);
+            pos += value_bytes;
+            if (n_runs >= cap) return ERR_CAP;
+            kinds[n_runs] = 1;
+            vals[n_runs] = (u32)v;
+            starts[n_runs] = 0;
+            if (want_max && (v & mask) > max_val) max_val = v & mask;
+            total += repeats;
+        }
+        ends[n_runs] = total;
+        n_runs++;
+    }
+    consumed_out[0] = pos;
+    if (want_max) max_out[0] = max_val;
+    return n_runs;
+}
+
+}  // extern "C"
