@@ -162,3 +162,91 @@ def test_pack_hybrid_pages_tail_padding(mesh):
         np.testing.assert_array_equal(got[i], dictionary[v.astype(np.int64)])
     np.testing.assert_array_equal(got[7, :57], dictionary[vals_tail.astype(np.int64)])
     np.testing.assert_array_equal(got[7, 57:], np.full(count - 57, dictionary[0]))
+
+
+# ---------------------------------------------------------------------------
+# Multi-host work list → global sharded array (SURVEY.md §5.8)
+# ---------------------------------------------------------------------------
+
+def _write_span_file(tmp_path, rows=1000, rg_rows=137):
+    from tpu_parquet.format import CompressionCodec, FieldRepetitionType as FRT, Type
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.writer import FileWriter
+
+    vals = np.arange(rows, dtype=np.int64) * 3 - 500
+    schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
+    p = tmp_path / "span.parquet"
+    with FileWriter(p, schema, codec=CompressionCodec.SNAPPY,
+                    use_dictionary=False) as w:
+        for lo in range(0, rows, rg_rows):
+            w.write_columns({"v": vals[lo : lo + rg_rows]})
+            w.flush_row_group()
+    return p, vals
+
+
+def test_shard_row_ranges_properties():
+    spans = par.shard_row_ranges(1000, 8)
+    assert len(spans) == 8
+    assert spans[0] == (0, 125) and spans[-1] == (875, 1000)
+    # uneven: equal spans, short tail
+    spans = par.shard_row_ranges(1001, 8)
+    assert all(hi - lo == 126 for lo, hi in spans[:-1])
+    assert spans[-1] == (882, 1001)
+    assert par.shard_row_ranges(0, 4) == [(0, 0)] * 4
+
+
+def test_decode_row_span_touches_only_needed_groups(tmp_path):
+    from tpu_parquet.reader import FileReader
+
+    p, vals = _write_span_file(tmp_path)
+    with FileReader(p) as r:
+        np.testing.assert_array_equal(
+            par.decode_row_span(r, "v", 130, 290), vals[130:290]
+        )
+        np.testing.assert_array_equal(
+            par.decode_row_span(r, "v", 0, 1000), vals
+        )
+        np.testing.assert_array_equal(
+            par.decode_row_span(r, "v", 999, 1000), vals[999:]
+        )
+
+
+def test_global_column_array(mesh, tmp_path):
+    """Work list → per-device decode → one global row-sharded array."""
+    from tpu_parquet.reader import FileReader
+
+    p, vals = _write_span_file(tmp_path)
+    with FileReader(p) as r:
+        arr, valid = par.global_column_array(r, "v", mesh)
+    assert valid == 1000
+    assert arr.shape == (1000,)  # 1000 divides evenly over 8 shards
+    np.testing.assert_array_equal(np.asarray(arr), vals)
+    # every device holds exactly its contiguous span
+    for shard in arr.addressable_shards:
+        lo = shard.index[0].start or 0
+        np.testing.assert_array_equal(np.asarray(shard.data), vals[lo : lo + 125])
+
+
+def test_global_column_array_padded_tail(mesh, tmp_path):
+    from tpu_parquet.reader import FileReader
+
+    p, vals = _write_span_file(tmp_path, rows=997)
+    with FileReader(p) as r:
+        arr, valid = par.global_column_array(r, "v", mesh)
+    assert valid == 997
+    per = -(-997 // 8)
+    assert arr.shape == (per * 8,)
+    np.testing.assert_array_equal(np.asarray(arr)[:997], vals)
+    assert not np.any(np.asarray(arr)[997:])  # zero tail padding
+
+
+def test_process_local_column_single_process(mesh, tmp_path):
+    """Multi-host API path on a single process: the same plan/assembly code
+    runs with process_count()==1 (decodes everything locally)."""
+    from tpu_parquet.reader import FileReader
+
+    p, vals = _write_span_file(tmp_path)
+    with FileReader(p) as r:
+        arr, valid = par.process_local_column(r, "v", mesh)
+    assert valid == 1000
+    np.testing.assert_array_equal(np.asarray(arr), vals)

@@ -23,7 +23,7 @@ from .alloc import AllocTracker
 from .column import ByteArrayData, ColumnData
 from .compress import decompress_block
 from .footer import ParquetError
-from .format import Encoding, PageHeader, PageType, Type
+from .format import parse_encoding, Encoding, PageHeader, PageType, Type
 from .kernels import bitpack, bytearray as ba_codec, delta, plain, rle
 from .schema.core import SchemaNode
 from .thrift import ThriftError, read_struct
@@ -144,10 +144,7 @@ class ChunkDecoder:
     def _decode_values(self, enc: int, raw: bytes, count: int):
         ptype = self.leaf.physical_type
         tl = self.leaf.type_length
-        try:
-            enc = Encoding(enc)
-        except (ValueError, TypeError):
-            raise ParquetError(f"unknown value encoding {enc!r}") from None
+        enc = parse_encoding(enc)
         if enc == Encoding.PLAIN_DICTIONARY:
             enc = Encoding.RLE_DICTIONARY
         if enc == Encoding.PLAIN:
@@ -209,7 +206,7 @@ class ChunkDecoder:
         self.alloc.register(header.uncompressed_page_size)
         raw = decompress_block(payload, codec, header.uncompressed_page_size)
         dh = header.dictionary_page_header
-        enc = Encoding(dh.encoding)
+        enc = parse_encoding(dh.encoding, "dictionary page encoding")
         if enc not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
             raise ParquetError(f"dictionary page encoding {enc.name} unsupported")
         count = dh.num_values or 0

@@ -14,6 +14,8 @@ raw-snappy implementation as fallback; GZIP uses stdlib zlib; ZSTD uses the
 
 from __future__ import annotations
 
+from .errors import ParquetError
+
 import gzip as _gzip
 import io
 import threading
@@ -30,7 +32,7 @@ except ImportError:  # pragma: no cover - present in target image
 from . import native as _native
 
 
-class CompressionError(ValueError):
+class CompressionError(ParquetError):
     pass
 
 

@@ -39,9 +39,9 @@ from .chunk_decode import _check_crc, validate_chunk_meta, walk_pages
 from .column import ByteArrayData
 from .compress import decompress_block
 from .footer import ParquetError
-from .format import Encoding, PageType, Type
+from .format import Encoding, PageType, Type, parse_encoding
 from .jax_decode import (
-    DeviceColumnData, ParsedDataPage, _bucket, _SLACK,
+    DeviceColumnData, ParsedDataPage, _bucket, _bucket_bytes, _SLACK,
     _concat_jit, _concat_ragged_jit, _dict_gather_bytes_jit, _hybrid_jit,
     _max_jit, _plain_jit, _PTYPE_TO_NAME, _stack_jit,
     host_decode_dictionary, parse_data_page, parse_hybrid_meta, parse_delta_meta,
@@ -132,6 +132,55 @@ def _bool_pages_jit(buf, page_byte_base, page_val_start, *, count):
     return K.extract_bits(buf, bit_pos, 1, 1).astype(jnp.bool_)
 
 
+@functools.partial(jax.jit, static_argnames=("size",))
+def _dynslice_jit(buf, start, *, size):
+    """Slice ``size`` bytes at a traced offset (static size, bucketed by the
+    caller so executables are shared across chunks)."""
+    return jax.lax.dynamic_slice(buf, (start,), (size,))
+
+
+class _RowGroupStager:
+    """One staged host→device transfer for a whole row group.
+
+    The tunneled TPU backend charges a fixed ~50-100ms round trip per
+    transfer, so per-chunk staging (~8 MB each) runs at a fraction of link
+    bandwidth.  Every chunk registers its host byte regions here (value
+    streams, level arrays, byte-array heaps); ``stage()`` ships ONE buffer and
+    each chunk's kernels address into it by base offset — the transfer
+    granularity and the executable granularity are decoupled.
+    """
+
+    def __init__(self):
+        self._parts: list[tuple[np.ndarray, int, int]] = []  # (u8, base, reserve)
+        self.total = 0
+
+    def add(self, arr: np.ndarray, reserve: int | None = None) -> int:
+        """Register a host array; returns its byte offset in the staged buffer.
+
+        ``reserve`` rounds the region up (tail zero-filled) so callers can
+        device-slice a bucketed size without reading past the arena.
+        """
+        u8 = arr.reshape(-1).view(np.uint8) if arr.dtype != np.uint8 else arr.reshape(-1)
+        base = self.total
+        nbytes = u8.nbytes
+        room = max(reserve or 0, nbytes)
+        self._parts.append((u8, base, room))
+        # keep every region 64-byte aligned for clean device layouts
+        self.total = base + room + (-(base + room)) % 64
+        return base
+
+    def stage(self) -> jax.Array:
+        buf = np.empty(_bucket_bytes(self.total + _SLACK, 64), dtype=np.uint8)
+        pos = 0
+        for u8, base, room in self._parts:
+            if base > pos:
+                buf[pos:base] = 0
+            buf[base : base + u8.nbytes] = u8
+            pos = base + u8.nbytes
+        buf[pos:] = 0
+        return jnp.asarray(buf)
+
+
 class _ChunkAssembler:
     """Collects a chunk's pages, then emits one fused device decode."""
 
@@ -158,23 +207,33 @@ class _ChunkAssembler:
     # -- finish: fused decode -------------------------------------------------
 
     @scoped_x64
-    def finish(self) -> DeviceColumnData:
+    def finish(self, stager: _RowGroupStager):
+        """Phase A (host): parse structure, register bytes with the stager.
+
+        Returns a closure ``fn(buf_dev) -> DeviceColumnData`` that dispatches
+        the chunk's kernels against the staged row-group buffer.
+        """
         leaf = self.leaf
         slots = sum(p.num_values for p in self.pages)
-        encs = {Encoding(p.encoding) for p in self.pages}
+        encs = {parse_encoding(p.encoding) for p in self.pages}
         encs = {
             Encoding.RLE_DICTIONARY if e == Encoding.PLAIN_DICTIONARY else e
             for e in encs
         }
-        dlv = rlv = None
+        d_base = r_base = None
         if leaf.max_def > 0:
-            dlv = jnp.asarray(np.concatenate([p.def_levels for p in self.pages]))
+            d_all = np.ascontiguousarray(
+                np.concatenate([p.def_levels for p in self.pages]), dtype=np.uint32
+            )
+            d_base = stager.add(d_all)
         if leaf.max_rep > 0:
-            rlv = jnp.asarray(np.concatenate([p.rep_levels for p in self.pages]))
+            r_all = np.ascontiguousarray(
+                np.concatenate([p.rep_levels for p in self.pages]), dtype=np.uint32
+            )
+            r_base = stager.add(r_all)
 
         common = dict(
-            def_levels=dlv, rep_levels=rlv, max_def=leaf.max_def,
-            max_rep=leaf.max_rep, num_leaf_slots=slots,
+            max_def=leaf.max_def, max_rep=leaf.max_rep, num_leaf_slots=slots,
             value_dtype=(
                 "float64" if leaf.physical_type == Type.DOUBLE else None
             ),
@@ -183,31 +242,58 @@ class _ChunkAssembler:
         if len(encs) == 1:
             enc = next(iter(encs))
             if enc == Encoding.RLE_DICTIONARY:
-                return self._finish_dict(common)
-            if enc == Encoding.PLAIN and leaf.physical_type in _PTYPE_TO_NAME:
-                return self._finish_plain_fixed(common)
-            if enc == Encoding.PLAIN and leaf.physical_type == Type.BOOLEAN:
-                return self._finish_plain_bool(common)
-            if enc == Encoding.DELTA_BINARY_PACKED:
-                return self._finish_delta(common)
-        # everything else (byte arrays, BSS, INT96, boolean RLE, mixed
-        # encodings): host decode per page, stage once
-        return self._finish_host(common)
+                value_fn = self._finish_dict(common, stager)
+            elif enc == Encoding.PLAIN and leaf.physical_type in _PTYPE_TO_NAME:
+                value_fn = self._finish_plain_fixed(common, stager)
+            elif enc == Encoding.PLAIN and leaf.physical_type == Type.BOOLEAN:
+                value_fn = self._finish_plain_bool(common, stager)
+            elif enc == Encoding.PLAIN and leaf.physical_type == Type.BYTE_ARRAY:
+                value_fn = self._finish_plain_bytes(common, stager)
+            elif enc == Encoding.DELTA_BINARY_PACKED:
+                value_fn = self._finish_delta(common, stager)
+            else:
+                value_fn = self._finish_host(common)
+        else:
+            # mixed encodings, BSS, INT96, FLBA, delta byte arrays, boolean
+            # RLE: host decode per page, stage per chunk
+            value_fn = self._finish_host(common)
 
-    def _value_buffer(self) -> tuple[np.ndarray, np.ndarray]:
-        """Concatenate all pages' value streams; returns (buffer, byte_bases)."""
+        # every closure has captured what it needs; dropping the parsed pages
+        # here releases all raw decompressed page bytes before dispatch (the
+        # iter_row_groups pipeline otherwise pins a whole extra row group)
+        self.pages = []
+
+        @scoped_x64
+        def run(buf_dev) -> DeviceColumnData:
+            col = value_fn(buf_dev)
+            if d_base is not None:
+                col.def_levels = _plain_jit(
+                    buf_dev, np.int64(d_base), dtype="uint32", count=slots
+                )
+            if r_base is not None:
+                col.rep_levels = _plain_jit(
+                    buf_dev, np.int64(r_base), dtype="uint32", count=slots
+                )
+            return col
+
+        return run
+
+    def _value_segments(self, stager: _RowGroupStager) -> np.ndarray:
+        """Register all pages' value streams back-to-back; returns byte bases
+        (absolute offsets in the staged buffer), int64[P]."""
         sizes = [len(p.raw) - p.value_pos for p in self.pages]
+        total = sum(sizes)
+        buf = np.empty(total, dtype=np.uint8)
         bases = np.zeros(len(sizes), dtype=np.int64)
-        total = 0
-        for i, s in enumerate(sizes):
-            bases[i] = total
-            total += s
-        buf = np.zeros(_bucket(total + _SLACK, 64), dtype=np.uint8)
-        for p, b, s in zip(self.pages, bases, sizes):
-            buf[b : b + s] = np.frombuffer(p.raw, np.uint8, s, p.value_pos)
-        return buf, bases
+        pos = 0
+        for i, (p, s) in enumerate(zip(self.pages, sizes)):
+            bases[i] = pos
+            buf[pos : pos + s] = np.frombuffer(p.raw, np.uint8, s, p.value_pos)
+            pos += s
+        base = stager.add(buf)
+        return bases + base
 
-    def _finish_plain_fixed(self, common) -> DeviceColumnData:
+    def _finish_plain_fixed(self, common, stager):
         name = _PTYPE_TO_NAME[self.leaf.physical_type]
         itemsize = np.dtype(name).itemsize
         defined = sum(p.defined for p in self.pages)
@@ -219,18 +305,19 @@ class _ChunkAssembler:
                 )
         # copy exactly the value bytes back-to-back → one contiguous bitcast
         total = defined * itemsize
-        buf = np.zeros(_bucket(total + _SLACK, 64), dtype=np.uint8)
+        buf = np.empty(total, dtype=np.uint8)
         pos = 0
         for p in self.pages:
             n = p.defined * itemsize
             buf[pos : pos + n] = np.frombuffer(p.raw, np.uint8, n, p.value_pos)
             pos += n
-        vals = _plain_jit(
-            jnp.asarray(buf), np.int64(0), dtype=name, count=defined
+        base = stager.add(buf)
+        return lambda buf_dev: DeviceColumnData(
+            values=_plain_jit(buf_dev, np.int64(base), dtype=name, count=defined),
+            **common,
         )
-        return DeviceColumnData(values=vals, **common)
 
-    def _finish_plain_bool(self, common) -> DeviceColumnData:
+    def _finish_plain_bool(self, common, stager):
         defined = sum(p.defined for p in self.pages)
         for p in self.pages:
             need = (p.defined + 7) // 8
@@ -238,19 +325,65 @@ class _ChunkAssembler:
                 raise ParquetError(
                     f"PLAIN BOOLEAN truncated: {len(p.raw) - p.value_pos} < {need}"
                 )
-        buf, bases = self._value_buffer()
+        bases = self._value_segments(stager)
         starts = np.zeros(len(self.pages), dtype=np.int64)
         acc = 0
         for i, p in enumerate(self.pages):
             starts[i] = acc
             acc += p.defined
-        vals = _bool_pages_jit(
-            jnp.asarray(buf), jnp.asarray(bases), jnp.asarray(starts),
-            count=defined,
+        return lambda buf_dev: DeviceColumnData(
+            values=_bool_pages_jit(
+                buf_dev, jnp.asarray(bases), jnp.asarray(starts), count=defined
+            ),
+            **common,
         )
-        return DeviceColumnData(values=vals, **common)
 
-    def _finish_dict(self, common) -> DeviceColumnData:
+    def _finish_plain_bytes(self, common, stager):
+        """PLAIN BYTE_ARRAY chunk: native host walk per page, merged offsets,
+        heap shipped in the row-group buffer (no per-page transfers)."""
+        from .kernels import plain as plain_host
+
+        offs_parts, heap_parts = [], []
+        for p in self.pages:
+            ba = plain_host.decode_byte_array(
+                p.raw[p.value_pos :], p.defined
+            )
+            offs_parts.append(ba.offsets)
+            heap_parts.append(ba.heap)
+        counts = np.array([len(o) - 1 for o in offs_parts], dtype=np.int64)
+        heap_sizes = np.array([h.nbytes for h in heap_parts], dtype=np.int64)
+        n = int(counts.sum())
+        offsets = np.empty(n + 1, dtype=np.int64)
+        offsets[0] = 0
+        pos = 0
+        hbase = 0
+        for o, hs in zip(offs_parts, heap_sizes):
+            k = len(o) - 1
+            offsets[pos + 1 : pos + 1 + k] = o[1:] + hbase
+            pos += k
+            hbase += int(hs)
+        heap = (np.concatenate(heap_parts) if len(heap_parts) > 1
+                else heap_parts[0])
+        heap_len = heap.nbytes
+        heap_room = _bucket_bytes(max(heap_len, 1), 64)
+        heap_base = stager.add(heap, reserve=heap_room)
+        off_base = stager.add(offsets)
+
+        def run(buf_dev):
+            col = DeviceColumnData(**common)
+            col.offsets = _plain_jit(
+                buf_dev, np.int64(off_base), dtype="int64", count=n + 1
+            )
+            # bucketed slice: heap may carry zero padding past offsets[-1]
+            # (trimmed on host by to_host); keeps executables shared
+            col.heap = _dynslice_jit(
+                buf_dev, np.int64(heap_base), size=heap_room
+            )
+            return col
+
+        return run
+
+    def _finish_dict(self, common, stager):
         if self.dict_u8 is None and self.dict_ragged is None:
             raise ParquetError("dictionary-encoded page but no dictionary page seen")
         widths = set()
@@ -265,7 +398,7 @@ class _ChunkAssembler:
             # spec-legal but rare: per-page index widths differ; page-at-a-time
             return self._finish_host(common)
         width = widths.pop()
-        buf, bases = self._value_buffer()
+        bases = self._value_segments(stager)
         ends_l, rle_l, vals_l, starts_l = [], [], [], []
         prefix = 0
         host_max = 0 if self.pages else None
@@ -309,31 +442,36 @@ class _ChunkAssembler:
                 f"dictionary index {host_max} out of range ({self.dict_len}) "
                 f"in column {'.'.join(self.leaf.path)}"
             )
-        idx = _hybrid_jit(
-            jnp.asarray(buf), jnp.asarray(ends), jnp.asarray(is_rle),
-            jnp.asarray(rvals), jnp.asarray(starts), width=width, count=prefix,
-        )
-        if prefix and host_max is None:
-            # no native walk: fall back to the deferred on-device range check
-            # (one extra executable + one sync at finalize)
-            self._deferred.append(
-                (_max_jit(idx), self.dict_len, ".".join(self.leaf.path))
-            )
-        col = DeviceDictColumn(indices=idx, **common)
-        if self.dict_u8 is not None:
-            col.dict_u8 = jnp.asarray(self.dict_u8)
-            col.dict_dtype = self.dict_dtype
-        else:
-            col.dict_offsets = jnp.asarray(self.dict_ragged.offsets)
-            col.dict_heap = jnp.asarray(self.dict_ragged.heap)
-        return col
 
-    def _finish_delta(self, common) -> DeviceColumnData:
+        def run(buf_dev):
+            idx = _hybrid_jit(
+                buf_dev, jnp.asarray(ends), jnp.asarray(is_rle),
+                jnp.asarray(rvals), jnp.asarray(starts), width=width,
+                count=prefix,
+            )
+            if prefix and host_max is None:
+                # no native walk: fall back to the deferred on-device range
+                # check (one extra executable + one sync at finalize)
+                self._deferred.append(
+                    (_max_jit(idx), self.dict_len, ".".join(self.leaf.path))
+                )
+            col = DeviceDictColumn(indices=idx, **common)
+            if self.dict_u8 is not None:
+                col.dict_u8 = jnp.asarray(self.dict_u8)
+                col.dict_dtype = self.dict_dtype
+            else:
+                col.dict_offsets = jnp.asarray(self.dict_ragged.offsets)
+                col.dict_heap = jnp.asarray(self.dict_ragged.heap)
+            return col
+
+        return run
+
+    def _finish_delta(self, common, stager):
         ptype = self.leaf.physical_type
         if ptype not in (Type.INT32, Type.INT64):
             raise ParquetError(f"DELTA_BINARY_PACKED invalid for {ptype!r}")
         bits = 32 if ptype == Type.INT32 else 64
-        buf, bases = self._value_buffer()
+        bases = self._value_segments(stager)
         metas = []
         for p, base in zip(self.pages, bases):
             m = parse_delta_meta(p.raw[p.value_pos :], bits)
@@ -354,17 +492,21 @@ class _ChunkAssembler:
             widths[i, :kk] = m.mini_widths
             mins[i, :kk] = m.mini_min_delta
             firsts[i] = m.first_value
-        flat = _delta_pages_jit(
-            jnp.asarray(buf), jnp.asarray(firsts), jnp.asarray(starts),
-            jnp.asarray(widths), jnp.asarray(mins),
-            values_per_mini=metas[0].values_per_mini, count=count, bits=bits,
-            max_width=max(1, int(widths.max(initial=0))),
-            defined=tuple(p.defined for p in self.pages),
+        defined = tuple(p.defined for p in self.pages)
+        return lambda buf_dev: DeviceColumnData(
+            values=_delta_pages_jit(
+                buf_dev, jnp.asarray(firsts), jnp.asarray(starts),
+                jnp.asarray(widths), jnp.asarray(mins),
+                values_per_mini=metas[0].values_per_mini, count=count,
+                bits=bits, max_width=max(1, int(widths.max(initial=0))),
+                defined=defined,
+            ),
+            **common,
         )
-        return DeviceColumnData(values=flat, **common)
 
-    def _finish_host(self, common) -> DeviceColumnData:
-        """Host decode per page (byte arrays, INT96, BSS, boolean RLE, mixed)."""
+    def _finish_host(self, common):
+        """Host decode per page (byte arrays, INT96, BSS, boolean RLE, mixed);
+        per-chunk staging, independent of the row-group buffer."""
         from .jax_decode import DeviceChunkDecoder
 
         helper = DeviceChunkDecoder(self.leaf)
@@ -401,15 +543,15 @@ class _ChunkAssembler:
             )
         else:
             out.values = jnp.asarray(np.zeros(0, dtype=np.int64))
-        return out
+        return lambda buf_dev: out
 
 
 @scoped_x64
-def decode_chunk_batched(
+def _collect_chunk(
     buf: bytes, codec: int, total_values: int, leaf: SchemaNode,
     deferred_checks: list, validate_crc: bool = False,
-) -> DeviceColumnData:
-    """Decode one chunk with per-chunk fused dispatch (no blocking syncs)."""
+) -> Optional[_ChunkAssembler]:
+    """Walk a chunk's pages into an assembler (host phase); None if no data."""
     asm = _ChunkAssembler(leaf, deferred_checks)
     for ps in walk_pages(buf, total_values):
         header = ps.header
@@ -427,12 +569,25 @@ def decode_chunk_batched(
             )
             continue
         # index/unknown pages: skip
-    if not asm.pages:
+    return asm if asm.pages else None
+
+
+@scoped_x64
+def decode_chunk_batched(
+    buf: bytes, codec: int, total_values: int, leaf: SchemaNode,
+    deferred_checks: list, validate_crc: bool = False,
+) -> DeviceColumnData:
+    """Decode one chunk with per-chunk fused dispatch (no blocking syncs)."""
+    asm = _collect_chunk(buf, codec, total_values, leaf, deferred_checks,
+                         validate_crc)
+    if asm is None:
         return DeviceColumnData(
             values=jnp.asarray(np.zeros(0, dtype=np.int64)),
             max_def=leaf.max_def, max_rep=leaf.max_rep, num_leaf_slots=0,
         )
-    return asm.finish()
+    stager = _RowGroupStager()
+    run = asm.finish(stager)
+    return run(stager.stage())
 
 
 class DeviceFileReader:
@@ -467,11 +622,21 @@ class DeviceFileReader:
         return self._host.num_row_groups
 
     @scoped_x64
-    def read_row_group(self, index: int, finalize: bool = True):
+    def _prepare_row_group(self, index: int):
+        """Host phase: decompress + parse every chunk of the row group,
+        registering all byte regions with ONE stager.
+
+        No device calls on the common paths (plain/bool/bytes/dict/delta);
+        the _finish_host fallback (mixed encodings, FLBA, INT96, delta byte
+        arrays) still stages per chunk eagerly here and is therefore NOT
+        overlapped by the iter_row_groups pipeline.
+        """
         rg = self.metadata.row_groups[index]
         leaves = {l.path: l for l in self.schema.selected_leaves()}
         out: dict[str, DeviceColumnData] = {}
         f = self._host._f
+        stager = _RowGroupStager()
+        plans: list[tuple[str, object]] = []
         for chunk in rg.columns or []:
             md = chunk.meta_data
             if md is None or md.path_in_schema is None:
@@ -485,10 +650,34 @@ class DeviceFileReader:
             buf = f.read(md.total_compressed_size)
             if len(buf) != md.total_compressed_size:
                 raise ParquetError("chunk truncated")
-            out[".".join(path)] = decode_chunk_batched(
+            asm = _collect_chunk(
                 buf, md.codec, md.num_values, leaf, self._deferred,
                 validate_crc=self.validate_crc,
             )
+            name = ".".join(path)
+            if asm is None:
+                out[name] = DeviceColumnData(
+                    values=jnp.asarray(np.zeros(0, dtype=np.int64)),
+                    max_def=leaf.max_def, max_rep=leaf.max_rep,
+                    num_leaf_slots=0,
+                )
+                continue
+            plans.append((name, asm.finish(stager)))
+        return out, plans, stager
+
+    @scoped_x64
+    def _dispatch_row_group(self, prepared, buf_dev=None):
+        out, plans, stager = prepared
+        if plans:
+            if buf_dev is None:
+                buf_dev = stager.stage()
+            for name, run in plans:
+                out[name] = run(buf_dev)
+        return out
+
+    @scoped_x64
+    def read_row_group(self, index: int, finalize: bool = True):
+        out = self._dispatch_row_group(self._prepare_row_group(index))
         if finalize:
             self.finalize()
         return out
@@ -511,6 +700,36 @@ class DeviceFileReader:
         self._deferred = []
 
     def iter_row_groups(self, finalize_each: bool = False):
-        for i in range(self.num_row_groups):
-            yield self.read_row_group(i, finalize=finalize_each)
+        """Iterate row groups with a one-deep transfer pipeline.
+
+        Staging (host→device transfer) of row group N runs on a worker thread
+        while the main thread decompresses and parses row group N+1 — the
+        tunneled backend serializes transfers with its queue, so overlapping
+        them with host work is the difference between sum and max of the two
+        phases.  The stager buffers are plain uint8, so the worker thread
+        needs no x64 scope.
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        n = self.num_row_groups
+        if n == 0:
+            self.finalize()
+            return
+        with ThreadPoolExecutor(1) as ex:
+            prev = None  # (prepared, future staging the device buffer)
+            for i in range(n):
+                prepared = self._prepare_row_group(i)
+                fut = ex.submit(prepared[2].stage) if prepared[1] else None
+                if prev is not None:
+                    p_prepared, p_fut = prev
+                    yield self._dispatch_row_group(
+                        p_prepared, p_fut.result() if p_fut else None
+                    )
+                    if finalize_each:
+                        self.finalize()
+                prev = (prepared, fut)
+            p_prepared, p_fut = prev
+            yield self._dispatch_row_group(
+                p_prepared, p_fut.result() if p_fut else None
+            )
         self.finalize()

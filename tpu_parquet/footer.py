@@ -22,8 +22,7 @@ MAGIC_ENCRYPTED = b"PARE"
 FOOTER_TAIL = 8  # uint32 footer length + 4-byte magic
 
 
-class ParquetError(ValueError):
-    """Malformed parquet input."""
+from .errors import ParquetError  # noqa: F401  (canonical home: errors.py)
 
 
 def read_file_metadata(

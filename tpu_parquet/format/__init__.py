@@ -74,6 +74,21 @@ class Encoding(enum.IntEnum):
     BYTE_STREAM_SPLIT = 9
 
 
+def parse_encoding(value, what: str = "value encoding") -> "Encoding":
+    """Convert an untrusted thrift field to Encoding, or raise ParquetError.
+
+    Encoding fields are optional in the wire metadata and attacker-controlled;
+    a bare enum ValueError would escape the unified error contract
+    (errors.ParquetError) — found by the file_reader fuzz target.
+    """
+    from ..errors import ParquetError
+
+    try:
+        return Encoding(value)
+    except (ValueError, TypeError):
+        raise ParquetError(f"unknown {what} {value!r}") from None
+
+
 class CompressionCodec(enum.IntEnum):
     UNCOMPRESSED = 0
     SNAPPY = 1

@@ -12,6 +12,8 @@ host with numpy (SURVEY.md §7.4.4 hard-part ranking).
 
 from __future__ import annotations
 
+from ..errors import ParquetError
+
 import numpy as np
 
 from ..column import ByteArrayData
@@ -25,7 +27,7 @@ __all__ = [
 ]
 
 
-class ByteArrayError(ValueError):
+class ByteArrayError(ParquetError):
     pass
 
 

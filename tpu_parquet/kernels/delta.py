@@ -20,6 +20,8 @@ miniblocks_per_block=4 (chunk_writer.go:53-57).
 
 from __future__ import annotations
 
+from ..errors import ParquetError
+
 import numpy as np
 
 from . import bitpack
@@ -27,7 +29,7 @@ from . import bitpack
 __all__ = ["decode", "encode"]
 
 
-class DeltaError(ValueError):
+class DeltaError(ParquetError):
     pass
 
 

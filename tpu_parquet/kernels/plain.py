@@ -13,6 +13,8 @@ vectorized two-pass implementation below.
 
 from __future__ import annotations
 
+from ..errors import ParquetError
+
 import numpy as np
 
 from ..column import ByteArrayData
@@ -21,7 +23,7 @@ from ..format import Type
 __all__ = ["decode", "encode", "decode_byte_array", "encode_byte_array"]
 
 
-class PlainError(ValueError):
+class PlainError(ParquetError):
     pass
 
 
@@ -78,8 +80,21 @@ def decode_byte_array(buf: bytes, count: int) -> ByteArrayData:
 
     The prefix walk is inherently sequential (each length tells where the next
     one is), but only over ``count`` header positions — two passes over a small
-    int array, no per-byte Python loop.
+    int array, no per-byte Python loop.  Runs in C when the native library is
+    available (native/meta_parse.cpp tpq_bytearray_walk, identical semantics);
+    the Python walk below is the reference and no-toolchain fallback.
     """
+    if count > 0:
+        from .. import native
+
+        res = native.bytearray_walk(bytes(buf), count)
+        if isinstance(res, tuple):
+            offsets, heap = res
+            return ByteArrayData(offsets=offsets, heap=heap)
+        if isinstance(res, int):
+            if res == -20:
+                raise PlainError("byte array: truncated length prefix")
+            raise PlainError("byte array: length exceeds buffer")
     data = np.frombuffer(buf, dtype=np.uint8)
     n = len(data)
     starts = np.empty(count, dtype=np.int64)

@@ -17,6 +17,8 @@ bulky expansion can also run on device with static shapes.
 
 from __future__ import annotations
 
+from ..errors import ParquetError
+
 import io
 from dataclasses import dataclass
 
@@ -27,7 +29,7 @@ from . import bitpack
 __all__ = ["decode", "encode", "decode_prefixed", "parse_runs", "RunList"]
 
 
-class RLEError(ValueError):
+class RLEError(ParquetError):
     pass
 
 

@@ -78,6 +78,11 @@ def load():
             ]
             c_ll = ctypes.c_longlong
             p = ctypes.POINTER
+            lib.tpq_bytearray_walk.restype = c_ll
+            lib.tpq_bytearray_walk.argtypes = [
+                ctypes.c_char_p, c_ll, c_ll, p(ctypes.c_longlong),
+                p(ctypes.c_uint8),
+            ]
             lib.tpq_delta_meta.restype = c_ll
             lib.tpq_delta_meta.argtypes = [
                 ctypes.c_char_p, c_ll, c_ll, p(ctypes.c_longlong),
@@ -193,6 +198,34 @@ def hybrid_meta(buf: bytes, n: int, pos: int, width: int, count: int, cap: int,
     r = int(rc)
     mx = int(max_out[0]) if want_max else None
     return r, int(consumed[0]), ends[:r], kinds[:r], vals[:r], starts[:r], mx
+
+
+def bytearray_walk(buf: bytes, count: int):
+    """Walk PLAIN BYTE_ARRAY length prefixes natively (meta_parse.cpp).
+
+    Returns (offsets int64[count+1], heap uint8[total]) with prefixes
+    stripped, a negative error code (int), or None when the native library is
+    unavailable.
+    """
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    n = len(buf)
+    offsets = np.empty(count + 1, dtype=np.int64)
+    # upper bound is n, NOT n - 4*count: a malformed stream can run out of
+    # records midway, after legitimately copying up to ~n payload bytes
+    # (found by fuzz_plain — the tighter bound corrupted the heap allocation)
+    heap = np.empty(n, dtype=np.uint8)
+    rc = lib.tpq_bytearray_walk(
+        buf, n, count,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        heap.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    if rc < 0:
+        return int(rc)
+    return offsets, heap[: int(rc)]
 
 
 def available() -> bool:

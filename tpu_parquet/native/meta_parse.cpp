@@ -217,4 +217,28 @@ i64 tpq_hybrid_meta(const u8 *buf, i64 n, i64 pos, i64 width, i64 count,
     return n_runs;
 }
 
+// Walk `count` PLAIN BYTE_ARRAY values (uint32 LE length prefix + bytes,
+// type_bytearray.go:13-96 wire shape) starting at buf[0]: validate prefixes,
+// write offsets[count+1] (cumulative value lengths) and compact the value
+// bytes into heap (prefixes stripped).  heap must hold >= n - 4*count bytes
+// (the caller allocates the upper bound).  Returns total heap bytes, or a
+// negative error (ERR_TRUNC_PREFIX / ERR_LEN_RANGE).
+i64 tpq_bytearray_walk(const u8 *buf, i64 n, i64 count, i64 *offsets,
+                       u8 *heap) {
+    i64 pos = 0, total = 0;
+    offsets[0] = 0;
+    for (i64 i = 0; i < count; i++) {
+        if (pos + 4 > n) return -20;  // truncated length prefix
+        u32 ln = (u32)buf[pos] | ((u32)buf[pos + 1] << 8) |
+                 ((u32)buf[pos + 2] << 16) | ((u32)buf[pos + 3] << 24);
+        if ((u128)pos + 4 + ln > (u128)n) return -21;  // length exceeds buffer
+        pos += 4;
+        __builtin_memcpy(heap + total, buf + pos, ln);
+        pos += ln;
+        total += ln;
+        offsets[i + 1] = total;
+    }
+    return total;
+}
+
 }  // extern "C"

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..format import Type
 from .. import jax_kernels as K
 from ..jax_kernels import scoped_x64
 from ..jax_decode import HybridMeta, DeltaMeta, parse_hybrid_meta, parse_delta_meta, _bucket, _SLACK
@@ -47,6 +48,10 @@ __all__ = [
     "sharded_delta_decode",
     "sharded_plain_decode",
     "column_stats",
+    "shard_row_ranges",
+    "decode_row_span",
+    "global_column_array",
+    "process_local_column",
 ]
 
 
@@ -387,3 +392,156 @@ def column_stats(values: jax.Array, mesh: Mesh, axis: str = "data"):
         check_vma=False,
     )
     return fn(values)
+
+
+# ---------------------------------------------------------------------------
+# Multi-host work list → global sharded array (SURVEY.md §5.8)
+# ---------------------------------------------------------------------------
+
+def shard_row_ranges(total_rows: int, n_shards: int) -> list[tuple[int, int]]:
+    """Contiguous, equal-size row spans, one per shard (last may be short).
+
+    Equal spans are what a NamedSharding over the row axis requires; each
+    shard decodes only the row groups its span touches (boundary groups are
+    decoded by both neighbors and sliced — the standard input-pipeline trade
+    against cross-host exchange).  Deterministic from (total_rows, n_shards),
+    so every host derives the identical plan from the footer alone — DCN
+    carries no work-list coordination, matching SURVEY.md §5.8.
+    """
+    if n_shards <= 0:
+        raise ValueError(f"n_shards must be positive, got {n_shards}")
+    per = -(-total_rows // n_shards) if total_rows else 0
+    return [
+        (min(i * per, total_rows), min((i + 1) * per, total_rows))
+        for i in range(n_shards)
+    ]
+
+
+_FIXED_DTYPES = {
+    Type.INT32: np.dtype(np.int32),
+    Type.INT64: np.dtype(np.int64),
+    Type.FLOAT: np.dtype(np.float32),
+    Type.DOUBLE: np.dtype(np.float64),
+    Type.BOOLEAN: np.dtype(bool),
+}
+
+
+def column_span_dtype(reader, column: str) -> np.dtype:
+    """The numpy dtype a flat column decodes to — derivable from the schema
+    alone, so shards with EMPTY spans pad with the right dtype without
+    decoding anything."""
+    leaf = reader.schema.leaf_by_path(tuple(column.split(".")))
+    if leaf is None:
+        raise KeyError(f"no such column {column!r}")
+    dt = _FIXED_DTYPES.get(leaf.physical_type)
+    if dt is None:
+        raise TypeError(
+            f"global span decode needs a fixed-width column; {column!r} is "
+            f"{leaf.physical_type!r}"
+        )
+    return dt
+
+
+def decode_row_span(reader, column: str, row_start: int, row_end: int) -> np.ndarray:
+    """Decode exactly rows [row_start, row_end) of a flat column on host.
+
+    Touches only the row groups the span intersects (others are never read —
+    the skipChunk discipline of chunk_reader.go:271-297 at row-group
+    granularity) and slices boundary groups.  Column selection is narrowed to
+    the one requested column for the duration of the call, so sibling chunks
+    in touched row groups are seeked past, not decoded.
+    """
+    dtype = column_span_dtype(reader, column)
+    parts = []
+    base = 0
+    prev_selected = [tuple(l.path) for l in reader.schema.selected_leaves()]
+    reader.schema.set_selected([tuple(column.split("."))])
+    try:
+        for i, rg in enumerate(reader.metadata.row_groups):
+            n = rg.num_rows
+            lo, hi = max(row_start - base, 0), min(row_end - base, n)
+            if lo < hi:
+                cd = reader.read_row_group(i)[column]
+                vals = cd.values
+                if len(vals) != n:
+                    raise ValueError(
+                        f"decode_row_span requires a flat required column; "
+                        f"{column!r} has {len(vals)} values for {n} rows"
+                    )
+                parts.append(np.asarray(vals)[lo:hi])
+            base += n
+            if base >= row_end:
+                break
+    finally:
+        reader.schema.set_selected(prev_selected)
+    if not parts:
+        return np.zeros(0, dtype=dtype)
+    return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+@scoped_x64
+def global_column_array(
+    reader, column: str, mesh: Mesh, axis: str = "data"
+) -> tuple[jax.Array, int]:
+    """Work-list → one global row-sharded device array (single-host form).
+
+    Every addressable device in ``mesh`` stands in for one shard of the work
+    list: shard i decodes its row span on host and its slice is placed on its
+    device; ``jax.make_array_from_single_device_arrays`` stitches the global
+    view without any cross-device exchange (row groups are assigned, not
+    traded — SURVEY.md §5.7/5.8).  Returns (global_array, valid_rows):
+    the tail shard is zero-padded to the uniform span size, so the global
+    length is per*n — consumers mask with valid_rows.
+    """
+    total = int(reader.metadata.num_rows)
+    devs = list(mesh.devices.flat)
+    n = len(devs)
+    spans = shard_row_ranges(total, n)
+    per = spans[0][1] - spans[0][0] if total else 0
+    sharding = NamedSharding(mesh, P(axis))
+    dtype = column_span_dtype(reader, column)
+    pieces = []
+    for (lo, hi), dev in zip(spans, devs):
+        local = decode_row_span(reader, column, lo, hi)
+        if len(local) < per:  # tail/empty padding to the uniform shard size
+            local = np.concatenate(
+                [local.astype(dtype), np.zeros(per - len(local), dtype=dtype)]
+            )
+        pieces.append(jax.device_put(local, dev))
+    if not per:
+        return jnp.zeros((0,), dtype=jnp.int64), 0
+    global_shape = (per * n,)
+    arr = jax.make_array_from_single_device_arrays(global_shape, sharding, pieces)
+    return arr, total
+
+
+@scoped_x64
+def process_local_column(
+    reader, column: str, mesh: Mesh, axis: str = "data"
+) -> tuple[jax.Array, int]:
+    """True multi-host form: this process decodes only ITS span of the work
+    list and contributes it via ``jax.make_array_from_process_local_data``.
+
+    Each host computes the identical plan from the shared footer
+    (shard_row_ranges over jax.process_count()), decodes the rows owned by
+    its process, and the runtime assembles the global sharded array — the
+    decode path's only cross-host traffic is the ICI/DCN assembly the
+    consumer's pjit triggers.  On a single-process mesh this degrades to
+    decoding everything locally, so the same code serves tests and clusters.
+    """
+    total = int(reader.metadata.num_rows)
+    nproc = jax.process_count()
+    spans = shard_row_ranges(total, nproc)
+    lo, hi = spans[jax.process_index()]
+    per = spans[0][1] - spans[0][0] if total else 0
+    local = decode_row_span(reader, column, lo, hi)
+    if len(local) < per:
+        dtype = column_span_dtype(reader, column)
+        local = np.concatenate(
+            [local.astype(dtype), np.zeros(per - len(local), dtype=dtype)]
+        )
+    sharding = NamedSharding(mesh, P(axis))
+    arr = jax.make_array_from_process_local_data(
+        sharding, local, (per * nproc,)
+    )
+    return arr, total

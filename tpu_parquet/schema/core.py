@@ -9,6 +9,8 @@ LIST/MAP-convention constructors (schema.go:582-647).
 
 from __future__ import annotations
 
+from ..errors import ParquetError
+
 from dataclasses import dataclass, field
 from typing import Iterable, Optional, Sequence
 
@@ -21,7 +23,7 @@ from ..format import (
 )
 
 
-class SchemaError(ValueError):
+class SchemaError(ParquetError):
     pass
 
 
@@ -72,12 +74,22 @@ class SchemaNode:
     @property
     def repetition(self) -> FieldRepetitionType:
         rt = self.element.repetition_type
-        return FieldRepetitionType(rt if rt is not None else FieldRepetitionType.REQUIRED)
+        try:
+            return FieldRepetitionType(
+                rt if rt is not None else FieldRepetitionType.REQUIRED
+            )
+        except ValueError:
+            raise SchemaError(f"invalid repetition type {rt!r}") from None
 
     @property
     def physical_type(self) -> Optional[Type]:
         t = self.element.type
-        return None if t is None else Type(t)
+        if t is None:
+            return None
+        try:
+            return Type(t)
+        except ValueError:
+            raise SchemaError(f"invalid physical type {t!r}") from None
 
     @property
     def type_length(self) -> int:
@@ -86,7 +98,12 @@ class SchemaNode:
     @property
     def converted_type(self) -> Optional[ConvertedType]:
         c = self.element.converted_type
-        return None if c is None else ConvertedType(c)
+        if c is None:
+            return None
+        try:
+            return ConvertedType(c)
+        except ValueError:
+            raise SchemaError(f"invalid converted type {c!r}") from None
 
     @property
     def logical_type(self) -> Optional[LogicalType]:

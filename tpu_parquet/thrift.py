@@ -24,6 +24,8 @@ raise ``ThriftError`` instead of crashing, and containers are size-sanity-checke
 
 from __future__ import annotations
 
+from .errors import ParquetError
+
 import struct as _struct
 from typing import Any, Callable, Optional
 
@@ -39,7 +41,7 @@ __all__ = [
 ]
 
 
-class ThriftError(ValueError):
+class ThriftError(ParquetError):
     """Raised on malformed thrift input (truncated, oversized, or type-confused)."""
 
 
