@@ -264,6 +264,11 @@ def bench_device(path, rows):
         dt = time.perf_counter() - t0
         log(f"  device rep {i}: {dt:.3f}s ({rows/dt/1e6:.2f} M rows/s)")
         best = min(best, dt)
+    # observability counters from one instrumented pass (SURVEY.md §5.5)
+    with DeviceFileReader(path) as r:
+        for cols in r.iter_row_groups():
+            pass
+        log(f"  reader stats: {r.stats().as_dict()}")
     return best
 
 
@@ -330,15 +335,16 @@ def main():
         if name == "lineitem16":
             headline = r
 
+    headline_name = "lineitem16"
     if headline is None:  # config 4 not run: fall back to the first result
         if not results:
             print(json.dumps({"metric": "no_valid_configs", "value": 0.0,
                               "unit": "rows/s", "vs_baseline": 0.0,
                               "configs": {}}))
             sys.exit(1)
-        headline = next(iter(results.values()))
+        headline_name, headline = next(iter(results.items()))
     print(json.dumps({
-        "metric": "lineitem16_decode_rows_per_sec_device",
+        "metric": f"{headline_name}_decode_rows_per_sec_device",
         "value": headline["device_rows_per_sec"],
         "unit": "rows/s",
         "vs_baseline": headline["device_vs_host"],

@@ -1,0 +1,47 @@
+"""High-level object API: dataclasses in, dataclasses out.
+
+Mirror of the reference's examples/high-level-reflection/main.go — the floor
+layer marshals typed records (reflection there, dataclass fields here) and
+scans them back.
+
+    python examples/high_level_dataclass.py [output.parquet]
+"""
+
+import sys
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+from dataclasses import dataclass
+
+from tpu_parquet import floor
+from tpu_parquet.schema.dsl import parse_schema_definition
+
+SCHEMA = parse_schema_definition("""
+message record {
+    required binary name (STRING);
+    optional binary data;
+    required double score;
+}
+""")
+
+
+@dataclass
+class Record:
+    name: str
+    data: bytes
+    score: float
+
+
+def main(path: str = "output.parquet") -> None:
+    rows = [
+        Record(name="Test", data=bytes([0xFF, 0x0A, 0x8E, 0x00, 0x12]), score=23.5),
+        Record(name="Second", data=b"", score=-1.5),
+    ]
+    with floor.Writer(path, SCHEMA) as w:
+        w.write_many(rows)
+    with floor.Reader(path, Record) as r:
+        for rec in r.scan_all(Record):
+            print(rec)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "output.parquet")

@@ -1,0 +1,34 @@
+"""Low-level read: open a file, print its schema and every row.
+
+Mirror of the reference's examples/read-low-level/main.go:27-63 — iterate
+``FileReader.iter_rows()`` (NextRow parity) and print each record's fields.
+
+    python examples/read_low_level.py file1.parquet [file2.parquet ...]
+"""
+
+import sys
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+from tpu_parquet.reader import FileReader
+from tpu_parquet.schema.dsl import schema_to_string
+
+
+def print_file(path: str) -> None:
+    with FileReader(path) as r:
+        print(f"Printing file {path}")
+        print(f"Schema: {schema_to_string(r.schema)}")
+        for count, row in enumerate(r.iter_rows()):
+            print(f"Record {count}:")
+            for k, v in row.items():
+                if isinstance(v, bytes):
+                    v = v.decode("utf-8", errors="replace")
+                print(f"\t{k} = {v}")
+        print(f"End of file {path} ({count + 1} records)")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        sys.exit(f"usage: {sys.argv[0]} file.parquet [...]")
+    for f in sys.argv[1:]:
+        print_file(f)

@@ -1,0 +1,44 @@
+"""Low-level write: schema DSL + row maps → parquet file.
+
+Mirror of the reference's examples/write-low-level/main.go:22-58 — parse a
+message schema, write row maps with SNAPPY, close (footer written once).
+
+    python examples/write_low_level.py [output.parquet]
+"""
+
+import sys
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+from tpu_parquet.format import CompressionCodec
+from tpu_parquet.schema.dsl import parse_schema_definition
+from tpu_parquet.writer import FileWriter
+
+SCHEMA = parse_schema_definition("""
+message test {
+    required int64 id;
+    required binary city (STRING);
+    optional int64 population;
+}
+""")
+
+CITIES = [
+    (1, b"Berlin", 3_520_031),
+    (2, b"Hamburg", 1_787_408),
+    (3, b"Munich", 1_450_381),
+    (4, b"Cologne", 1_060_582),
+    (5, b"Frankfurt", 732_688),
+]
+
+
+def main(path: str = "output.parquet") -> None:
+    with FileWriter(
+        path, SCHEMA, codec=CompressionCodec.SNAPPY, created_by="write-lowlevel"
+    ) as w:
+        for id_, city, pop in CITIES:
+            w.write_row({"id": id_, "city": city, "population": pop})
+    print(f"wrote {len(CITIES)} rows to {path}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "output.parquet")

@@ -250,3 +250,31 @@ def test_batched_reader_corrupt_dict_index_deferred_fallback(monkeypatch):
     assert deferred, "deferred check must be recorded"
     mx, dict_len, path = deferred[0]
     assert int(np.asarray(mx)) == 9 and dict_len == 4
+
+
+def test_reader_stats(tmp_path):
+    """Observability counters (SURVEY.md §5.5): rows, pages/chunk, staged
+    bytes, throughput — populated after a full read."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    p = tmp_path / "s.parquet"
+    pq.write_table(
+        pa.table({"a": np.arange(20000, dtype=np.int64),
+                  "b": np.arange(20000, dtype=np.int64) * 2}),
+        p, compression="snappy", row_group_size=6000, use_dictionary=False,
+    )
+    with DeviceFileReader(p) as r:
+        for cols in r.iter_row_groups():
+            pass
+        st = r.stats()
+    assert st.row_groups == 4
+    assert st.chunks == 8
+    assert st.rows == 20000
+    assert st.pages >= st.chunks
+    assert st.compressed_bytes > 0
+    assert st.staged_bytes >= 2 * 8 * 20000  # both int64 columns staged
+    assert st.wall_seconds > 0 and st.rows_per_sec > 0
+    assert st.pages_per_chunk >= 1.0
+    d = st.as_dict()
+    assert d["rows"] == 20000 and d["bytes_per_sec"] > 0

@@ -48,7 +48,7 @@ from .jax_decode import (
 )
 from .schema.core import SchemaNode
 
-__all__ = ["DeviceFileReader", "decode_chunk_batched", "DeviceDictColumn"]
+__all__ = ["DeviceFileReader", "ReaderStats", "decode_chunk_batched", "DeviceDictColumn"]
 
 
 @dataclass
@@ -590,6 +590,50 @@ def decode_chunk_batched(
     return run(stager.stage())
 
 
+@dataclass
+class ReaderStats:
+    """Decode observability counters (SURVEY.md §5.5 — the subsystem the
+    reference lacks entirely).  Accumulated per DeviceFileReader; throughput
+    properties divide by wall time from first host parse to last dispatch."""
+
+    row_groups: int = 0
+    chunks: int = 0
+    pages: int = 0
+    rows: int = 0
+    compressed_bytes: int = 0      # chunk bytes read from the file
+    staged_bytes: int = 0          # HBM bytes shipped (row-group buffers)
+    host_seconds: float = 0.0      # decompress + structure parse + assembly
+    device_seconds: float = 0.0    # stage + dispatch (not queue drain)
+    wall_seconds: float = 0.0
+
+    @property
+    def rows_per_sec(self) -> float:
+        return self.rows / self.wall_seconds if self.wall_seconds else 0.0
+
+    @property
+    def bytes_per_sec(self) -> float:
+        return (self.compressed_bytes / self.wall_seconds
+                if self.wall_seconds else 0.0)
+
+    @property
+    def pages_per_chunk(self) -> float:
+        return self.pages / self.chunks if self.chunks else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "row_groups": self.row_groups, "chunks": self.chunks,
+            "pages": self.pages, "rows": self.rows,
+            "compressed_bytes": self.compressed_bytes,
+            "staged_bytes": self.staged_bytes,
+            "host_seconds": round(self.host_seconds, 6),
+            "device_seconds": round(self.device_seconds, 6),
+            "wall_seconds": round(self.wall_seconds, 6),
+            "rows_per_sec": round(self.rows_per_sec, 1),
+            "bytes_per_sec": round(self.bytes_per_sec, 1),
+            "pages_per_chunk": round(self.pages_per_chunk, 3),
+        }
+
+
 class DeviceFileReader:
     """Columnar file reader decoding straight to device arrays.
 
@@ -607,6 +651,8 @@ class DeviceFileReader:
         self.schema = self._host.schema
         self.validate_crc = validate_crc
         self._deferred: list = []
+        self._stats = ReaderStats()
+        self._t0: float | None = None
 
     def close(self):
         self._host.close()
@@ -632,6 +678,11 @@ class DeviceFileReader:
         overlapped by the iter_row_groups pipeline.
         """
         rg = self.metadata.row_groups[index]
+        import time as _time
+
+        t0 = _time.perf_counter()
+        if self._t0 is None:
+            self._t0 = t0
         leaves = {l.path: l for l in self.schema.selected_leaves()}
         out: dict[str, DeviceColumnData] = {}
         f = self._host._f
@@ -650,10 +701,14 @@ class DeviceFileReader:
             buf = f.read(md.total_compressed_size)
             if len(buf) != md.total_compressed_size:
                 raise ParquetError("chunk truncated")
+            self._stats.chunks += 1
+            self._stats.compressed_bytes += md.total_compressed_size
             asm = _collect_chunk(
                 buf, md.codec, md.num_values, leaf, self._deferred,
                 validate_crc=self.validate_crc,
             )
+            if asm is not None:
+                self._stats.pages += len(asm.pages)
             name = ".".join(path)
             if asm is None:
                 out[name] = DeviceColumnData(
@@ -663,17 +718,34 @@ class DeviceFileReader:
                 )
                 continue
             plans.append((name, asm.finish(stager)))
+        self._stats.row_groups += 1
+        self._stats.rows += rg.num_rows or 0
+        self._stats.staged_bytes += stager.total
+        now = _time.perf_counter()
+        self._stats.host_seconds += now - t0
+        self._stats.wall_seconds = now - self._t0
         return out, plans, stager
 
     @scoped_x64
     def _dispatch_row_group(self, prepared, buf_dev=None):
+        import time as _time
+
+        t0 = _time.perf_counter()
         out, plans, stager = prepared
         if plans:
             if buf_dev is None:
                 buf_dev = stager.stage()
             for name, run in plans:
                 out[name] = run(buf_dev)
+        now = _time.perf_counter()
+        self._stats.device_seconds += now - t0
+        if self._t0 is not None:
+            self._stats.wall_seconds = now - self._t0
         return out
+
+    def stats(self) -> ReaderStats:
+        """Decode counters so far (rows/s, bytes/s, pages/chunk, HBM staged)."""
+        return self._stats
 
     @scoped_x64
     def read_row_group(self, index: int, finalize: bool = True):
@@ -715,11 +787,21 @@ class DeviceFileReader:
         if n == 0:
             self.finalize()
             return
+        def timed_stage(stager):
+            import time as _time
+
+            t0 = _time.perf_counter()
+            buf_dev = stager.stage()
+            # GIL-atomic float add: staging cost must show up in the counters
+            # even when it runs on the worker thread
+            self._stats.device_seconds += _time.perf_counter() - t0
+            return buf_dev
+
         with ThreadPoolExecutor(1) as ex:
             prev = None  # (prepared, future staging the device buffer)
             for i in range(n):
                 prepared = self._prepare_row_group(i)
-                fut = ex.submit(prepared[2].stage) if prepared[1] else None
+                fut = ex.submit(timed_stage, prepared[2]) if prepared[1] else None
                 if prev is not None:
                     p_prepared, p_fut = prev
                     yield self._dispatch_row_group(

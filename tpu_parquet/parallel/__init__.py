@@ -479,6 +479,15 @@ def decode_row_span(reader, column: str, row_start: int, row_end: int) -> np.nda
     return np.concatenate(parts) if len(parts) > 1 else parts[0]
 
 
+def _pad_span(local: np.ndarray, per: int, dtype: np.dtype) -> np.ndarray:
+    """Zero-pad a decoded span to the uniform shard size (tail/empty shards)."""
+    if len(local) >= per:
+        return local
+    return np.concatenate(
+        [local.astype(dtype), np.zeros(per - len(local), dtype=dtype)]
+    )
+
+
 @scoped_x64
 def global_column_array(
     reader, column: str, mesh: Mesh, axis: str = "data"
@@ -500,16 +509,13 @@ def global_column_array(
     per = spans[0][1] - spans[0][0] if total else 0
     sharding = NamedSharding(mesh, P(axis))
     dtype = column_span_dtype(reader, column)
-    pieces = []
-    for (lo, hi), dev in zip(spans, devs):
-        local = decode_row_span(reader, column, lo, hi)
-        if len(local) < per:  # tail/empty padding to the uniform shard size
-            local = np.concatenate(
-                [local.astype(dtype), np.zeros(per - len(local), dtype=dtype)]
-            )
-        pieces.append(jax.device_put(local, dev))
     if not per:
-        return jnp.zeros((0,), dtype=jnp.int64), 0
+        return jnp.zeros((0,), dtype=dtype), 0
+    pieces = [
+        jax.device_put(_pad_span(decode_row_span(reader, column, lo, hi),
+                                 per, dtype), dev)
+        for (lo, hi), dev in zip(spans, devs)
+    ]
     global_shape = (per * n,)
     arr = jax.make_array_from_single_device_arrays(global_shape, sharding, pieces)
     return arr, total
@@ -534,12 +540,8 @@ def process_local_column(
     spans = shard_row_ranges(total, nproc)
     lo, hi = spans[jax.process_index()]
     per = spans[0][1] - spans[0][0] if total else 0
-    local = decode_row_span(reader, column, lo, hi)
-    if len(local) < per:
-        dtype = column_span_dtype(reader, column)
-        local = np.concatenate(
-            [local.astype(dtype), np.zeros(per - len(local), dtype=dtype)]
-        )
+    local = _pad_span(decode_row_span(reader, column, lo, hi), per,
+                      column_span_dtype(reader, column))
     sharding = NamedSharding(mesh, P(axis))
     arr = jax.make_array_from_process_local_data(
         sharding, local, (per * nproc,)
