@@ -18,13 +18,14 @@ def print_file(path: str) -> None:
     with FileReader(path) as r:
         print(f"Printing file {path}")
         print(f"Schema: {schema_to_string(r.schema)}")
-        for count, row in enumerate(r.iter_rows()):
-            print(f"Record {count}:")
+        count = 0
+        for count, row in enumerate(r.iter_rows(), start=1):
+            print(f"Record {count - 1}:")
             for k, v in row.items():
                 if isinstance(v, bytes):
                     v = v.decode("utf-8", errors="replace")
                 print(f"\t{k} = {v}")
-        print(f"End of file {path} ({count + 1} records)")
+        print(f"End of file {path} ({count} records)")
 
 
 if __name__ == "__main__":

@@ -787,14 +787,22 @@ class DeviceFileReader:
         if n == 0:
             self.finalize()
             return
+        import threading as _threading
+
+        stats_lock = _threading.Lock()
+
+        def _add_device_seconds(dt: float) -> None:
+            with stats_lock:
+                self._stats.device_seconds += dt
+
         def timed_stage(stager):
             import time as _time
 
             t0 = _time.perf_counter()
             buf_dev = stager.stage()
-            # GIL-atomic float add: staging cost must show up in the counters
-            # even when it runs on the worker thread
-            self._stats.device_seconds += _time.perf_counter() - t0
+            # the worker thread and the dispatching main thread both touch
+            # device_seconds; += is not atomic across bytecodes
+            _add_device_seconds(_time.perf_counter() - t0)
             return buf_dev
 
         with ThreadPoolExecutor(1) as ex:
