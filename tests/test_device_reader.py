@@ -278,3 +278,23 @@ def test_reader_stats(tmp_path):
     assert st.pages_per_chunk >= 1.0
     d = st.as_dict()
     assert d["rows"] == 20000 and d["bytes_per_sec"] > 0
+
+
+def test_profiler_trace_hook(tmp_path):
+    """profile_dir= wraps the decode in a JAX profiler trace (SURVEY §5.1)."""
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    p = tmp_path / "t.parquet"
+    pq.write_table(pa.table({"a": np.arange(1000, dtype=np.int64)}), p,
+                   use_dictionary=False)
+    trace_dir = str(tmp_path / "trace")
+    with DeviceFileReader(p, profile_dir=trace_dir) as r:
+        for cols in r.iter_row_groups():
+            pass
+    found = []
+    for root, _, files in os.walk(trace_dir):
+        found.extend(files)
+    assert found, "profiler trace produced no files"

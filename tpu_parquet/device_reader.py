@@ -151,8 +151,16 @@ class _RowGroupStager:
     """
 
     def __init__(self):
-        self._parts: list[tuple[np.ndarray, int, int]] = []  # (u8, base, reserve)
+        # ("arr", u8, base, nbytes) | ("segs", segments, base, nbytes)
+        self._parts: list[tuple] = []
         self.total = 0
+
+    def _reserve(self, nbytes: int, reserve: int | None) -> int:
+        base = self.total
+        room = max(reserve or 0, nbytes)
+        # keep every region 64-byte aligned for clean device layouts
+        self.total = base + room + (-(base + room)) % 64
+        return base
 
     def add(self, arr: np.ndarray, reserve: int | None = None) -> int:
         """Register a host array; returns its byte offset in the staged buffer.
@@ -161,22 +169,40 @@ class _RowGroupStager:
         device-slice a bucketed size without reading past the arena.
         """
         u8 = arr.reshape(-1).view(np.uint8) if arr.dtype != np.uint8 else arr.reshape(-1)
-        base = self.total
-        nbytes = u8.nbytes
-        room = max(reserve or 0, nbytes)
-        self._parts.append((u8, base, room))
-        # keep every region 64-byte aligned for clean device layouts
-        self.total = base + room + (-(base + room)) % 64
+        base = self._reserve(u8.nbytes, reserve)
+        self._parts.append(("arr", u8, base, u8.nbytes))
         return base
+
+    def add_segments(self, segments: list[tuple[bytes, int, int]]) -> np.ndarray:
+        """Register byte slices (buf, offset, size) laid back to back.
+
+        The slices are copied straight from their source buffers (decompressed
+        page bytes) into the staged buffer during ``stage()`` — no per-chunk
+        intermediate assembly copy.  Returns each slice's absolute byte base.
+        """
+        bases = np.empty(len(segments), dtype=np.int64)
+        nbytes = 0
+        for i, (_, _, size) in enumerate(segments):
+            bases[i] = nbytes
+            nbytes += size
+        base = self._reserve(nbytes, None)
+        self._parts.append(("segs", segments, base, nbytes))
+        return bases + base
 
     def stage(self) -> jax.Array:
         buf = np.empty(_bucket_bytes(self.total + _SLACK, 64), dtype=np.uint8)
         pos = 0
-        for u8, base, room in self._parts:
+        for kind, payload, base, nbytes in self._parts:
             if base > pos:
                 buf[pos:base] = 0
-            buf[base : base + u8.nbytes] = u8
-            pos = base + u8.nbytes
+            if kind == "arr":
+                buf[base : base + nbytes] = payload
+            else:
+                off = base
+                for raw, start, size in payload:
+                    buf[off : off + size] = np.frombuffer(raw, np.uint8, size, start)
+                    off += size
+            pos = base + nbytes
         buf[pos:] = 0
         return jnp.asarray(buf)
 
@@ -280,18 +306,12 @@ class _ChunkAssembler:
 
     def _value_segments(self, stager: _RowGroupStager) -> np.ndarray:
         """Register all pages' value streams back-to-back; returns byte bases
-        (absolute offsets in the staged buffer), int64[P]."""
-        sizes = [len(p.raw) - p.value_pos for p in self.pages]
-        total = sum(sizes)
-        buf = np.empty(total, dtype=np.uint8)
-        bases = np.zeros(len(sizes), dtype=np.int64)
-        pos = 0
-        for i, (p, s) in enumerate(zip(self.pages, sizes)):
-            bases[i] = pos
-            buf[pos : pos + s] = np.frombuffer(p.raw, np.uint8, s, p.value_pos)
-            pos += s
-        base = stager.add(buf)
-        return bases + base
+        (absolute offsets in the staged buffer), int64[P].  The page bytes are
+        copied exactly once, by ``stage()``, straight into the row-group
+        buffer."""
+        return stager.add_segments([
+            (p.raw, p.value_pos, len(p.raw) - p.value_pos) for p in self.pages
+        ])
 
     def _finish_plain_fixed(self, common, stager):
         name = _PTYPE_TO_NAME[self.leaf.physical_type]
@@ -303,15 +323,9 @@ class _ChunkAssembler:
                     f"PLAIN data truncated: {len(p.raw) - p.value_pos} "
                     f"< {p.defined * itemsize}"
                 )
-        # copy exactly the value bytes back-to-back → one contiguous bitcast
-        total = defined * itemsize
-        buf = np.empty(total, dtype=np.uint8)
-        pos = 0
-        for p in self.pages:
-            n = p.defined * itemsize
-            buf[pos : pos + n] = np.frombuffer(p.raw, np.uint8, n, p.value_pos)
-            pos += n
-        base = stager.add(buf)
+        # exactly the value bytes back-to-back → one contiguous bitcast
+        segs = [(p.raw, p.value_pos, p.defined * itemsize) for p in self.pages]
+        base = int(stager.add_segments(segs)[0]) if segs else stager._reserve(0, None)
         return lambda buf_dev: DeviceColumnData(
             values=_plain_jit(buf_dev, np.int64(base), dtype=name, count=defined),
             **common,
@@ -643,15 +657,18 @@ class DeviceFileReader:
     and call it once).
     """
 
-    def __init__(self, source, columns=None, validate_crc: bool = False):
+    def __init__(self, source, columns=None, validate_crc: bool = False,
+                 profile_dir: "str | None" = None):
         from .reader import FileReader
 
         self._host = FileReader(source, columns=columns, validate_crc=validate_crc)
         self.metadata = self._host.metadata
         self.schema = self._host.schema
         self.validate_crc = validate_crc
+        self.profile_dir = profile_dir  # JAX profiler trace dir (SURVEY §5.1)
         self._deferred: list = []
         self._stats = ReaderStats()
+        self._stats_lock = __import__("threading").Lock()
         self._t0: float | None = None
 
     def close(self):
@@ -738,7 +755,8 @@ class DeviceFileReader:
             for name, run in plans:
                 out[name] = run(buf_dev)
         now = _time.perf_counter()
-        self._stats.device_seconds += now - t0
+        with self._stats_lock:
+            self._stats.device_seconds += now - t0
         if self._t0 is not None:
             self._stats.wall_seconds = now - self._t0
         return out
@@ -782,17 +800,16 @@ class DeviceFileReader:
         needs no x64 scope.
         """
         from concurrent.futures import ThreadPoolExecutor
+        import contextlib
 
         n = self.num_row_groups
         if n == 0:
             self.finalize()
             return
-        import threading as _threading
-
-        stats_lock = _threading.Lock()
-
+        trace = (jax.profiler.trace(self.profile_dir) if self.profile_dir
+                 else contextlib.nullcontext())
         def _add_device_seconds(dt: float) -> None:
-            with stats_lock:
+            with self._stats_lock:
                 self._stats.device_seconds += dt
 
         def timed_stage(stager):
@@ -805,7 +822,7 @@ class DeviceFileReader:
             _add_device_seconds(_time.perf_counter() - t0)
             return buf_dev
 
-        with ThreadPoolExecutor(1) as ex:
+        with trace, ThreadPoolExecutor(1) as ex:
             prev = None  # (prepared, future staging the device buffer)
             for i in range(n):
                 prepared = self._prepare_row_group(i)

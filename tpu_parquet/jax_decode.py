@@ -105,21 +105,7 @@ class HybridMeta:
     max_value: Optional[int] = None  # stream max (native walk only, on request)
 
 
-# meta_parse.cpp error codes → messages (kept aligned with the C enum)
-_NATIVE_ERRORS = {
-    -1: "truncated varint in stream header",
-    -2: "varint too long in stream header",
-    -3: "invalid delta block size",
-    -4: "invalid miniblock count",
-    -5: "miniblock size not multiple of 32",
-    -6: "implausible delta value count",
-    -7: "truncated miniblock bit widths",
-    -8: "invalid miniblock bit width",
-    -9: "truncated miniblock data",
-    -11: "truncated bit-packed run",
-    -12: "truncated RLE run value",
-    -13: "hybrid stream exhausted",
-}
+from .native import NATIVE_ERRORS as _NATIVE_ERRORS
 
 
 def parse_hybrid_meta(
@@ -154,34 +140,28 @@ def parse_hybrid_meta(
 def _native_hybrid_meta(buf, n, pos, width, count, compute_max=False) -> Optional[HybridMeta]:
     from . import native
 
-    cap = min(count, max(n - pos, 0) + 1, 4096)
-    while True:
-        res = native.hybrid_meta(buf, n, pos, width, count, cap,
-                                 want_max=compute_max)
-        if res is None:
+    res = native.hybrid_meta_retry(buf, n, pos, width, count,
+                                   want_max=compute_max)
+    if res is None:
+        return None
+    if isinstance(res, int):
+        if res == -10:  # cap retry exhausted: let the Python walk diagnose
             return None
-        if isinstance(res, int):
-            if res == -10:  # cap exceeded: worst case one run per value/byte
-                full_cap = min(count, max(n - pos, 0) + 1)
-                if cap >= full_cap:
-                    return None  # defensive: let the Python walk diagnose
-                cap = full_cap
-                continue
-            raise RLEError(_NATIVE_ERRORS.get(res, f"hybrid parse error {res}"))
-        n_runs, consumed, ends, kinds, vals, starts, max_value = res
-        rp = _bucket(max(n_runs, 1))
-        run_ends = np.full(rp, count, dtype=np.int64)
-        run_is_rle = np.zeros(rp, dtype=bool)
-        run_values = np.zeros(rp, dtype=np.uint32)
-        run_bit_starts = np.zeros(rp, dtype=np.int64)
-        run_ends[:n_runs] = ends
-        run_is_rle[:n_runs] = kinds.astype(bool)
-        run_values[:n_runs] = vals
-        run_bit_starts[:n_runs] = starts
-        return HybridMeta(
-            run_ends, run_is_rle, run_values, run_bit_starts, count, consumed,
-            n_runs=n_runs, max_value=max_value,
-        )
+        raise RLEError(_NATIVE_ERRORS.get(res, f"hybrid parse error {res}"))
+    n_runs, consumed, ends, kinds, vals, starts, max_value = res
+    rp = _bucket(max(n_runs, 1))
+    run_ends = np.full(rp, count, dtype=np.int64)
+    run_is_rle = np.zeros(rp, dtype=bool)
+    run_values = np.zeros(rp, dtype=np.uint32)
+    run_bit_starts = np.zeros(rp, dtype=np.int64)
+    run_ends[:n_runs] = ends
+    run_is_rle[:n_runs] = kinds.astype(bool)
+    run_values[:n_runs] = vals
+    run_bit_starts[:n_runs] = starts
+    return HybridMeta(
+        run_ends, run_is_rle, run_values, run_bit_starts, count, consumed,
+        n_runs=n_runs, max_value=max_value,
+    )
 
 
 def _parse_hybrid_meta_py(

@@ -135,20 +135,15 @@ def _decode_native(buf: bytes, width: int, count: int) -> "np.ndarray | None":
 
     if not isinstance(buf, bytes):
         buf = bytes(buf)
-    res = None
-    cap = min(count, len(buf) + 1, 4096)
-    while True:
-        res = native.hybrid_meta(buf, len(buf), 0, width, count, cap)
-        if res is None:
+    res = native.hybrid_meta_retry(buf, len(buf), 0, width, count)
+    if res is None:
+        return None
+    if isinstance(res, int):
+        if res == -10:
             return None
-        if isinstance(res, int):
-            if res == -10 and cap < min(count, len(buf) + 1):
-                cap = min(count, len(buf) + 1)
-                continue
-            if res == -10:
-                return None
-            raise RLEError(f"hybrid stream rejected (native code {res})")
-        break
+        raise RLEError(
+            native.NATIVE_ERRORS.get(res, f"hybrid parse error {res}")
+        )
     n_runs, _consumed, ends, kinds, vals, starts = res[:6]
     if width == 0:
         return np.zeros(count, dtype=np.uint32)

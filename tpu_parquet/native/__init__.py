@@ -200,6 +200,43 @@ def hybrid_meta(buf: bytes, n: int, pos: int, width: int, count: int, cap: int,
     return r, int(consumed[0]), ends[:r], kinds[:r], vals[:r], starts[:r], mx
 
 
+# meta_parse.cpp error codes → messages (kept aligned with the C enum);
+# shared by every native-walk caller so diagnostics don't depend on which
+# wrapper surfaced the failure
+NATIVE_ERRORS = {
+    -1: "truncated varint in stream header",
+    -2: "varint too long in stream header",
+    -3: "invalid delta block size",
+    -4: "invalid miniblock count",
+    -5: "miniblock size not multiple of 32",
+    -6: "implausible delta value count",
+    -7: "truncated miniblock bit widths",
+    -8: "invalid miniblock bit width",
+    -9: "truncated miniblock data",
+    -11: "truncated bit-packed run",
+    -12: "truncated RLE run value",
+    -13: "hybrid stream exhausted",
+}
+
+
+def hybrid_meta_retry(buf: bytes, n: int, pos: int, width: int, count: int,
+                      want_max: bool = False):
+    """hybrid_meta with the standard cap-retry policy.
+
+    Starts with a small run-table cap and retries once with the provable
+    worst case (one run per value/byte) on ERR_CAP.  Returns the result
+    tuple, a negative error code, or None when unavailable.
+    """
+    cap = min(count, max(n - pos, 0) + 1, 4096)
+    full_cap = min(count, max(n - pos, 0) + 1)
+    while True:
+        res = hybrid_meta(buf, n, pos, width, count, cap, want_max=want_max)
+        if isinstance(res, int) and res == -10 and cap < full_cap:
+            cap = full_cap
+            continue
+        return res
+
+
 def bytearray_walk(buf: bytes, count: int):
     """Walk PLAIN BYTE_ARRAY length prefixes natively (meta_parse.cpp).
 
