@@ -298,3 +298,22 @@ def test_profiler_trace_hook(tmp_path):
     for root, _, files in os.walk(trace_dir):
         found.extend(files)
     assert found, "profiler trace produced no files"
+
+
+def test_device_reader_memory_budget(tmp_path):
+    """HBM staging budget (SURVEY §5.3): a tight max_memory raises instead of
+    staging an oversized row group; a generous one reads fine."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from tpu_parquet.alloc import MemoryBudgetExceeded
+
+    p = tmp_path / "b.parquet"
+    pq.write_table(pa.table({"a": np.arange(200_000, dtype=np.int64)}), p,
+                   use_dictionary=False, compression="snappy")
+    with DeviceFileReader(p, max_memory=64 << 20) as r:
+        assert sum(1 for _ in r.iter_row_groups()) == 1
+    with DeviceFileReader(p, max_memory=100_000) as r:
+        with pytest.raises(MemoryBudgetExceeded):
+            for _ in r.iter_row_groups():
+                pass

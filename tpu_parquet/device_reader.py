@@ -563,7 +563,7 @@ class _ChunkAssembler:
 @scoped_x64
 def _collect_chunk(
     buf: bytes, codec: int, total_values: int, leaf: SchemaNode,
-    deferred_checks: list, validate_crc: bool = False,
+    deferred_checks: list, validate_crc: bool = False, alloc=None,
 ) -> Optional[_ChunkAssembler]:
     """Walk a chunk's pages into an assembler (host phase); None if no data."""
     asm = _ChunkAssembler(leaf, deferred_checks)
@@ -573,13 +573,16 @@ def _collect_chunk(
         if pt == PageType.DICTIONARY_PAGE:
             payload = buf[ps.payload_start : ps.payload_end]
             _check_crc(header, payload, validate_crc)
+            if alloc is not None:
+                alloc.register(max(header.uncompressed_page_size or 0, 0))
             raw = decompress_block(payload, codec, header.uncompressed_page_size)
             dh = header.dictionary_page_header
             asm.set_dictionary(raw, dh.encoding, dh.num_values or 0)
             continue
         if pt in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
             asm.pages.append(
-                parse_data_page(ps, buf, codec, leaf, validate_crc=validate_crc)
+                parse_data_page(ps, buf, codec, leaf, validate_crc=validate_crc,
+                                alloc=alloc)
             )
             continue
         # index/unknown pages: skip
@@ -658,14 +661,21 @@ class DeviceFileReader:
     """
 
     def __init__(self, source, columns=None, validate_crc: bool = False,
-                 profile_dir: "str | None" = None):
+                 profile_dir: "str | None" = None, max_memory: int = 0):
         from .reader import FileReader
 
-        self._host = FileReader(source, columns=columns, validate_crc=validate_crc)
+        self._host = FileReader(source, columns=columns,
+                                validate_crc=validate_crc,
+                                max_memory=max_memory)
         self.metadata = self._host.metadata
         self.schema = self._host.schema
         self.validate_crc = validate_crc
         self.profile_dir = profile_dir  # JAX profiler trace dir (SURVEY §5.1)
+        # HBM/host staging budget (SURVEY §5.3): ONE tracker shared with the
+        # host FileReader, registered against each page's REAL decompressed
+        # size (chunk-level metadata totals are attacker-controlled), so a
+        # decompression bomb raises instead of exhausting memory
+        self.alloc = self._host.alloc
         self._deferred: list = []
         self._stats = ReaderStats()
         self._stats_lock = __import__("threading").Lock()
@@ -703,6 +713,7 @@ class DeviceFileReader:
         leaves = {l.path: l for l in self.schema.selected_leaves()}
         out: dict[str, DeviceColumnData] = {}
         f = self._host._f
+        self.alloc.reset()
         stager = _RowGroupStager()
         plans: list[tuple[str, object]] = []
         for chunk in rg.columns or []:
@@ -720,9 +731,10 @@ class DeviceFileReader:
                 raise ParquetError("chunk truncated")
             self._stats.chunks += 1
             self._stats.compressed_bytes += md.total_compressed_size
+            self.alloc.register(md.total_compressed_size)
             asm = _collect_chunk(
                 buf, md.codec, md.num_values, leaf, self._deferred,
-                validate_crc=self.validate_crc,
+                validate_crc=self.validate_crc, alloc=self.alloc,
             )
             if asm is not None:
                 self._stats.pages += len(asm.pages)

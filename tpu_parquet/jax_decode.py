@@ -415,7 +415,7 @@ class ParsedDataPage:
 
 def parse_data_page(
     ps: PageSlice, buf: bytes, codec: int, leaf: SchemaNode,
-    validate_crc: bool = False,
+    validate_crc: bool = False, alloc=None,
 ) -> ParsedDataPage:
     """Parse one v1/v2 data page on host (no device work).
 
@@ -428,6 +428,10 @@ def parse_data_page(
     header = ps.header
     payload = buf[ps.payload_start : ps.payload_end]
     _check_crc(header, payload, validate_crc)
+    if alloc is not None:
+        # register the REAL decompressed size before materializing it — the
+        # chunk-level metadata totals are attacker-controlled and optional
+        alloc.register(max(header.uncompressed_page_size or 0, 0))
     max_rep, max_def = leaf.max_rep, leaf.max_def
     if header.type == PageType.DATA_PAGE:
         dh = header.data_page_header

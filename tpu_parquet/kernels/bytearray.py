@@ -79,6 +79,20 @@ def decode_delta(buf: bytes, count: int) -> ByteArrayData:
     prev_len = 0
     s_off = suffixes.offsets
     s_heap = suffixes.heap
+    from .. import native
+
+    rc = native.delta_ba_stitch(
+        np.ascontiguousarray(prefix_lens, dtype=np.int64),
+        np.ascontiguousarray(s_off, dtype=np.int64),
+        np.ascontiguousarray(s_heap, dtype=np.uint8),
+        out_offsets,
+        heap,
+    )
+    if rc == 0:
+        return ByteArrayData(offsets=out_offsets, heap=heap)
+    if rc == -30:
+        raise ByteArrayError("prefix longer than previous value")
+    # native unavailable: reference Python chain below
     for i in range(count):
         p = int(prefix_lens[i])
         if p > prev_len:

@@ -78,6 +78,11 @@ def load():
             ]
             c_ll = ctypes.c_longlong
             p = ctypes.POINTER
+            lib.tpq_delta_ba_stitch.restype = c_ll
+            lib.tpq_delta_ba_stitch.argtypes = [
+                p(ctypes.c_longlong), p(ctypes.c_longlong), p(ctypes.c_uint8),
+                p(ctypes.c_longlong), p(ctypes.c_uint8), c_ll,
+            ]
             lib.tpq_bytearray_walk.restype = c_ll
             lib.tpq_bytearray_walk.argtypes = [
                 ctypes.c_char_p, c_ll, c_ll, p(ctypes.c_longlong),
@@ -263,6 +268,28 @@ def bytearray_walk(buf: bytes, count: int):
     if rc < 0:
         return int(rc)
     return offsets, heap[: int(rc)]
+
+
+def delta_ba_stitch(prefix_lens, suf_off, suf_heap, out_off, heap) -> "int | None":
+    """Run the DELTA_BYTE_ARRAY prefix chain natively (meta_parse.cpp).
+
+    All arguments are numpy arrays (int64 offsets, uint8 heaps); ``heap`` is
+    written in place.  Returns 0, -30 (prefix exceeds previous value), or
+    None when the native library is unavailable.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    pll = ctypes.POINTER(ctypes.c_longlong)
+    pu8 = ctypes.POINTER(ctypes.c_uint8)
+    return int(lib.tpq_delta_ba_stitch(
+        prefix_lens.ctypes.data_as(pll),
+        suf_off.ctypes.data_as(pll),
+        suf_heap.ctypes.data_as(pu8),
+        out_off.ctypes.data_as(pll),
+        heap.ctypes.data_as(pu8),
+        len(prefix_lens),
+    ))
 
 
 def available() -> bool:

@@ -241,4 +241,27 @@ i64 tpq_bytearray_walk(const u8 *buf, i64 n, i64 count, i64 *offsets,
     return total;
 }
 
+// DELTA_BYTE_ARRAY prefix stitching (type_bytearray.go:189-292 semantics):
+// value i = previous value's first prefix_lens[i] bytes + suffix i.  The
+// chain is inherently sequential (SURVEY.md §7.4.4) — this runs it at memcpy
+// speed.  All offset arrays are caller-validated cumulative sums; the only
+// data-dependent check is the prefix-vs-previous-length bound.
+// Returns 0, or -30 when value i's prefix exceeds the previous value's length.
+i64 tpq_delta_ba_stitch(const i64 *prefix_lens, const i64 *suf_off,
+                        const u8 *suf_heap, const i64 *out_off, u8 *heap,
+                        i64 count) {
+    i64 prev_start = 0, prev_len = 0;
+    for (i64 i = 0; i < count; i++) {
+        i64 p = prefix_lens[i];
+        if (p > prev_len) return -30;
+        i64 start = out_off[i];
+        if (p) __builtin_memmove(heap + start, heap + prev_start, p);
+        i64 sl = suf_off[i + 1] - suf_off[i];
+        if (sl) __builtin_memcpy(heap + start + p, suf_heap + suf_off[i], sl);
+        prev_start = start;
+        prev_len = p + sl;
+    }
+    return 0;
+}
+
 }  // extern "C"
