@@ -317,3 +317,56 @@ def test_device_reader_memory_budget(tmp_path):
         with pytest.raises(MemoryBudgetExceeded):
             for _ in r.iter_row_groups():
                 pass
+
+
+def test_iter_batches(tmp_path):
+    """Fixed-shape device batches across row-group boundaries."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = 10_000
+    a = np.arange(n, dtype=np.int64) * 3
+    b = np.arange(n, dtype=np.float64) / 7
+    p = tmp_path / "b.parquet"
+    pq.write_table(pa.table({"a": a, "b": b}), p, row_group_size=2307,
+                   use_dictionary=False)
+    got_a, got_b = [], []
+    with DeviceFileReader(p) as r:
+        for batch in r.iter_batches(999):
+            assert batch["a"].shape == (999,)
+            assert batch["b"].shape == (999, 2) or batch["b"].shape == (999,)
+            got_a.append(np.asarray(batch["a"]))
+            hb = batch["b"]
+            arr = np.asarray(hb)
+            if arr.ndim == 2:  # f64 device representation: uint32 word pairs
+                arr = np.ascontiguousarray(arr).view("<f8").reshape(-1)
+            got_b.append(arr)
+    full = n - n % 999  # drop_remainder semantics
+    np.testing.assert_array_equal(np.concatenate(got_a), a[:full])
+    np.testing.assert_array_equal(np.concatenate(got_b), b[:full])
+
+
+def test_iter_batches_dict_column_materializes(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    vals = np.arange(5000, dtype=np.int64) % 17
+    p = tmp_path / "d.parquet"
+    pq.write_table(pa.table({"v": vals}), p)  # dictionary-encoded by default
+    out = []
+    with DeviceFileReader(p) as r:
+        for batch in r.iter_batches(512):
+            out.append(np.asarray(batch["v"]))
+    np.testing.assert_array_equal(np.concatenate(out), vals[: 5000 - 5000 % 512])
+
+
+def test_iter_batches_rejects_ragged(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    p = tmp_path / "s.parquet"
+    pq.write_table(pa.table({"s": [f"x{i%1000}" for i in range(3000)]}), p,
+                   use_dictionary=False)
+    with DeviceFileReader(p) as r:
+        with pytest.raises(TypeError, match="ragged"):
+            next(iter(r.iter_batches(100)))

@@ -58,7 +58,7 @@ class PlainCompressor(BlockCompressor):
 # Snappy (raw format) — native C++ preferred, pure-Python fallback
 # ---------------------------------------------------------------------------
 
-def _py_snappy_decompress(data: bytes) -> bytes:
+def _py_snappy_decompress(data: bytes, max_size: int = -1) -> bytes:
     """Pure-Python raw-snappy decoder (same format as native/snappy.cpp)."""
     pos = 0
     n = len(data)
@@ -76,6 +76,11 @@ def _py_snappy_decompress(data: bytes) -> bytes:
         shift += 7
         if shift > 28:
             raise CompressionError("snappy: length varint too long")
+    if 0 <= max_size < expect:
+        # bomb guard: stream claims more than the page header declared
+        raise CompressionError(
+            f"snappy stream claims {expect} bytes, page declared {max_size}"
+        )
     out = bytearray()
     while pos < n:
         tag = data[pos]
@@ -162,8 +167,12 @@ class SnappyCompressor(BlockCompressor):
     def decompress_block(self, block: bytes, uncompressed_size: int) -> bytes:
         try:
             if _native.available():
-                return _native.snappy_decompress(bytes(block))
-            return _py_snappy_decompress(bytes(block))
+                return _native.snappy_decompress(
+                    bytes(block), max_size=max(uncompressed_size, 0)
+                )
+            return _py_snappy_decompress(
+                bytes(block), max_size=max(uncompressed_size, 0)
+            )
         except ValueError as e:
             raise CompressionError(str(e)) from e
 

@@ -133,10 +133,35 @@ def _bool_pages_jit(buf, page_byte_base, page_val_start, *, count):
 
 
 @functools.partial(jax.jit, static_argnames=("size",))
+def _fit_rows_jit(x, *, size):
+    """Zero-pad leading-axis rows up to ``size`` (batch-carry capacity)."""
+    pad = size - x.shape[0]
+    return jnp.concatenate(
+        [x, jnp.zeros((pad,) + x.shape[1:], dtype=x.dtype)]
+    )
+
+
+@jax.jit
+def _roll_rows_jit(x, shift):
+    """Roll rows by a traced shift (batch-carry compaction)."""
+    return jnp.roll(x, shift, axis=0)
+
+
+@jax.jit
+def _update_rows_jit(x, update, pos):
+    """Write ``update`` rows at a traced offset (batch-carry append)."""
+    return jax.lax.dynamic_update_slice(
+        x, update, (pos,) + (0,) * (x.ndim - 1)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
 def _dynslice_jit(buf, start, *, size):
-    """Slice ``size`` bytes at a traced offset (static size, bucketed by the
-    caller so executables are shared across chunks)."""
-    return jax.lax.dynamic_slice(buf, (start,), (size,))
+    """Slice ``size`` leading rows at a traced offset (static size, bucketed
+    by the caller so executables are shared across chunks/batches)."""
+    return jax.lax.dynamic_slice(
+        buf, (start,) + (0,) * (buf.ndim - 1), (size,) + buf.shape[1:]
+    )
 
 
 class _RowGroupStager:
@@ -800,6 +825,111 @@ class DeviceFileReader:
                     f"in column {path}"
                 )
         self._deferred = []
+
+    def iter_batches(self, batch_size: int, columns=None):
+        """Yield fixed-size device batches {column: jax.Array[batch_size, ...]}.
+
+        The training-pipeline view: every yielded batch has the SAME static
+        shape, so a consuming jitted step compiles once.  Rows flow across row
+        group boundaries through fixed-capacity device buffers (power-of-two
+        capacity, rows appended with dynamic_update_slice, batches cut with
+        dynamic_slice at traced offsets), so the executable set is bounded by
+        {capacity} x {row-group size} x {batch_size} — no per-remainder
+        recompiles.  The final short remainder is NOT yielded (classic
+        drop_remainder semantics; the row count is known from the footer).
+
+        Fixed-width, null-free, non-repeated columns only: ragged byte arrays
+        have no static row shape.  Dictionary columns are materialized to
+        values on device.
+        """
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        want = None if columns is None else set(columns)
+        bufs: dict[str, jax.Array] = {}
+        cap = 0
+        start = end = 0  # valid rows [start, end), shared by all columns
+        first = True
+        for cols in self.iter_row_groups():
+            ready: list[dict] = []
+            # trace everything under a scoped x64 context; yields happen
+            # outside it so the consumer's dtype semantics are untouched
+            # (a decorator on a generator would only scope its construction)
+            with jax.enable_x64():
+                arrays = {}
+                for name, col in cols.items():
+                    if want is not None and name not in want:
+                        continue
+                    if isinstance(col, DeviceDictColumn):
+                        col = col.materialize()
+                    if col.values is None:
+                        raise TypeError(
+                            f"iter_batches needs fixed-width columns; "
+                            f"{name!r} is ragged (offsets/heap)"
+                        )
+                    if col.max_rep > 0:
+                        raise TypeError(
+                            f"iter_batches needs flat columns; {name!r} is "
+                            f"repeated"
+                        )
+                    if int(col.values.shape[0]) != col.num_leaf_slots:
+                        raise TypeError(
+                            f"iter_batches needs null-free columns; {name!r} "
+                            f"has "
+                            f"{col.num_leaf_slots - int(col.values.shape[0])} "
+                            f"nulls"
+                        )
+                    arrays[name] = col.values
+                if want is not None:
+                    missing = want - set(arrays)
+                    if missing:
+                        raise KeyError(
+                            f"iter_batches: no such column(s) {sorted(missing)}"
+                        )
+                if not arrays:
+                    continue
+                ns = {int(v.shape[0]) for v in arrays.values()}
+                if len(ns) != 1:
+                    raise ParquetError(
+                        f"iter_batches: column row counts differ: {sorted(ns)}"
+                    )
+                n_new = ns.pop()
+                if n_new == 0:
+                    continue  # zero-row group: placeholder columns, skip
+                if first:
+                    cap = _bucket(n_new + batch_size)
+                    bufs = {k: _fit_rows_jit(v, size=cap)
+                            for k, v in arrays.items()}
+                    start, end = 0, n_new
+                    first = False
+                else:
+                    if end + n_new > cap and start:  # compact [start, end) to 0
+                        bufs = {k: _roll_rows_jit(v, np.int64(-start))
+                                for k, v in bufs.items()}
+                        end -= start
+                        start = 0
+                    if end + n_new > cap:  # still short: grow capacity
+                        cap = _bucket(end + n_new + batch_size)
+                        bufs = {k: _fit_rows_jit(v, size=cap)
+                                for k, v in bufs.items()}
+                    bufs = {
+                        k: _update_rows_jit(bufs[k], v, np.int64(end))
+                        for k, v in arrays.items()
+                    }
+                    end += n_new
+                # the carry is device memory held across row groups: count it
+                # against this row group's budget window (alloc resets per
+                # group in _prepare_row_group)
+                self.alloc.register(
+                    sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                        for v in bufs.values())
+                )
+                while end - start >= batch_size:
+                    ready.append({
+                        k: _dynslice_jit(v, np.int64(start), size=batch_size)
+                        for k, v in bufs.items()
+                    })
+                    start += batch_size
+            yield from ready
 
     def iter_row_groups(self, finalize_each: bool = False):
         """Iterate row groups with a one-deep transfer pipeline.

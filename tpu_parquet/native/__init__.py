@@ -107,13 +107,19 @@ def load():
     return _lib
 
 
-def snappy_decompress(data: bytes) -> bytes:
+def snappy_decompress(data: bytes, max_size: int = -1) -> bytes:
     lib = load()
     if lib is None:
         raise RuntimeError("native library unavailable")
     n = lib.tpq_snappy_uncompressed_length(data, len(data))
     if n < 0:
         raise ValueError("malformed snappy data: bad length header")
+    if 0 <= max_size < n:
+        # bomb guard: the stream's own varint claims more than the page header
+        # declared — reject BEFORE allocating the output buffer
+        raise ValueError(
+            f"snappy stream claims {n} bytes, page declared {max_size}"
+        )
     out = ctypes.create_string_buffer(n)
     rc = lib.tpq_snappy_decompress(data, len(data), out, n)
     if rc != 0:
