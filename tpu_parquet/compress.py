@@ -83,6 +83,13 @@ def _py_snappy_decompress(data: bytes, max_size: int = -1) -> bytes:
         )
     out = bytearray()
     while pos < n:
+        if len(out) > expect:
+            # bomb guard inside the loop: copy ops amplify ~21x per input
+            # byte, so waiting for the post-hoc length check would allocate
+            # the whole bomb first
+            raise CompressionError(
+                f"snappy: output exceeds declared {expect} bytes"
+            )
         tag = data[pos]
         pos += 1
         kind = tag & 3
