@@ -1,0 +1,57 @@
+"""Write the sample dataset to parquet — one cell of the interop matrix.
+
+Python twin of the reference's compatibility/build.go:17-78: load JSON rows,
+write them with the chosen codec and page version, so foreign readers
+(parquet-mr's parquet-tools, pyarrow) can verify the output.
+
+    python build.py --json data.json --pq out.parquet \
+        --compression snappy --version v1
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from tpu_parquet.format import CompressionCodec
+from tpu_parquet.schema.dsl import parse_schema_definition
+from tpu_parquet.writer import FileWriter
+
+from data_model import SCHEMA_TEXT, load_json, to_parquet_row
+
+CODECS = {
+    "none": CompressionCodec.UNCOMPRESSED,
+    "gzip": CompressionCodec.GZIP,
+    "snappy": CompressionCodec.SNAPPY,
+    "zstd": CompressionCodec.ZSTD,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", default="data.json")
+    ap.add_argument("--pq", default="out.parquet")
+    ap.add_argument("--compression", default="snappy", choices=sorted(CODECS))
+    ap.add_argument("--version", default="v1", choices=["v1", "v2"])
+    args = ap.parse_args(argv)
+
+    rows = load_json(args.json)
+    schema = parse_schema_definition(SCHEMA_TEXT)
+    with FileWriter(
+        args.pq, schema,
+        codec=CODECS[args.compression],
+        data_page_version=2 if args.version == "v2" else 1,
+        created_by="tpu-parquet compatibility harness",
+    ) as w:
+        for row in rows:
+            w.write_row(to_parquet_row(row))
+    print(f"wrote {len(rows)} rows to {args.pq} "
+          f"({args.compression}, pages {args.version})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

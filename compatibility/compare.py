@@ -1,0 +1,74 @@
+"""Read a matrix cell back and deep-compare against the source JSON.
+
+Python twin of the reference's compatibility/compare.go:10-39.  With
+``--reader pyarrow`` the file is read by pyarrow instead of our own reader —
+a true cross-implementation check that runs without Java.
+
+    python compare.py --json data.json --pq out.parquet [--reader pyarrow]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from data_model import from_parquet_row, load_json
+
+
+def read_ours(path: str) -> list[dict]:
+    from tpu_parquet.reader import FileReader
+
+    with FileReader(path) as r:
+        return [from_parquet_row(row) for row in r.iter_rows()]
+
+
+def read_pyarrow(path: str) -> list[dict]:
+    import pyarrow.parquet as pq
+
+    rows = pq.read_table(path).to_pylist()
+    # pyarrow reads `repeated` fields (no LIST annotation) as lists already,
+    # and binary(STRING) as str; normalize through the same shape
+    out = []
+    for row in rows:
+        out.append({
+            **{k: row[k] for k in (
+                "id", "index", "guid", "is_active", "balance", "age",
+                "eye_color", "company", "email", "latitude", "longitude",
+                "greeting", "favorite_fruit",
+            )},
+            "name": dict(row["name"]),
+            "tags": list(row.get("tags") or []),
+            "range": list(row.get("range") or []),
+            "friends": [dict(f) for f in (row.get("friends") or [])],
+        })
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", default="data.json")
+    ap.add_argument("--pq", default="out.parquet")
+    ap.add_argument("--reader", default="ours", choices=["ours", "pyarrow"])
+    args = ap.parse_args(argv)
+
+    want = load_json(args.json)
+    got = read_ours(args.pq) if args.reader == "ours" else read_pyarrow(args.pq)
+    if len(got) != len(want):
+        print(f"FAIL: row count {len(got)} != {len(want)}", file=sys.stderr)
+        return 1
+    for i, (g, w) in enumerate(zip(got, want)):
+        if g != w:
+            for k in w:
+                if g.get(k) != w[k]:
+                    print(f"FAIL row {i} field {k!r}: {g.get(k)!r} != {w[k]!r}",
+                          file=sys.stderr)
+            return 1
+    print(f"OK: {len(got)} rows equal ({args.reader} reader)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,154 @@
+"""Shared data model for the cross-implementation compatibility harness.
+
+Mirrors the role of the reference's compatibility/data_model.go: one schema +
+one JSON-serializable sample dataset that ``build.py`` writes to parquet and
+``compare.py`` (plus the parquet-mr / pyarrow cross-readers) verify byte-for-
+byte at the value level.  The shapes deliberately cover the surface the
+reference's harness exercises (compatibility/data_model.go:13-42): flat
+strings/ints/bool/doubles, a nested group, LIST of strings, LIST of int32,
+and a repeated group of structs — the sample data itself is generated here
+(deterministic seed), not copied from anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import string
+
+SCHEMA_TEXT = """message sample {
+  required binary id (STRING);
+  required int64 index;
+  required binary guid (STRING);
+  required boolean is_active;
+  required binary balance (STRING);
+  required int32 age;
+  required binary eye_color (STRING);
+  required group name {
+    required binary first (STRING);
+    required binary last (STRING);
+  }
+  required binary company (STRING);
+  required binary email (STRING);
+  required double latitude;
+  required double longitude;
+  repeated binary tags (STRING);
+  repeated int32 range;
+  repeated group friends {
+    required int32 id;
+    required binary name (STRING);
+  }
+  required binary greeting (STRING);
+  required binary favorite_fruit (STRING);
+}"""
+
+_FRUIT = ["apple", "banana", "strawberry"]
+_COLORS = ["blue", "brown", "green"]
+
+
+def _word(rng: random.Random, n: int) -> str:
+    return "".join(rng.choice(string.ascii_lowercase) for _ in range(n))
+
+
+def generate(n: int = 500, seed: int = 7) -> list[dict]:
+    """Deterministic sample rows, JSON-representable (strings, not bytes)."""
+    rng = random.Random(seed)
+    rows = []
+    for i in range(n):
+        rows.append({
+            "id": "".join(rng.choice("0123456789abcdef") for _ in range(24)),
+            "index": i,
+            "guid": "-".join(
+                _word(rng, k) for k in (8, 4, 4, 4, 12)
+            ),
+            "is_active": rng.random() < 0.5,
+            "balance": f"${rng.uniform(1000, 4000):,.2f}",
+            "age": rng.randint(20, 40),
+            "eye_color": rng.choice(_COLORS),
+            "name": {"first": _word(rng, 6).title(),
+                     "last": _word(rng, 8).title()},
+            "company": _word(rng, 9).upper(),
+            "email": f"{_word(rng, 6)}@{_word(rng, 8)}.com",
+            "latitude": round(rng.uniform(-90, 90), 6),
+            "longitude": round(rng.uniform(-180, 180), 6),
+            "tags": [_word(rng, rng.randint(3, 10))
+                     for _ in range(rng.randint(0, 7))],
+            "range": list(range(rng.randint(0, 10))),
+            "friends": [
+                {"id": j, "name": f"{_word(rng, 5).title()} "
+                                  f"{_word(rng, 7).title()}"}
+                for j in range(rng.randint(0, 3))
+            ],
+            "greeting": f"Hello, {_word(rng, 6)}! You have "
+                        f"{rng.randint(1, 20)} unread messages.",
+            "favorite_fruit": rng.choice(_FRUIT),
+        })
+    return rows
+
+
+def load_json(path: str) -> list[dict]:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def save_json(rows: list[dict], path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(rows, f, indent=1)
+
+
+def to_parquet_row(row: dict) -> dict:
+    """JSON row → writer row map (strings become bytes, like toMap())."""
+    return {
+        "id": row["id"].encode(),
+        "index": row["index"],
+        "guid": row["guid"].encode(),
+        "is_active": row["is_active"],
+        "balance": row["balance"].encode(),
+        "age": row["age"],
+        "eye_color": row["eye_color"].encode(),
+        "name": {"first": row["name"]["first"].encode(),
+                 "last": row["name"]["last"].encode()},
+        "company": row["company"].encode(),
+        "email": row["email"].encode(),
+        "latitude": row["latitude"],
+        "longitude": row["longitude"],
+        "tags": [t.encode() for t in row["tags"]],
+        "range": list(row["range"]),
+        "friends": [{"id": f["id"], "name": f["name"].encode()}
+                    for f in row["friends"]],
+        "greeting": row["greeting"].encode(),
+        "favorite_fruit": row["favorite_fruit"].encode(),
+    }
+
+
+def from_parquet_row(row: dict) -> dict:
+    """Reader row map → JSON-comparable row (bytes back to str).
+
+    Repeated fields read back as lists (possibly absent when empty — the
+    format cannot distinguish empty repeated from missing); normalize to [].
+    """
+    def s(v):
+        return v.decode() if isinstance(v, (bytes, bytearray)) else v
+
+    out = {
+        "id": s(row["id"]),
+        "index": int(row["index"]),
+        "guid": s(row["guid"]),
+        "is_active": bool(row["is_active"]),
+        "balance": s(row["balance"]),
+        "age": int(row["age"]),
+        "eye_color": s(row["eye_color"]),
+        "name": {"first": s(row["name"]["first"]),
+                 "last": s(row["name"]["last"])},
+        "company": s(row["company"]),
+        "email": s(row["email"]),
+        "latitude": float(row["latitude"]),
+        "longitude": float(row["longitude"]),
+        "tags": [s(t) for t in (row.get("tags") or [])],
+        "range": [int(v) for v in (row.get("range") or [])],
+        "friends": [{"id": int(f["id"]), "name": s(f["name"])}
+                    for f in (row.get("friends") or [])],
+        "greeting": s(row["greeting"]),
+        "favorite_fruit": s(row["favorite_fruit"]),
+    }
+    return out

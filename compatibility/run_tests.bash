@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Cross-implementation interop matrix, mirroring the reference's
+# compatibility/run_tests.bash:3-20 ({codec} x {page version}) and extending
+# it with zstd and a pyarrow foreign-read leg that needs no Java.
+#
+# When $PARQUET_TOOLS_JAR points at a parquet-mr parquet-tools jar (and java
+# is on PATH) every cell is additionally read back by parquet-mr via
+# `java -jar $PARQUET_TOOLS_JAR cat -j`, the same jar the reference's Docker
+# image builds.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+PY=${PYTHON:-python}
+WORK=${WORK_DIR:-$(mktemp -d)}
+
+$PY - <<EOF
+from data_model import generate, save_json
+save_json(generate(500), "$WORK/data.json")
+EOF
+
+rebuild_and_compare() {
+  comp=$1
+  version=$2
+  out="$WORK/out-${comp}-${version}.parquet"
+  $PY build.py --json "$WORK/data.json" --pq "$out" --compression "$comp" --version "$version"
+  $PY compare.py --json "$WORK/data.json" --pq "$out"
+  $PY compare.py --json "$WORK/data.json" --pq "$out" --reader pyarrow
+  if [[ -n "${PARQUET_TOOLS_JAR:-}" ]] && command -v java >/dev/null; then
+    java -jar "$PARQUET_TOOLS_JAR" cat -j "$out" > "$out.mr.jsonl"
+    $PY - "$WORK/data.json" "$out.mr.jsonl" <<'EOF'
+import json, sys
+want = json.load(open(sys.argv[1]))
+got = [json.loads(l) for l in open(sys.argv[2]) if l.strip()]
+assert len(got) == len(want), (len(got), len(want))
+for g, w in zip(got, want):
+    assert g["id"] == w["id"] and g["index"] == w["index"], (g, w)
+    assert g.get("tags", []) == w["tags"], (g, w)
+print(f"OK: parquet-mr read {len(got)} rows")
+EOF
+  fi
+}
+
+for comp in none gzip snappy zstd; do
+  for version in v1 v2; do
+    rebuild_and_compare "$comp" "$version"
+  done
+done
+
+echo "compatibility matrix PASSED (workdir $WORK)"

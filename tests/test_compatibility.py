@@ -1,0 +1,92 @@
+"""Cross-implementation compatibility harness tests.
+
+In-process version of compatibility/run_tests.bash (the reference's
+compatibility/run_tests.bash:14-19 matrix): write the shared sample dataset
+with every {codec} x {page version} cell, read it back with our reader AND
+with pyarrow, and deep-compare against the source rows.  The parquet-mr leg
+runs when PARQUET_TOOLS_JAR + java are available (same env-gating style as
+the reference's external corpora, parquet_test.go:12-15).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+COMPAT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      "compatibility")
+sys.path.insert(0, COMPAT)
+
+from data_model import (  # noqa: E402
+    SCHEMA_TEXT, from_parquet_row, generate, to_parquet_row,
+)
+
+from tpu_parquet.format import CompressionCodec  # noqa: E402
+from tpu_parquet.reader import FileReader  # noqa: E402
+from tpu_parquet.schema.dsl import parse_schema_definition  # noqa: E402
+from tpu_parquet.writer import FileWriter  # noqa: E402
+
+CODECS = {
+    "none": CompressionCodec.UNCOMPRESSED,
+    "gzip": CompressionCodec.GZIP,
+    "snappy": CompressionCodec.SNAPPY,
+    "zstd": CompressionCodec.ZSTD,
+}
+
+
+@pytest.fixture(scope="module")
+def rows():
+    return generate(120, seed=11)
+
+
+def _write(path, rows, codec, version):
+    schema = parse_schema_definition(SCHEMA_TEXT)
+    with FileWriter(path, schema, codec=CODECS[codec],
+                    data_page_version=version) as w:
+        for row in rows:
+            w.write_row(to_parquet_row(row))
+
+
+@pytest.mark.parametrize("codec", sorted(CODECS))
+@pytest.mark.parametrize("version", [1, 2])
+def test_matrix_cell_roundtrip_and_pyarrow(tmp_path, rows, codec, version):
+    import pyarrow.parquet as pq
+
+    p = tmp_path / f"out-{codec}-v{version}.parquet"
+    _write(p, rows, codec, version)
+
+    with FileReader(p) as r:
+        got = [from_parquet_row(row) for row in r.iter_rows()]
+    assert got == rows
+
+    # foreign read: pyarrow sees the same values
+    t = pq.read_table(p)
+    assert t.num_rows == len(rows)
+    pl = t.to_pylist()
+    for g, w in zip(pl, rows):
+        assert g["id"] == w["id"]
+        assert g["index"] == w["index"]
+        assert list(g.get("tags") or []) == w["tags"]
+        assert [dict(f) for f in (g.get("friends") or [])] == w["friends"]
+        assert g["latitude"] == pytest.approx(w["latitude"])
+
+
+@pytest.mark.skipif(
+    not (os.environ.get("PARQUET_TOOLS_JAR") and shutil.which("java")),
+    reason="PARQUET_TOOLS_JAR / java not available",
+)
+@pytest.mark.parametrize("codec", ["none", "gzip", "snappy"])
+def test_parquet_mr_reads_our_files(tmp_path, rows, codec):
+    p = tmp_path / f"mr-{codec}.parquet"
+    _write(p, rows, codec, 1)
+    out = subprocess.run(
+        ["java", "-jar", os.environ["PARQUET_TOOLS_JAR"], "cat", "-j", str(p)],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    got = [json.loads(l) for l in out.splitlines() if l.strip()]
+    assert len(got) == len(rows)
+    for g, w in zip(got, rows):
+        assert g["id"] == w["id"] and g["index"] == w["index"]

@@ -41,9 +41,10 @@ from .compress import decompress_block
 from .footer import ParquetError
 from .format import Encoding, PageType, Type, parse_encoding
 from .jax_decode import (
-    DeviceColumnData, ParsedDataPage, _bucket, _bucket_bytes, _SLACK,
-    _concat_jit, _concat_ragged_jit, _dict_gather_bytes_jit, _hybrid_jit,
-    _max_jit, _plain_jit, _PTYPE_TO_NAME, _stack_jit,
+    DeviceColumnData, ParsedDataPage, _bucket, _bucket_bytes, _bucket_count,
+    _SLACK, _concat_jit, _concat_ragged_jit, _dict_gather_bytes_jit,
+    _hybrid_jit, _hybrid_vw_jit, _max_jit, _plain_jit, _PTYPE_TO_NAME,
+    _stack_jit,
     host_decode_dictionary, parse_data_page, parse_hybrid_meta, parse_delta_meta,
 )
 from .schema.core import SchemaNode
@@ -69,15 +70,18 @@ class DeviceDictColumn(DeviceColumnData):
     @scoped_x64
     def materialize(self) -> DeviceColumnData:
         if self.dict_u8 is not None:
+            # padded tail indices are zeros (expand_rle_hybrid n_valid mask),
+            # so the gather stays in bounds; n_values carries the real count
             vals = _dict_gather_bytes_jit(self.dict_u8, self.indices, dtype=self.dict_dtype)
             return DeviceColumnData(
                 values=vals, def_levels=self.def_levels, rep_levels=self.rep_levels,
                 max_def=self.max_def, max_rep=self.max_rep,
                 num_leaf_slots=self.num_leaf_slots, value_dtype=self.value_dtype,
+                n_values=self.n_values,
             )
         off = np.asarray(self.dict_offsets)
         heap = np.asarray(self.dict_heap)
-        idx = np.asarray(self.indices, dtype=np.int64)
+        idx = np.asarray(self.indices, dtype=np.int64)[: self.num_values]
         host = ByteArrayData(offsets=off, heap=heap).take(idx)
         return DeviceColumnData(
             offsets=jnp.asarray(host.offsets), heap=jnp.asarray(host.heap),
@@ -86,9 +90,15 @@ class DeviceDictColumn(DeviceColumnData):
             num_leaf_slots=self.num_leaf_slots,
         )
 
+    @property
+    def num_values(self) -> int:
+        if self.n_values is not None:
+            return self.n_values
+        return int(self.indices.shape[0]) if self.indices is not None else 0
+
     def to_host(self):
         off_or_none = self.dict_offsets
-        idx = np.asarray(self.indices, dtype=np.int64)
+        idx = np.asarray(self.indices, dtype=np.int64)[: self.num_values]
         if self.dict_u8 is not None:
             rows = np.asarray(self.dict_u8)
             n, nb = rows.shape
@@ -102,24 +112,31 @@ class DeviceDictColumn(DeviceColumnData):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("values_per_mini", "count", "bits", "max_width", "defined"),
+    static_argnames=("values_per_mini", "count", "bits", "max_width", "total"),
 )
-def _delta_pages_jit(buf, firsts, starts, widths, mins, *, values_per_mini,
-                     count, bits, max_width, defined):
+def _delta_pages_jit(buf, firsts, starts, widths, mins, page_starts, *,
+                     values_per_mini, count, bits, max_width, total):
     """Decode P delta pages; flatten to the per-page real extents in-graph.
 
-    ``defined`` (static tuple of per-page value counts) keeps the tail
-    slice/concat inside the executable — an eager slice per page would pay the
-    tunneled backend's first-dispatch compile cost instead.
+    Every shape here is *bucketed* static (page count, per-page value count,
+    total output), and the real per-page extents arrive as the traced
+    ``page_starts`` (int64[P+1], cumulative defined counts, last = real
+    total).  One executable therefore serves every delta chunk whose geometry
+    lands in the same buckets — per-page exact counts as static args would
+    compile a fresh program per chunk, which over a tunneled backend costs
+    tens of seconds each.  Tail lanes (pad pages, output past the real total)
+    gather clamped garbage that callers slice off via ``n_values``.
     """
     vals = jax.vmap(
         lambda f, s, w, m: K.delta_reconstruct(
             buf, f, s, w, m, values_per_mini, count, bits, max_width
         )
     )(firsts, starts, widths, mins)
-    if all(d == count for d in defined):
-        return vals.reshape(-1)
-    return jnp.concatenate([vals[i, :d] for i, d in enumerate(defined)])
+    i = jnp.arange(total, dtype=jnp.int64)
+    p = jnp.searchsorted(page_starts, i, side="right") - 1
+    p = jnp.clip(p, 0, vals.shape[0] - 1)
+    within = jnp.clip(i - page_starts[p], 0, count - 1)
+    return vals[p, within]
 
 
 @functools.partial(jax.jit, static_argnames=("count",))
@@ -179,6 +196,7 @@ class _RowGroupStager:
         # ("arr", u8, base, nbytes) | ("segs", segments, base, nbytes)
         self._parts: list[tuple] = []
         self.total = 0
+        self._max_read_end = 0
 
     def _reserve(self, nbytes: int, reserve: int | None) -> int:
         base = self.total
@@ -214,8 +232,17 @@ class _RowGroupStager:
         self._parts.append(("segs", segments, base, nbytes))
         return bases + base
 
+    def note_read_extent(self, base: int, nbytes: int) -> None:
+        """Declare that a kernel will read ``nbytes`` from ``base`` — possibly
+        past the registered region (bucketed static-size reads overlap the
+        next chunk's bytes harmlessly; only the END of the staged buffer must
+        cover the overhang).  ``stage()`` sizes the buffer to the maximum
+        declared extent, so dynamic_slice reads never clamp/misalign."""
+        self._max_read_end = max(self._max_read_end, base + nbytes)
+
     def stage(self) -> jax.Array:
-        buf = np.empty(_bucket_bytes(self.total + _SLACK, 64), dtype=np.uint8)
+        need = max(self.total, self._max_read_end)
+        buf = np.empty(_bucket_bytes(need + _SLACK, 64), dtype=np.uint8)
         pos = 0
         for kind, payload, base, nbytes in self._parts:
             if base > pos:
@@ -271,17 +298,20 @@ class _ChunkAssembler:
             Encoding.RLE_DICTIONARY if e == Encoding.PLAIN_DICTIONARY else e
             for e in encs
         }
+        slots_pad = _bucket_count(slots)
         d_base = r_base = None
         if leaf.max_def > 0:
             d_all = np.ascontiguousarray(
                 np.concatenate([p.def_levels for p in self.pages]), dtype=np.uint32
             )
             d_base = stager.add(d_all)
+            stager.note_read_extent(d_base, slots_pad * 4)
         if leaf.max_rep > 0:
             r_all = np.ascontiguousarray(
                 np.concatenate([p.rep_levels for p in self.pages]), dtype=np.uint32
             )
             r_base = stager.add(r_all)
+            stager.note_read_extent(r_base, slots_pad * 4)
 
         common = dict(
             max_def=leaf.max_def, max_rep=leaf.max_rep, num_leaf_slots=slots,
@@ -323,13 +353,15 @@ class _ChunkAssembler:
         @scoped_x64
         def run(buf_dev) -> DeviceColumnData:
             col = value_fn(buf_dev)
+            # level arrays decode at the bucketed slot count (tail garbage
+            # past num_leaf_slots; levels_to_host slices it off)
             if d_base is not None:
                 col.def_levels = _plain_jit(
-                    buf_dev, np.int64(d_base), dtype="uint32", count=slots
+                    buf_dev, np.int64(d_base), dtype="uint32", count=slots_pad
                 )
             if r_base is not None:
                 col.rep_levels = _plain_jit(
-                    buf_dev, np.int64(r_base), dtype="uint32", count=slots
+                    buf_dev, np.int64(r_base), dtype="uint32", count=slots_pad
                 )
             return col
 
@@ -354,11 +386,17 @@ class _ChunkAssembler:
                     f"PLAIN data truncated: {len(p.raw) - p.value_pos} "
                     f"< {p.defined * itemsize}"
                 )
-        # exactly the value bytes back-to-back → one contiguous bitcast
+        # exactly the value bytes back-to-back → one contiguous bitcast; the
+        # bitcast reads a BUCKETED count (executable shared across chunks),
+        # overreading into whatever follows in the staged buffer — harmless
+        # garbage past n_values, guaranteed in-bounds by note_read_extent
         segs = [(p.raw, p.value_pos, p.defined * itemsize) for p in self.pages]
         base = int(stager.add_segments(segs)[0]) if segs else stager._reserve(0, None)
+        count = _bucket_count(defined)
+        stager.note_read_extent(base, count * itemsize)
         return lambda buf_dev: DeviceColumnData(
-            values=_plain_jit(buf_dev, np.int64(base), dtype=name, count=defined),
+            values=_plain_jit(buf_dev, np.int64(base), dtype=name, count=count),
+            n_values=defined,
             **common,
         )
 
@@ -371,15 +409,21 @@ class _ChunkAssembler:
                     f"PLAIN BOOLEAN truncated: {len(p.raw) - p.value_pos} < {need}"
                 )
         bases = self._value_segments(stager)
-        starts = np.zeros(len(self.pages), dtype=np.int64)
+        n_pages = _bucket(len(self.pages))
+        byte_base = np.zeros(n_pages, dtype=np.int64)
+        byte_base[: len(self.pages)] = bases
+        byte_base[len(self.pages):] = bases[-1] if len(self.pages) else 0
+        starts = np.full(n_pages, defined, dtype=np.int64)
         acc = 0
         for i, p in enumerate(self.pages):
             starts[i] = acc
             acc += p.defined
         return lambda buf_dev: DeviceColumnData(
             values=_bool_pages_jit(
-                buf_dev, jnp.asarray(bases), jnp.asarray(starts), count=defined
+                buf_dev, jnp.asarray(byte_base), jnp.asarray(starts),
+                count=_bucket_count(defined),
             ),
+            n_values=defined,
             **common,
         )
 
@@ -413,11 +457,14 @@ class _ChunkAssembler:
         heap_room = _bucket_bytes(max(heap_len, 1), 64)
         heap_base = stager.add(heap, reserve=heap_room)
         off_base = stager.add(offsets)
+        n_off = _bucket_count(n + 1)
+        stager.note_read_extent(off_base, n_off * 8)
 
         def run(buf_dev):
-            col = DeviceColumnData(**common)
+            col = DeviceColumnData(n_values=n, **common)
+            # bucketed offset count (tail garbage past n+1, sliced by to_host)
             col.offsets = _plain_jit(
-                buf_dev, np.int64(off_base), dtype="int64", count=n + 1
+                buf_dev, np.int64(off_base), dtype="int64", count=n_off
             )
             # bucketed slice: heap may carry zero padding past offsets[-1]
             # (trimmed on host by to_host); keeps executables shared
@@ -460,25 +507,23 @@ class _ChunkAssembler:
     def _finish_dict(self, common, stager):
         if self.dict_u8 is None and self.dict_ragged is None:
             raise ParquetError("dictionary-encoded page but no dictionary page seen")
-        widths = set()
+        page_widths = []
         for p in self.pages:
             stream = p.raw[p.value_pos :]
             if len(stream) < 1:
                 raise ParquetError("dictionary page data truncated (missing width)")
             if stream[0] > 32:
                 raise ParquetError(f"dictionary index width {stream[0]} invalid")
-            widths.add(stream[0])
-        if len(widths) > 1:
-            # spec-legal but rare: per-page index widths differ; page-at-a-time
-            return self._finish_host(common)
-        width = widths.pop()
+            page_widths.append(stream[0])
+        uniform = len(set(page_widths)) <= 1
+        width = page_widths[0] if page_widths else 0
         bases = self._value_segments(stager)
-        ends_l, rle_l, vals_l, starts_l = [], [], [], []
+        ends_l, rle_l, vals_l, starts_l, widths_l = [], [], [], [], []
         prefix = 0
         host_max = 0 if self.pages else None
-        for p, base in zip(self.pages, bases):
+        for p, base, pw in zip(self.pages, bases, page_widths):
             stream = p.raw[p.value_pos :]
-            meta = parse_hybrid_meta(stream, width, p.defined, pos=1,
+            meta = parse_hybrid_meta(stream, pw, p.defined, pos=1,
                                      compute_max=True)
             if p.defined == 0:
                 pass  # no indices: nothing to fold into the max
@@ -493,8 +538,9 @@ class _ChunkAssembler:
             # global bit base: page byte base within buf, re-zeroed for the
             # global value position (see jax_kernels.expand_rle_hybrid)
             starts_l.append(
-                meta.run_bit_starts[:n] + base * 8 - prefix * width
+                meta.run_bit_starts[:n] + base * 8 - prefix * pw
             )
+            widths_l.append(np.full(n, pw, dtype=np.uint32))
             prefix += p.defined
         r = max(sum(len(e) for e in ends_l), 1)
         rp = _bucket(r)
@@ -502,12 +548,14 @@ class _ChunkAssembler:
         is_rle = np.zeros(rp, dtype=bool)
         rvals = np.zeros(rp, dtype=np.uint32)
         starts = np.zeros(rp, dtype=np.int64)
+        rwidths = np.zeros(rp, dtype=np.uint32)
         k = 0
-        for e, ir, v, s in zip(ends_l, rle_l, vals_l, starts_l):
+        for e, ir, v, s, w in zip(ends_l, rle_l, vals_l, starts_l, widths_l):
             ends[k : k + len(e)] = e
             is_rle[k : k + len(e)] = ir
             rvals[k : k + len(e)] = v
             starts[k : k + len(e)] = s
+            rwidths[k : k + len(e)] = w
             k += len(e)
         if prefix and self.dict_len == 0:
             raise ParquetError("dictionary indices with empty dictionary")
@@ -516,22 +564,44 @@ class _ChunkAssembler:
                 f"dictionary index {host_max} out of range ({self.dict_len}) "
                 f"in column {'.'.join(self.leaf.path)}"
             )
+        dict_u8 = self.dict_u8
+        if dict_u8 is not None:
+            # pad dictionary rows to a bucketed row count so the gather
+            # executable is shared across chunks with different dict sizes
+            kp = _bucket(max(self.dict_len, 1))
+            if kp != dict_u8.shape[0]:
+                pad = np.zeros((kp - dict_u8.shape[0],) + dict_u8.shape[1:],
+                               dtype=dict_u8.dtype)
+                dict_u8 = np.concatenate([dict_u8, pad])
 
         def run(buf_dev):
-            idx = _hybrid_jit(
-                buf_dev, jnp.asarray(ends), jnp.asarray(is_rle),
-                jnp.asarray(rvals), jnp.asarray(starts), width=width,
-                count=prefix,
-            )
+            if uniform:
+                idx = _hybrid_jit(
+                    buf_dev, jnp.asarray(ends), jnp.asarray(is_rle),
+                    jnp.asarray(rvals), jnp.asarray(starts), np.int64(prefix),
+                    width=width, count=_bucket_count(prefix),
+                )
+            else:
+                # per-page index widths differ (dictionary grew page to
+                # page): same fused expansion with per-run widths
+                idx = _hybrid_vw_jit(
+                    buf_dev, jnp.asarray(ends), jnp.asarray(is_rle),
+                    jnp.asarray(rvals), jnp.asarray(starts),
+                    jnp.asarray(rwidths), np.int64(prefix),
+                    max_width=min(max(8, (max(page_widths) + 7) // 8 * 8), 32),
+                    count=_bucket_count(prefix),
+                )
             if prefix and host_max is None:
                 # no native walk: fall back to the deferred on-device range
-                # check (one extra executable + one sync at finalize)
+                # check (one extra executable + one sync at finalize);
+                # bucketing tail lanes are zeroed by n_valid, so the max
+                # still reflects only real indices
                 self._deferred.append(
                     (_max_jit(idx), self.dict_len, ".".join(self.leaf.path))
                 )
-            col = DeviceDictColumn(indices=idx, **common)
-            if self.dict_u8 is not None:
-                col.dict_u8 = jnp.asarray(self.dict_u8)
+            col = DeviceDictColumn(indices=idx, n_values=prefix, **common)
+            if dict_u8 is not None:
+                col.dict_u8 = jnp.asarray(dict_u8)
                 col.dict_dtype = self.dict_dtype
             else:
                 col.dict_offsets = jnp.asarray(self.dict_ragged.offsets)
@@ -545,36 +615,51 @@ class _ChunkAssembler:
         if ptype not in (Type.INT32, Type.INT64):
             raise ParquetError(f"DELTA_BINARY_PACKED invalid for {ptype!r}")
         bits = 32 if ptype == Type.INT32 else 64
-        bases = self._value_segments(stager)
         metas = []
-        for p, base in zip(self.pages, bases):
+        for p in self.pages:
             m = parse_delta_meta(p.raw[p.value_pos :], bits)
             if m.count < p.defined:
                 raise ParquetError(
                     f"delta stream yielded {m.count} of {p.defined} values"
                 )
             metas.append(m)
-        count = max(m.count for m in metas)
-        m_max = max(m.mini_bit_starts.shape[0] for m in metas)
-        starts = np.zeros((len(metas), m_max), dtype=np.int64)
-        widths = np.zeros((len(metas), m_max), dtype=np.int32)
-        mins = np.zeros((len(metas), m_max), dtype=np.uint64)
-        firsts = np.zeros(len(metas), dtype=np.int64)
+        if any(m.values_per_mini != metas[0].values_per_mini for m in metas):
+            # spec-legal but rare: block geometry differs across pages;
+            # page-at-a-time fallback rather than a per-page-geometry kernel
+            return self._finish_host(common)
+        bases = self._value_segments(stager)
+        # every static shape bucketed; real geometry rides the traced tables
+        n_pages = _bucket(len(metas))
+        count = _bucket_count(max(m.count for m in metas))
+        m_max = _bucket(max(m.mini_bit_starts.shape[0] for m in metas))
+        starts = np.zeros((n_pages, m_max), dtype=np.int64)
+        widths = np.zeros((n_pages, m_max), dtype=np.int32)
+        mins = np.zeros((n_pages, m_max), dtype=np.uint64)
+        firsts = np.zeros(n_pages, dtype=np.int64)
         for i, (m, base) in enumerate(zip(metas, bases)):
             kk = m.mini_bit_starts.shape[0]
             starts[i, :kk] = m.mini_bit_starts + base * 8
+            starts[i, kk:] = starts[i, kk - 1] if kk else 0
             widths[i, :kk] = m.mini_widths
             mins[i, :kk] = m.mini_min_delta
             firsts[i] = m.first_value
-        defined = tuple(p.defined for p in self.pages)
+        total_real = sum(p.defined for p in self.pages)
+        page_starts = np.full(n_pages + 1, total_real, dtype=np.int64)
+        page_starts[0] = 0
+        np.cumsum([p.defined for p in self.pages],
+                  out=page_starts[1 : len(metas) + 1])
+        max_width = max(1, int(widths.max(initial=0)))
+        max_width = min((max_width + 7) // 8 * 8, 64)  # byte-rounded: 8 shapes
         return lambda buf_dev: DeviceColumnData(
             values=_delta_pages_jit(
                 buf_dev, jnp.asarray(firsts), jnp.asarray(starts),
                 jnp.asarray(widths), jnp.asarray(mins),
+                jnp.asarray(page_starts),
                 values_per_mini=metas[0].values_per_mini, count=count,
-                bits=bits, max_width=max(1, int(widths.max(initial=0))),
-                defined=defined,
+                bits=bits, max_width=max_width,
+                total=_bucket_count(total_real),
             ),
+            n_values=total_real,
             **common,
         )
 
@@ -662,7 +747,8 @@ class _ChunkAssembler:
                 idx_parts = [
                     _hybrid_jit(
                         buf_dev, jnp.asarray(e), jnp.asarray(r),
-                        jnp.asarray(v), jnp.asarray(s), width=w, count=c,
+                        jnp.asarray(v), jnp.asarray(s), np.int64(c),
+                        width=w, count=c,
                     )
                     for e, r, v, s, w, c in dict_calls if c
                 ]
@@ -1016,14 +1102,14 @@ class DeviceFileReader:
                             f"iter_batches needs flat columns; {name!r} is "
                             f"repeated"
                         )
-                    if int(col.values.shape[0]) != col.num_leaf_slots:
+                    if col.num_values != col.num_leaf_slots:
                         raise TypeError(
                             f"iter_batches needs null-free columns; {name!r} "
                             f"has "
-                            f"{col.num_leaf_slots - int(col.values.shape[0])} "
+                            f"{col.num_leaf_slots - col.num_values} "
                             f"nulls"
                         )
-                    arrays[name] = col.values
+                    arrays[name] = (col.values, col.num_values)
                 if want is not None:
                     missing = want - set(arrays)
                     if missing:
@@ -1032,7 +1118,7 @@ class DeviceFileReader:
                         )
                 if not arrays:
                     continue
-                ns = {int(v.shape[0]) for v in arrays.values()}
+                ns = {n for _, n in arrays.values()}
                 if len(ns) != 1:
                     raise ParquetError(
                         f"iter_batches: column row counts differ: {sorted(ns)}"
@@ -1040,25 +1126,30 @@ class DeviceFileReader:
                 n_new = ns.pop()
                 if n_new == 0:
                     continue  # zero-row group: placeholder columns, skip
+                # arrays may be bucket-padded past n_new; appends write the
+                # full padded rows (tail garbage lands past `end`, where the
+                # next append or the drop_remainder tail covers it), so all
+                # capacity math uses the padded length
+                pad_len = max(int(v.shape[0]) for v, _ in arrays.values())
                 if first:
-                    cap = _bucket(n_new + batch_size)
+                    cap = _bucket(pad_len + batch_size)
                     bufs = {k: _fit_rows_jit(v, size=cap)
-                            for k, v in arrays.items()}
+                            for k, (v, _) in arrays.items()}
                     start, end = 0, n_new
                     first = False
                 else:
-                    if end + n_new > cap and start:  # compact [start, end) to 0
+                    if end + pad_len > cap and start:  # compact [start, end) to 0
                         bufs = {k: _roll_rows_jit(v, np.int64(-start))
                                 for k, v in bufs.items()}
                         end -= start
                         start = 0
-                    if end + n_new > cap:  # still short: grow capacity
-                        cap = _bucket(end + n_new + batch_size)
+                    if end + pad_len > cap:  # still short: grow capacity
+                        cap = _bucket(end + pad_len + batch_size)
                         bufs = {k: _fit_rows_jit(v, size=cap)
                                 for k, v in bufs.items()}
                     bufs = {
                         k: _update_rows_jit(bufs[k], v, np.int64(end))
-                        for k, v in arrays.items()
+                        for k, (v, _) in arrays.items()
                     }
                     end += n_new
                 # the carry is device memory held across row groups: count it

@@ -76,6 +76,19 @@ def _bucket_bytes(n: int, floor: int = 64) -> int:
     return ((n + step - 1) // step) * step
 
 
+def _bucket_count(n: int) -> int:
+    """Bucket a value count: 8 steps per power-of-two octave (<= 12.5% pad).
+
+    The decode kernels take their output size as a *static* shape, so every
+    distinct count otherwise compiles a fresh executable — and over a tunneled
+    backend each remote compile costs tens of seconds, dominating first-open
+    wall clock (the row groups of one file rarely share exact value counts).
+    Decoding into the bucketed size (tail lanes masked or sliced off on host)
+    collapses that diversity to <= 8 shapes per octave per kernel family.
+    """
+    return _bucket_bytes(max(n, 1), 8)
+
+
 def pad_buffer(raw: bytes | np.ndarray) -> jax.Array:
     """Stage a byte buffer on device, padded so bit-extract gathers stay in bounds."""
     arr = np.frombuffer(raw, dtype=np.uint8) if isinstance(raw, (bytes, bytearray, memoryview)) else raw
@@ -223,9 +236,24 @@ def _parse_hybrid_meta_py(
 
 
 @functools.partial(jax.jit, static_argnames=("width", "count"))
-def _hybrid_jit(buf, run_ends, run_is_rle, run_values, run_bit_starts, *, width, count):
+def _hybrid_jit(buf, run_ends, run_is_rle, run_values, run_bit_starts, n_valid,
+                *, width, count):
+    """``count`` is the (possibly bucketed) static output size; ``n_valid`` is
+    the traced real count — tail lanes beyond it are zeroed."""
     return K.expand_rle_hybrid(
-        buf, run_ends, run_is_rle, run_values, run_bit_starts, width, count
+        buf, run_ends, run_is_rle, run_values, run_bit_starts, width, count,
+        n_valid=n_valid,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("max_width", "count"))
+def _hybrid_vw_jit(buf, run_ends, run_is_rle, run_values, run_bit_starts,
+                   run_widths, n_valid, *, max_width, count):
+    """Variable-width hybrid expansion (per-run widths — multi-page dict
+    chunks whose index width grows as the dictionary fills)."""
+    return K.expand_rle_hybrid_vw(
+        buf, run_ends, run_is_rle, run_values, run_bit_starts, run_widths,
+        max_width, count, n_valid=n_valid,
     )
 
 
@@ -237,6 +265,7 @@ def decode_hybrid_device(buf_dev: jax.Array, meta: HybridMeta, width: int) -> ja
         jnp.asarray(meta.run_is_rle),
         jnp.asarray(meta.run_values),
         jnp.asarray(meta.run_bit_starts),
+        np.int64(meta.count),
         width=width,
         count=meta.count,
     )
@@ -623,20 +652,49 @@ class DeviceColumnData:
     # uint32[n,2] word pairs on device (TPU f64 emulation rounds real f64 data —
     # see jax_kernels.plain_decode_fixed) and only become f64 on the host.
     value_dtype: Optional[str] = None
+    # Number of REAL defined values; device arrays may be padded past it to a
+    # bucketed static shape (executable sharing across chunks — _bucket_count).
+    # None means the arrays are exact.  Level arrays may likewise be padded
+    # past num_leaf_slots.  A jitted consumer *wants* the bucketed shapes (it
+    # recompiles per shape); host materialization slices the padding off.
+    n_values: Optional[int] = None
+
+    @property
+    def num_values(self) -> int:
+        """Real defined-value count (excludes bucketing pad and nulls)."""
+        if self.n_values is not None:
+            return self.n_values
+        if self.values is not None:
+            return int(self.values.shape[0])
+        if self.offsets is not None:
+            return max(int(self.offsets.shape[0]) - 1, 0)
+        return 0
 
     def validity(self) -> jax.Array:
         if self.def_levels is None:
             return jnp.ones(self.num_leaf_slots, dtype=bool)
-        return K.levels_to_validity(self.def_levels, self.max_def)
+        # def_levels may be bucket-padded; tail lanes are garbage, so the
+        # mask must stop at the real slot count
+        return K.levels_to_validity(
+            self.def_levels, self.max_def
+        )[: self.num_leaf_slots]
+
+    def levels_to_host(self):
+        """(def_levels, rep_levels) as exact host arrays (padding sliced)."""
+        n = self.num_leaf_slots
+        d = None if self.def_levels is None else np.asarray(self.def_levels)[:n]
+        r = None if self.rep_levels is None else np.asarray(self.rep_levels)[:n]
+        return d, r
 
     def to_host(self) -> "ByteArrayData | np.ndarray":
+        n = self.num_values
         if self.offsets is not None:
-            off = np.asarray(self.offsets)
+            off = np.asarray(self.offsets)[: n + 1]
             heap = np.asarray(self.heap)
             if len(off) and heap.nbytes > off[-1]:
                 heap = heap[: off[-1]]  # drop bucketed staging padding
             return ByteArrayData(offsets=off, heap=heap)
-        vals = np.asarray(self.values)
+        vals = np.asarray(self.values)[:n]
         if self.value_dtype == "float64" and vals.ndim == 2:
             return np.ascontiguousarray(vals).view("<f8").reshape(len(vals))
         return vals

@@ -62,6 +62,7 @@ __all__ = [
     "extract_bits",
     "unpack_bits",
     "expand_rle_hybrid",
+    "expand_rle_hybrid_vw",
     "delta_reconstruct",
     "dict_gather",
     "dict_gather_bytes",
@@ -95,6 +96,10 @@ def extract_bits(buf: jax.Array, bit_pos: jax.Array, width: jax.Array, max_width
     byte0 = bit_pos >> 3
     shift = (bit_pos & 7).astype(jnp.uint32)
     nbytes = (max_width + 7 + 7) // 8  # widest field + worst-case 7-bit shift
+    # bucketed decode shapes may carry tail positions past the real stream;
+    # clamp the gather base so every lane stays in bounds (tail lanes read
+    # garbage that callers mask or slice away)
+    byte0 = jnp.minimum(byte0, max(buf.shape[0] - 9, 0))
     if max_width <= 25:
         # fits in one uint32 accumulation (25 + 7 = 32)
         acc = jnp.zeros(bit_pos.shape, dtype=jnp.uint32)
@@ -164,8 +169,15 @@ def expand_rle_hybrid(
     run_bit_starts: jax.Array,
     width: int,
     count: int,
+    n_valid=None,
 ):
     """Expand a parsed RLE/bit-packed hybrid stream to ``count`` values.
+
+    ``count`` may be a *bucketed* static size larger than the stream's real
+    value count; pass the real count as the traced scalar ``n_valid`` and the
+    tail lanes come back zeroed (so e.g. deferred dictionary-index range
+    checks never see tail garbage).  One executable then serves every stream
+    whose count lands in the same bucket.
 
     Host side (jax_decode.parse_hybrid_device) walks the run headers — a few bytes
     per run — and hands over per-run metadata (padded to a static run count):
@@ -193,7 +205,48 @@ def expand_rle_hybrid(
     # clamp BP gathers for RLE positions to 0 so they stay in bounds
     bit_pos = jnp.where(is_rle, 0, bit_pos)
     bp_val = extract_bits(buf, bit_pos, width, width)
-    return jnp.where(is_rle, rle_val.astype(bp_val.dtype), bp_val)
+    out = jnp.where(is_rle, rle_val.astype(bp_val.dtype), bp_val)
+    if n_valid is not None:
+        out = jnp.where(pos < n_valid, out, jnp.zeros((), dtype=out.dtype))
+    return out
+
+
+@scoped_x64
+def expand_rle_hybrid_vw(
+    buf: jax.Array,
+    run_ends: jax.Array,
+    run_is_rle: jax.Array,
+    run_values: jax.Array,
+    run_bit_starts: jax.Array,
+    run_widths: jax.Array,
+    max_width: int,
+    count: int,
+    n_valid=None,
+):
+    """Variable-width :func:`expand_rle_hybrid`: each run carries its own bit
+    width (``run_widths`` uint32[R], 0 for RLE runs).
+
+    A dictionary-encoded column chunk is one hybrid stream per page, and the
+    index width legally GROWS page to page as the dictionary fills (pyarrow
+    writes exactly that).  Treating the width as per-run data instead of a
+    static lets one executable decode the whole chunk's merged run table —
+    per-value dynamic widths are what :func:`extract_bits` is built for.
+    ``max_width`` is the static gather-footprint bound (round it to a
+    multiple of 8 to share executables).
+    """
+    pos = jnp.arange(count, dtype=jnp.int64)
+    r = jnp.searchsorted(run_ends, pos, side="right").astype(jnp.int32)
+    r = jnp.minimum(r, run_ends.shape[0] - 1)
+    is_rle = run_is_rle[r]
+    rle_val = run_values[r]
+    w = run_widths[r].astype(jnp.int64)
+    bit_pos = run_bit_starts[r] + pos * w
+    bit_pos = jnp.where(is_rle, 0, bit_pos)
+    bp_val = extract_bits(buf, bit_pos, w.astype(jnp.uint32), max_width)
+    out = jnp.where(is_rle, rle_val.astype(bp_val.dtype), bp_val)
+    if n_valid is not None:
+        out = jnp.where(pos < n_valid, out, jnp.zeros((), dtype=out.dtype))
+    return out
 
 
 # ---------------------------------------------------------------------------
