@@ -20,21 +20,23 @@ COMPAT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))
                       "compatibility")
 sys.path.insert(0, COMPAT)
 
+import importlib.util as _ilu  # noqa: E402
+
+# load the harness's build module by path: the bare name `build` would
+# collide with PyPA's installed `build` package in sys.modules
+_spec = _ilu.spec_from_file_location(
+    "tpq_compat_build", os.path.join(COMPAT, "build.py"))
+_build = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_build)
+CODECS = _build.CODECS
+
 from data_model import (  # noqa: E402
     SCHEMA_TEXT, from_parquet_row, generate, to_parquet_row,
 )
 
-from tpu_parquet.format import CompressionCodec  # noqa: E402
 from tpu_parquet.reader import FileReader  # noqa: E402
 from tpu_parquet.schema.dsl import parse_schema_definition  # noqa: E402
 from tpu_parquet.writer import FileWriter  # noqa: E402
-
-CODECS = {
-    "none": CompressionCodec.UNCOMPRESSED,
-    "gzip": CompressionCodec.GZIP,
-    "snappy": CompressionCodec.SNAPPY,
-    "zstd": CompressionCodec.ZSTD,
-}
 
 
 @pytest.fixture(scope="module")

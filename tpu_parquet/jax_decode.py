@@ -36,7 +36,8 @@ from .footer import ParquetError
 from .format import Encoding, PageType, Type, parse_encoding
 from .kernels import bitpack, rle
 from .kernels.rle import RLEError, _read_uvarint
-from .kernels.delta import DeltaError, _read_uvarint as _delta_uvarint, _read_zigzag
+from .kernels import delta as delta_host
+from .kernels.delta import DeltaError
 from .chunk_decode import PageSlice, validate_chunk_meta, walk_pages, _check_crc
 from .schema.core import SchemaNode
 
@@ -286,104 +287,45 @@ class DeltaMeta:
     consumed: int
 
 
+def _meta_from_headers(hdrs) -> DeltaMeta:
+    """Bucket-pad a kernels.delta.parse_headers result into a DeltaMeta."""
+    first, starts, widths, mins, values_per_mini, total, consumed = hdrs
+    n = len(starts)
+    mp = _bucket(max(n, 1))
+    bs = np.zeros(mp, dtype=np.int64)
+    ws = np.zeros(mp, dtype=np.int32)
+    md = np.zeros(mp, dtype=np.uint64)
+    if n:
+        bs[:n] = starts
+        ws[:n] = widths
+        md[:n] = mins
+        bs[n:] = starts[-1]
+    return DeltaMeta(first, bs, ws, md, values_per_mini, total, consumed)
+
+
 def parse_delta_meta(buf: bytes, bits: int, pos: int = 0) -> DeltaMeta:
     """Walk DELTA_BINARY_PACKED headers, recording per-miniblock geometry.
 
     The payload bytes are never touched: only the varint headers and the
     bit-width byte vectors are read (deltabp_decoder.go:38-103 structure).
-    Runs in C when the native library is available (native/meta_parse.cpp,
-    identical semantics); the Python walk below is the reference
-    implementation and the no-toolchain fallback.
+    The walk itself lives in kernels.delta.parse_headers (native C with a
+    Python reference fallback — one source of truth for host and device
+    paths); this wrapper only adds the bucketed table padding.  ``bits`` is
+    kept for API stability: widths up to 64 are accepted even for 32-bit
+    columns (wrap-mod-2^32 parity with the Go reference).
     """
-    got = _native_delta_meta(buf, pos)
-    if got is not None:
-        return got
-    return _parse_delta_meta_py(buf, bits, pos)
+    return _meta_from_headers(delta_host.parse_headers(buf, pos))
 
 
 def _native_delta_meta(buf: bytes, pos: int) -> Optional[DeltaMeta]:
-    from . import native
-
-    # one miniblock costs >= its width-vector byte, so len(buf) bounds the
-    # miniblock count even for hostile headers; +4 covers tiny streams
-    cap = len(buf) - pos + 4
-    res = native.delta_meta(buf, pos, cap)
-    if res is None:
-        return None
-    if isinstance(res, int):
-        if res == -10:
-            return None  # cannot happen given cap bound; let Python diagnose
-        raise DeltaError(_NATIVE_ERRORS.get(res, f"delta parse error {res}"))
-    header, starts, widths, mins = res
-    _, minis_per_block, total, first, consumed, n_minis = (int(x) for x in header)
-    values_per_mini = int(header[0]) // minis_per_block
-    mp = _bucket(max(n_minis, 1))
-    bs = np.zeros(mp, dtype=np.int64)
-    ws = np.zeros(mp, dtype=np.int32)
-    md = np.zeros(mp, dtype=np.uint64)
-    if n_minis:
-        bs[:n_minis] = starts
-        ws[:n_minis] = widths
-        md[:n_minis] = mins
-        bs[n_minis:] = starts[-1]
-    return DeltaMeta(first, bs, ws, md, values_per_mini, total, consumed)
+    """Native-walk-only variant (fuzz parity oracle — see fuzz.py)."""
+    hdrs = delta_host.native_headers(buf, pos)
+    return None if hdrs is None else _meta_from_headers(hdrs)
 
 
 def _parse_delta_meta_py(buf: bytes, bits: int, pos: int = 0) -> DeltaMeta:
-    block_size, pos = _delta_uvarint(buf, pos)
-    minis_per_block, pos = _delta_uvarint(buf, pos)
-    total, pos = _delta_uvarint(buf, pos)
-    first, pos = _read_zigzag(buf, pos)
-    if block_size == 0 or block_size % 128 != 0:
-        raise DeltaError(f"invalid delta block size {block_size}")
-    if block_size > 1 << 30:  # decompression-bomb guard (parity: meta_parse.cpp)
-        raise DeltaError(f"implausible delta block size {block_size}")
-    if minis_per_block == 0 or block_size % minis_per_block != 0:
-        raise DeltaError(f"invalid miniblock count {minis_per_block}")
-    values_per_mini = block_size // minis_per_block
-    if values_per_mini % 32 != 0:
-        raise DeltaError(f"miniblock size {values_per_mini} not multiple of 32")
-    if total > 1 << 40:
-        raise DeltaError(f"implausible delta value count {total}")
-
-    starts, widths, mins = [], [], []
-    got = 0
-    n_deltas = max(total - 1, 0)
-    mask = 0xFFFFFFFFFFFFFFFF
-    while got < n_deltas:
-        min_delta, pos = _read_zigzag(buf, pos)
-        if pos + minis_per_block > len(buf):
-            raise DeltaError("truncated miniblock bit widths")
-        wvec = buf[pos : pos + minis_per_block]
-        pos += minis_per_block
-        for m in range(minis_per_block):
-            if got >= n_deltas:
-                break
-            w = wvec[m]
-            # widths up to 64 are accepted even for 32-bit columns (host
-            # parity: kernels/delta.py wraps mod 2^32, as does the Go reference)
-            if w > 64:
-                raise DeltaError(f"invalid miniblock bit width {w}")
-            nbytes = (values_per_mini * w + 7) // 8
-            if pos + nbytes > len(buf):
-                raise DeltaError("truncated miniblock data")
-            starts.append(pos * 8)
-            widths.append(w)
-            mins.append(min_delta & mask)
-            pos += nbytes
-            got += min(values_per_mini, n_deltas - got)
-
-    m = max(len(starts), 1)
-    mp = _bucket(m)
-    bs = np.zeros(mp, dtype=np.int64)
-    ws = np.zeros(mp, dtype=np.int32)
-    md = np.zeros(mp, dtype=np.uint64)
-    if starts:
-        bs[: len(starts)] = starts
-        ws[: len(widths)] = widths
-        md[: len(mins)] = mins
-        bs[len(starts):] = starts[-1]
-    return DeltaMeta(first, bs, ws, md, values_per_mini, total, pos)
+    """Python-walk-only variant (fuzz parity oracle — see fuzz.py)."""
+    return _meta_from_headers(delta_host.python_headers(buf, pos))
 
 
 @functools.partial(
@@ -440,19 +382,26 @@ class ParsedDataPage:
     encoding: int
     def_levels: Optional[np.ndarray] = None
     rep_levels: Optional[np.ndarray] = None
+    # raw RLE/bit-packed level streams as (source_buffer, start, size): the
+    # batched reader stages THESE (run-dominated, tiny) and expands them on
+    # device, instead of shipping the host-decoded uint32 arrays (4 bytes per
+    # leaf slot per level — the dominant transfer on nested files)
+    def_stream: Optional[tuple] = None
+    rep_stream: Optional[tuple] = None
 
 
 def parse_data_page(
     ps: PageSlice, buf: bytes, codec: int, leaf: SchemaNode,
-    validate_crc: bool = False, alloc=None,
+    validate_crc: bool = False, alloc=None, decode_rep: bool = True,
 ) -> ParsedDataPage:
     """Parse one v1/v2 data page on host (no device work).
 
-    Levels are metadata-sized and RLE-run dominated — host expansion is cheap,
-    yields the defined-count for free, and avoids a blocking device→host sync
-    per page that would serialize the page pipeline.  The device-side
-    *reconstruction* from levels (validity scatter, row starts) runs as prefix
-    scans in jax_kernels.
+    Def levels host-decode here because the defined-count gates every static
+    decode shape; rep levels are only *located* when ``decode_rep=False``
+    (the batched reader expands them on device from the recorded stream, so
+    a host decode would be dead work — the v1 length prefix gives the span
+    without decoding).  The device-side *reconstruction* from levels
+    (validity scatter, row starts) runs as prefix scans in jax_kernels.
     """
     header = ps.header
     payload = buf[ps.payload_start : ps.payload_end]
@@ -470,15 +419,28 @@ def parse_data_page(
             raise ParquetError(f"negative page value count {num_values}")
         pos = 0
         rlv = dlv = None
+        rsp = dsp = None
         if max_rep > 0:
-            rlv, used = rle.decode_prefixed(
-                raw[pos:], bitpack.bit_width(max_rep), num_values
-            )
+            if decode_rep:
+                rlv, used = rle.decode_prefixed(
+                    raw[pos:], bitpack.bit_width(max_rep), num_values
+                )
+            else:  # span only: u32 length prefix locates the stream
+                if len(raw) - pos < 4:
+                    raise ParquetError("truncated level stream length prefix")
+                size = int.from_bytes(raw[pos : pos + 4], "little")
+                if pos + 4 + size > len(raw):
+                    raise ParquetError(
+                        f"level stream length {size} exceeds page"
+                    )
+                used = 4 + size
+            rsp = (raw, pos + 4, used - 4)  # hybrid payload past the u32 size
             pos += used
         if max_def > 0:
             dlv, used = rle.decode_prefixed(
                 raw[pos:], bitpack.bit_width(max_def), num_values
             )
+            dsp = (raw, pos + 4, used - 4)
             pos += used
         defined = (
             int(np.count_nonzero(dlv == max_def)) if dlv is not None else num_values
@@ -486,6 +448,7 @@ def parse_data_page(
         return ParsedDataPage(
             raw=raw, value_pos=pos, num_values=num_values, defined=defined,
             encoding=dh.encoding, def_levels=dlv, rep_levels=rlv,
+            def_stream=dsp, rep_stream=rsp,
         )
 
     dh = header.data_page_header_v2
@@ -497,15 +460,20 @@ def parse_data_page(
     if rep_len < 0 or def_len < 0 or rep_len + def_len > len(payload):
         raise ParquetError("v2 level lengths exceed page")
     rlv = dlv = None
+    rsp = dsp = None
     if max_rep > 0:
         if rep_len == 0:
             raise ParquetError("v2 page missing repetition levels")
-        rlv = rle.decode(payload[:rep_len], bitpack.bit_width(max_rep), num_values)
+        if decode_rep:
+            rlv = rle.decode(payload[:rep_len], bitpack.bit_width(max_rep),
+                             num_values)
+        rsp = (payload, 0, rep_len)
     if max_def > 0:
         dlv = rle.decode(
             payload[rep_len : rep_len + def_len],
             bitpack.bit_width(max_def), num_values,
         )
+        dsp = (payload, rep_len, def_len)
     if dh.num_nulls is not None and dlv is not None:
         actual_nulls = int(np.count_nonzero(dlv != max_def))
         if dh.num_nulls != actual_nulls and max_rep == 0:
@@ -524,6 +492,7 @@ def parse_data_page(
     return ParsedDataPage(
         raw=raw, value_pos=0, num_values=num_values, defined=defined,
         encoding=dh.encoding, def_levels=dlv, rep_levels=rlv,
+        def_stream=dsp, rep_stream=rsp,
     )
 
 

@@ -1,0 +1,130 @@
+"""Pallas TPU kernels for the hot bit-level decode primitives.
+
+The XLA decode kernels (jax_kernels.py) express bit extraction as per-value
+byte *gathers* — fully general (arbitrary per-value positions/widths), which
+the RLE-hybrid and delta paths need.  But the single hottest primitive —
+fixed-width unpack of an 8-value-aligned stream (the reference's 98 generated
+``unpack8intXX_N`` functions, bitbacking32.go/bitpacking64.go) — has an
+affine access pattern Pallas can exploit: a tile of 8 values occupies exactly
+``width`` contiguous bytes, so every byte a lane needs is a STATIC column of
+a (groups, width) byte matrix.  The kernel below is pure strided loads +
+shifts + ors: no gathers, no dynamic indexing, VMEM-resident.
+
+Layout: values [g*8+j] live in row g of the (G, width) byte matrix; value j's
+bits start at static bit ``j*width`` of the row, so the unroll over j∈[0,8)
+bakes byte offsets and shifts into the instruction stream — the same
+specialization trick as the reference's generated Go, but one parameterized
+kernel instead of 98 source functions, and 8×128 lanes per VPU op instead of
+one value per iteration.
+
+On non-TPU backends (CPU tests) the kernel runs through the Pallas
+interpreter; ``unpack_bits`` in jax_kernels.py remains the default path until
+`use_pallas=True` callers opt in (bench.py A/Bs the two).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["unpack_bits_pallas", "build_planes", "pallas_available"]
+
+_GROUPS_PER_TILE = 1024  # 8192 values per grid step; (1024,) = one 8x128 tile
+
+
+def pallas_available() -> bool:
+    """True when the current default backend can run Mosaic TPU kernels."""
+    try:
+        plat = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001
+        return False
+    return plat in ("tpu", "axon")
+
+
+def _unpack_kernel(width: int, in_ref, out_ref):
+    """One tile: (width, G) byte PLANES -> (G, 8) values.
+
+    Plane b holds byte b of every group's packed row (host transposes once).
+    Leading-dim static indexing `in_ref[k, :]` is the layout Mosaic lowers
+    cleanly — strided u8 column slices of a (G, width) tile miscompile
+    (verified on v5e: the `<<16` term of 3-byte accumulations silently
+    drops for ~1/4 of the lanes).
+
+    Static unroll over the 8 values of a group: value j's field starts at bit
+    j*width of its row, i.e. byte j*width//8 with shift j*width%8 — all
+    Python ints at trace time, so the loop emits straight-line vector code.
+    """
+    mask = jnp.uint32((1 << width) - 1 if width < 32 else 0xFFFFFFFF)
+    for j in range(8):
+        start = (j * width) // 8
+        shift = (j * width) % 8
+        end = (j * width + width - 1) // 8  # inclusive last byte
+        acc = in_ref[start, :].astype(jnp.uint32)
+        for k in range(start + 1, min(end, start + 3) + 1):
+            acc = acc | (in_ref[k, :].astype(jnp.uint32)
+                         << jnp.uint32(8 * (k - start)))
+        val = acc if shift == 0 else acc >> jnp.uint32(shift)
+        if end - start + 1 > 4:  # 5-byte span (width>25, shift>0): straggler
+            val = val | (in_ref[start + 4, :].astype(jnp.uint32)
+                         << jnp.uint32(32 - shift))
+        out_ref[:, j] = val & mask
+
+
+@functools.partial(jax.jit, static_argnames=("width", "count", "interpret"))
+def _unpack_pallas_jit(planes, *, width, count, interpret):
+    from jax.experimental import pallas as pl
+
+    groups = planes.shape[1]
+    grid = groups // _GROUPS_PER_TILE
+    out = pl.pallas_call(
+        functools.partial(_unpack_kernel, width),
+        out_shape=jax.ShapeDtypeStruct((groups, 8), jnp.uint32),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((width, _GROUPS_PER_TILE), lambda t: (0, t))],
+        out_specs=pl.BlockSpec((_GROUPS_PER_TILE, 8), lambda t: (t, 0)),
+        interpret=interpret,
+    )(planes)
+    return out.reshape(-1)[:count]
+
+
+def build_planes(buf, width: int, count: int) -> jax.Array:
+    """Stage packed bytes as the kernel's (width, padded_groups) byte planes.
+
+    Pads to whole 8-value groups and whole tiles, then transposes once so
+    plane k holds byte k of every group's row (the layout the kernel's
+    leading-dim indexing needs — see _unpack_kernel).
+    """
+    groups = -(-count // 8)                    # ceil: values -> 8-value groups
+    gpad = -(-max(groups, 1) // _GROUPS_PER_TILE) * _GROUPS_PER_TILE
+    need = gpad * width
+    if isinstance(buf, jax.Array):
+        n = buf.shape[0]
+        flat = buf[:need] if n >= need else jnp.pad(buf, (0, need - n))
+        return flat.reshape(gpad, width).T
+    host = np.asarray(buf)
+    padded = np.zeros(need, dtype=np.uint8)
+    padded[: min(len(host), need)] = host[:need]
+    return jnp.asarray(np.ascontiguousarray(padded.reshape(gpad, width).T))
+
+
+def unpack_bits_pallas(buf, width: int, count: int, interpret: bool | None = None):
+    # NOTE: deliberately NOT under scoped_x64 — the kernel is pure uint32 and
+    # an x64 trace makes the grid index maps emit i64, which Mosaic refuses
+    # to legalize ("func.return (i32, i64)").
+    """Fixed-width LSB-first unpack via the Pallas tile kernel.
+
+    ``buf`` uint8[...] packed bytes (numpy or jax); ``count`` values out.
+    Drop-in for jax_kernels.unpack_bits on width 1..32.  ``interpret`` forces
+    the Pallas interpreter (auto: on for non-TPU backends so CPU tests run).
+    """
+    if not 1 <= width <= 32:
+        raise ValueError(f"unpack_bits_pallas supports widths 1..32, got {width}")
+    if interpret is None:
+        interpret = not pallas_available()
+    planes = build_planes(buf, width, count)
+    return _unpack_pallas_jit(planes, width=width, count=count,
+                              interpret=bool(interpret))
