@@ -332,13 +332,12 @@ class _ChunkAssembler:
         if leaf.max_def > 0:
             d_plan = self._plan_levels(
                 stager, [p.def_stream for p in self.pages],
-                [p.def_levels for p in self.pages],
                 bitpack.bit_width(leaf.max_def), slots, slots_pad,
+                metas=[p.def_meta for p in self.pages],
             )
         if leaf.max_rep > 0:
             r_plan = self._plan_levels(
                 stager, [p.rep_stream for p in self.pages],
-                [p.rep_levels for p in self.pages],
                 bitpack.bit_width(leaf.max_rep), slots, slots_pad,
             )
 
@@ -392,8 +391,8 @@ class _ChunkAssembler:
 
         return run
 
-    def _plan_levels(self, stager: _RowGroupStager, streams, decoded, width: int,
-                     slots: int, slots_pad: int):
+    def _plan_levels(self, stager: _RowGroupStager, streams, width: int,
+                     slots: int, slots_pad: int, metas=None):
         """Stage the pages' raw RLE level streams and expand them on device.
 
         Levels are run-dominated: the encoded stream is a fraction of the
@@ -401,22 +400,23 @@ class _ChunkAssembler:
         instead of host-decoded uint32 arrays cuts the dominant transfer on
         nested files (~2/3 of staged bytes on the LIST/MAP bench config).
         Returns ``fn(buf_dev) -> uint32[slots_pad]`` (tail past ``slots``
-        zeroed), or falls back to staging decoded arrays if any page lacks
-        its recorded stream span.
+        zeroed).  Every decode_levels=False parse records the stream span
+        whenever max_def/max_rep > 0, so a missing span is a caller bug.
         """
+        if metas is None:
+            metas = [None] * len(self.pages)
         if any(s is None for s in streams):
-            flat = np.ascontiguousarray(np.concatenate(decoded), dtype=np.uint32)
-            base = stager.add(flat)
-            stager.note_read_extent(base, slots_pad * 4)
-            return lambda buf_dev: _plain_jit(
-                buf_dev, np.int64(base), dtype="uint32", count=slots_pad
+            raise ParquetError(
+                "internal: level stream span missing on the batched path"
             )
         bases = stager.add_segments(list(streams))
         ends_l, rle_l, vals_l, starts_l = [], [], [], []
         prefix = 0
-        for (src, start, size), base, p in zip(streams, bases, self.pages):
-            meta = parse_hybrid_meta(src, width, p.num_values, pos=start,
-                                     end=start + size)
+        for (src, start, size), base, p, m in zip(streams, bases, self.pages,
+                                                  metas):
+            meta = m if m is not None else parse_hybrid_meta(
+                src, width, p.num_values, pos=start, end=start + size
+            )
             n = meta.n_runs
             ends_l.append(meta.run_ends[:n] + prefix)
             rle_l.append(meta.run_is_rle[:n])
@@ -896,7 +896,7 @@ def _collect_chunk(
         if pt in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
             asm.pages.append(
                 parse_data_page(ps, buf, codec, leaf, validate_crc=validate_crc,
-                                alloc=alloc, decode_rep=False)
+                                alloc=alloc, decode_levels=False)
             )
             continue
         # index/unknown pages: skip

@@ -117,6 +117,7 @@ class HybridMeta:
     consumed: int              # bytes consumed from the stream
     n_runs: int = 0            # real (unpadded) run count
     max_value: Optional[int] = None  # stream max (native walk only, on request)
+    eq_count: Optional[int] = None   # values == eq_target (native walk only)
 
 
 from .native import NATIVE_ERRORS as _NATIVE_ERRORS
@@ -124,7 +125,7 @@ from .native import NATIVE_ERRORS as _NATIVE_ERRORS
 
 def parse_hybrid_meta(
     buf: bytes, width: int, count: int, pos: int = 0, end: Optional[int] = None,
-    compute_max: bool = False,
+    compute_max: bool = False, eq_target: Optional[int] = None,
 ) -> HybridMeta:
     """Walk run headers only (no payload unpacking) — cheap, O(runs) bytes.
 
@@ -136,6 +137,9 @@ def parse_hybrid_meta(
     ``compute_max`` additionally reports the stream's maximum value when the
     native walk is available (``max_value``; None otherwise) — dictionary
     callers use it to range-check indices on host with zero device syncs.
+    ``eq_target`` likewise reports ``eq_count``, the number of stream values
+    equal to the target — def-level callers pass max_def and get the page's
+    defined count without ever materializing the decoded levels.
 
     The walk itself runs in C when the native library is available
     (native/meta_parse.cpp, identical semantics); this Python loop is the
@@ -145,24 +149,26 @@ def parse_hybrid_meta(
         raise RLEError(f"invalid hybrid bit width {width} for device path")
     n = len(buf) if end is None else min(end, len(buf))
     if count > 0:
-        got = _native_hybrid_meta(buf, n, pos, width, count, compute_max)
+        got = _native_hybrid_meta(buf, n, pos, width, count, compute_max,
+                                  eq_target)
         if got is not None:
             return got
     return _parse_hybrid_meta_py(buf, width, count, pos, n)
 
 
-def _native_hybrid_meta(buf, n, pos, width, count, compute_max=False) -> Optional[HybridMeta]:
+def _native_hybrid_meta(buf, n, pos, width, count, compute_max=False,
+                        eq_target=None) -> Optional[HybridMeta]:
     from . import native
 
     res = native.hybrid_meta_retry(buf, n, pos, width, count,
-                                   want_max=compute_max)
+                                   want_max=compute_max, eq_target=eq_target)
     if res is None:
         return None
     if isinstance(res, int):
         if res == -10:  # cap retry exhausted: let the Python walk diagnose
             return None
         raise RLEError(_NATIVE_ERRORS.get(res, f"hybrid parse error {res}"))
-    n_runs, consumed, ends, kinds, vals, starts, max_value = res
+    n_runs, consumed, ends, kinds, vals, starts, max_value, eq_count = res
     rp = _bucket(max(n_runs, 1))
     run_ends = np.full(rp, count, dtype=np.int64)
     run_is_rle = np.zeros(rp, dtype=bool)
@@ -174,7 +180,7 @@ def _native_hybrid_meta(buf, n, pos, width, count, compute_max=False) -> Optiona
     run_bit_starts[:n_runs] = starts
     return HybridMeta(
         run_ends, run_is_rle, run_values, run_bit_starts, count, consumed,
-        n_runs=n_runs, max_value=max_value,
+        n_runs=n_runs, max_value=max_value, eq_count=eq_count,
     )
 
 
@@ -388,20 +394,27 @@ class ParsedDataPage:
     # leaf slot per level — the dominant transfer on nested files)
     def_stream: Optional[tuple] = None
     rep_stream: Optional[tuple] = None
+    # def-stream run tables from the decode_levels=False walk (native eq-count
+    # gives `defined` without materializing levels); reused by _plan_levels
+    def_meta: Optional["HybridMeta"] = None
 
 
 def parse_data_page(
     ps: PageSlice, buf: bytes, codec: int, leaf: SchemaNode,
-    validate_crc: bool = False, alloc=None, decode_rep: bool = True,
+    validate_crc: bool = False, alloc=None, decode_levels: bool = True,
 ) -> ParsedDataPage:
     """Parse one v1/v2 data page on host (no device work).
 
-    Def levels host-decode here because the defined-count gates every static
-    decode shape; rep levels are only *located* when ``decode_rep=False``
-    (the batched reader expands them on device from the recorded stream, so
-    a host decode would be dead work — the v1 length prefix gives the span
-    without decoding).  The device-side *reconstruction* from levels
-    (validity scatter, row starts) runs as prefix scans in jax_kernels.
+    With ``decode_levels=False`` (the batched reader) neither level array is
+    host-decoded: rep streams are only *located* (the v1 length prefix gives
+    the span without decoding), and def streams are header-walked with the
+    native eq-counter (meta_parse.cpp want_eq) so the defined-value count —
+    which gates every static decode shape — comes straight off the run walk;
+    the run tables are kept on the page for the device-side expansion.
+    Without the native library the def levels fall back to a host decode
+    (the count has to come from somewhere).  The device-side
+    *reconstruction* from levels (validity scatter, row starts) runs as
+    prefix scans in jax_kernels.
     """
     header = ps.header
     payload = buf[ps.payload_start : ps.payload_end]
@@ -420,35 +433,51 @@ def parse_data_page(
         pos = 0
         rlv = dlv = None
         rsp = dsp = None
+        def_meta = None
+
+        def _prefixed_span(p0):
+            """v1 length prefix: locate the stream without decoding it."""
+            if len(raw) - p0 < 4:
+                raise ParquetError("truncated level stream length prefix")
+            size = int.from_bytes(raw[p0 : p0 + 4], "little")
+            if p0 + 4 + size > len(raw):
+                raise ParquetError(f"level stream length {size} exceeds page")
+            return size
+
         if max_rep > 0:
-            if decode_rep:
+            if decode_levels:
                 rlv, used = rle.decode_prefixed(
                     raw[pos:], bitpack.bit_width(max_rep), num_values
                 )
-            else:  # span only: u32 length prefix locates the stream
-                if len(raw) - pos < 4:
-                    raise ParquetError("truncated level stream length prefix")
-                size = int.from_bytes(raw[pos : pos + 4], "little")
-                if pos + 4 + size > len(raw):
-                    raise ParquetError(
-                        f"level stream length {size} exceeds page"
-                    )
-                used = 4 + size
+            else:
+                used = 4 + _prefixed_span(pos)
             rsp = (raw, pos + 4, used - 4)  # hybrid payload past the u32 size
             pos += used
         if max_def > 0:
-            dlv, used = rle.decode_prefixed(
-                raw[pos:], bitpack.bit_width(max_def), num_values
-            )
+            w = bitpack.bit_width(max_def)
+            if decode_levels:
+                dlv, used = rle.decode_prefixed(raw[pos:], w, num_values)
+            else:
+                size = _prefixed_span(pos)
+                used = 4 + size
+                def_meta = parse_hybrid_meta(
+                    raw, w, num_values, pos=pos + 4, end=pos + 4 + size,
+                    eq_target=max_def,
+                )
+                if def_meta.eq_count is None:  # no native walk: must decode
+                    dlv, _ = rle.decode_prefixed(raw[pos:], w, num_values)
             dsp = (raw, pos + 4, used - 4)
             pos += used
-        defined = (
-            int(np.count_nonzero(dlv == max_def)) if dlv is not None else num_values
-        )
+        if def_meta is not None and def_meta.eq_count is not None:
+            defined = def_meta.eq_count
+        elif dlv is not None:
+            defined = int(np.count_nonzero(dlv == max_def))
+        else:
+            defined = num_values
         return ParsedDataPage(
             raw=raw, value_pos=pos, num_values=num_values, defined=defined,
             encoding=dh.encoding, def_levels=dlv, rep_levels=rlv,
-            def_stream=dsp, rep_stream=rsp,
+            def_stream=dsp, rep_stream=rsp, def_meta=def_meta,
         )
 
     dh = header.data_page_header_v2
@@ -461,22 +490,39 @@ def parse_data_page(
         raise ParquetError("v2 level lengths exceed page")
     rlv = dlv = None
     rsp = dsp = None
+    def_meta = None
     if max_rep > 0:
         if rep_len == 0:
             raise ParquetError("v2 page missing repetition levels")
-        if decode_rep:
+        if decode_levels:
             rlv = rle.decode(payload[:rep_len], bitpack.bit_width(max_rep),
                              num_values)
         rsp = (payload, 0, rep_len)
     if max_def > 0:
-        dlv = rle.decode(
-            payload[rep_len : rep_len + def_len],
-            bitpack.bit_width(max_def), num_values,
-        )
+        w = bitpack.bit_width(max_def)
+        if decode_levels:
+            dlv = rle.decode(
+                payload[rep_len : rep_len + def_len], w, num_values
+            )
+        else:
+            def_meta = parse_hybrid_meta(
+                payload, w, num_values, pos=rep_len,
+                end=rep_len + def_len, eq_target=max_def,
+            )
+            if def_meta.eq_count is None:  # no native walk: must decode
+                dlv = rle.decode(
+                    payload[rep_len : rep_len + def_len], w, num_values
+                )
         dsp = (payload, rep_len, def_len)
-    if dh.num_nulls is not None and dlv is not None:
-        actual_nulls = int(np.count_nonzero(dlv != max_def))
-        if dh.num_nulls != actual_nulls and max_rep == 0:
+    if def_meta is not None and def_meta.eq_count is not None:
+        defined = def_meta.eq_count
+    elif dlv is not None:
+        defined = int(np.count_nonzero(dlv == max_def))
+    else:
+        defined = num_values
+    if dh.num_nulls is not None and max_def > 0 and max_rep == 0:
+        actual_nulls = num_values - defined
+        if dh.num_nulls != actual_nulls:
             raise ParquetError(
                 f"v2 page declares {dh.num_nulls} nulls, levels say {actual_nulls}"
             )
@@ -486,13 +532,10 @@ def parse_data_page(
         raw = decompress_block(values_block, codec, uncompressed_values)
     else:
         raw = values_block
-    defined = (
-        int(np.count_nonzero(dlv == max_def)) if dlv is not None else num_values
-    )
     return ParsedDataPage(
         raw=raw, value_pos=0, num_values=num_values, defined=defined,
         encoding=dh.encoding, def_levels=dlv, rep_levels=rlv,
-        def_stream=dsp, rep_stream=rsp,
+        def_stream=dsp, rep_stream=rsp, def_meta=def_meta,
     )
 
 

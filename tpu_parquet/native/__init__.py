@@ -100,6 +100,7 @@ def load():
                 p(ctypes.c_longlong), p(ctypes.c_uint8), p(ctypes.c_uint32),
                 p(ctypes.c_longlong), c_ll, p(ctypes.c_longlong),
                 c_ll, p(ctypes.c_uint64),
+                c_ll, ctypes.c_uint64, p(ctypes.c_longlong),
             ]
             _lib = lib
         except Exception:
@@ -173,13 +174,14 @@ def delta_meta(buf: bytes, pos: int, cap: int):
 
 
 def hybrid_meta(buf: bytes, n: int, pos: int, width: int, count: int, cap: int,
-                want_max: bool = False):
+                want_max: bool = False, eq_target: "int | None" = None):
     """Walk RLE/bit-packed hybrid run headers natively (meta_parse.cpp).
 
-    Returns (n_runs, consumed, ends, kinds, vals, starts, max_value) trimmed
-    to n_runs (max_value is None unless want_max), a negative error code
-    (int; -10 = cap exceeded, retry bigger), or None when the native library
-    is unavailable.
+    Returns (n_runs, consumed, ends, kinds, vals, starts, max_value,
+    eq_count) trimmed to n_runs (max_value is None unless want_max; eq_count
+    — the number of stream values equal to ``eq_target`` — is None unless
+    eq_target is given), a negative error code (int; -10 = cap exceeded,
+    retry bigger), or None when the native library is unavailable.
     """
     import numpy as np
 
@@ -192,6 +194,7 @@ def hybrid_meta(buf: bytes, n: int, pos: int, width: int, count: int, cap: int,
     starts = np.empty(cap, dtype=np.int64)
     consumed = np.zeros(1, dtype=np.int64)
     max_out = np.zeros(1, dtype=np.uint64)
+    eq_out = np.zeros(1, dtype=np.int64)
     pll = ctypes.POINTER(ctypes.c_longlong)
     rc = lib.tpq_hybrid_meta(
         buf, n, pos, width, count,
@@ -203,12 +206,17 @@ def hybrid_meta(buf: bytes, n: int, pos: int, width: int, count: int, cap: int,
         consumed.ctypes.data_as(pll),
         1 if want_max else 0,
         max_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        0 if eq_target is None else 1,
+        0 if eq_target is None else int(eq_target),
+        eq_out.ctypes.data_as(pll),
     )
     if rc < 0:
         return int(rc)
     r = int(rc)
     mx = int(max_out[0]) if want_max else None
-    return r, int(consumed[0]), ends[:r], kinds[:r], vals[:r], starts[:r], mx
+    eq = int(eq_out[0]) if eq_target is not None else None
+    return (r, int(consumed[0]), ends[:r], kinds[:r], vals[:r], starts[:r],
+            mx, eq)
 
 
 # meta_parse.cpp error codes → messages (kept aligned with the C enum);
@@ -231,7 +239,7 @@ NATIVE_ERRORS = {
 
 
 def hybrid_meta_retry(buf: bytes, n: int, pos: int, width: int, count: int,
-                      want_max: bool = False):
+                      want_max: bool = False, eq_target: "int | None" = None):
     """hybrid_meta with the standard cap-retry policy.
 
     Starts with a small run-table cap and retries once with the provable
@@ -241,7 +249,8 @@ def hybrid_meta_retry(buf: bytes, n: int, pos: int, width: int, count: int,
     cap = min(count, max(n - pos, 0) + 1, 4096)
     full_cap = min(count, max(n - pos, 0) + 1)
     while True:
-        res = hybrid_meta(buf, n, pos, width, count, cap, want_max=want_max)
+        res = hybrid_meta(buf, n, pos, width, count, cap, want_max=want_max,
+                          eq_target=eq_target)
         if isinstance(res, int) and res == -10 and cap < full_cap:
             cap = full_cap
             continue

@@ -145,15 +145,23 @@ i64 tpq_delta_meta(const u8 *buf, i64 len, i64 pos, i64 *header_out,
 // the stream's maximum value (RLE run values + a scan of every bit-packed
 // field up to each run's real extent) is written to max_out[0] — this lets
 // dictionary-index range validation happen entirely on the host, so the
-// device decode path needs zero device→host syncs.  Returns n_runs >= 0, or
-// a negative error (ERR_CAP: caller retries with a larger cap).
+// device decode path needs zero device→host syncs.  When want_eq is nonzero
+// the number of stream values equal to eq_target is written to eq_out[0]:
+// for definition-level streams with eq_target = max_def this is the page's
+// defined-value count, which gates every static decode shape — so the host
+// never needs to materialize the decoded level array at all.  Returns
+// n_runs >= 0, or a negative error (ERR_CAP: caller retries with a larger
+// cap).
 i64 tpq_hybrid_meta(const u8 *buf, i64 n, i64 pos, i64 width, i64 count,
                     i64 *ends, u8 *kinds, u32 *vals, i64 *starts, i64 cap,
-                    i64 *consumed_out, i64 want_max, u64 *max_out) {
+                    i64 *consumed_out, i64 want_max, u64 *max_out,
+                    i64 want_eq, u64 eq_target, i64 *eq_out) {
     i64 value_bytes = (width + 7) / 8;
     i64 total = 0, n_runs = 0;
     u64 max_val = 0;
+    i64 eq_count = 0;
     const u64 mask = width >= 64 ? ~(u64)0 : (((u64)1 << width) - 1);
+    const int scan_bp = (want_max || want_eq);
     while (total < count) {
         if (pos >= n) return ERR_EXHAUSTED;
         u128 h;
@@ -174,7 +182,7 @@ i64 tpq_hybrid_meta(const u8 *buf, i64 n, i64 pos, i64 width, i64 count,
             kinds[n_runs] = 0;
             vals[n_runs] = 0;
             starts[n_runs] = pos * 8 - total * width;
-            if (want_max && width > 0) {
+            if (scan_bp && width > 0) {
                 // scan the run's real extent (padding past `take` is ignored,
                 // matching the device expansion's idx[:count] semantics)
                 for (i64 k = 0; k < take; k++) {
@@ -187,7 +195,10 @@ i64 tpq_hybrid_meta(const u8 *buf, i64 n, i64 pos, i64 width, i64 count,
                         acc |= (u64)buf[byte0 + b] << (8 * b);
                     u64 v = (acc >> sh) & mask;
                     if (v > max_val) max_val = v;
+                    if (v == eq_target) eq_count++;
                 }
+            } else if (want_eq && width == 0 && eq_target == 0) {
+                eq_count += take;  // width-0 stream: every value is 0
             }
             pos += (i64)nbytes128;
             total += take;
@@ -207,6 +218,7 @@ i64 tpq_hybrid_meta(const u8 *buf, i64 n, i64 pos, i64 width, i64 count,
             vals[n_runs] = (u32)v;
             starts[n_runs] = 0;
             if (want_max && (v & mask) > max_val) max_val = v & mask;
+            if (want_eq && (v & mask) == eq_target) eq_count += repeats;
             total += repeats;
         }
         ends[n_runs] = total;
@@ -214,6 +226,7 @@ i64 tpq_hybrid_meta(const u8 *buf, i64 n, i64 pos, i64 width, i64 count,
     }
     consumed_out[0] = pos;
     if (want_max) max_out[0] = max_val;
+    if (want_eq) eq_out[0] = eq_count;
     return n_runs;
 }
 
