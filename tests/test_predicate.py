@@ -1,0 +1,206 @@
+"""Statistics-based row-group pruning (predicate pushdown).
+
+Soundness oracle: for random data and random predicates, any row group the
+pruner drops must contain ZERO matching rows (brute-force check); pruning is
+allowed to keep non-matching groups (conservative), never to drop matching
+ones.  Reader integration: pruned groups' bytes are never read, and both
+readers (host + device) skip them in iteration.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from tpu_parquet.device_reader import DeviceFileReader
+from tpu_parquet.errors import ParquetError
+from tpu_parquet.format import FieldRepetitionType as FRT, Type
+from tpu_parquet.predicate import col, prune_row_groups
+from tpu_parquet.reader import FileReader
+from tpu_parquet.schema.core import build_schema, data_column
+from tpu_parquet.writer import FileWriter
+
+RNG = np.random.default_rng(5)
+
+
+def _file(rows_per_group=100, groups=8, with_nulls=True):
+    schema = build_schema([
+        data_column("a", Type.INT64, FRT.REQUIRED),
+        data_column("b", Type.DOUBLE, FRT.REQUIRED),
+        data_column("x", Type.INT32, FRT.OPTIONAL),
+    ])
+    buf = io.BytesIO()
+    all_rows = []
+    with FileWriter(buf, schema) as w:  # explicit flush = one group per batch
+        for g in range(groups):
+            base = g * 1000
+            rows = [
+                {
+                    "a": int(base + RNG.integers(0, 500)),
+                    "b": float(g) + float(RNG.uniform(0, 1)),
+                    "x": (None if with_nulls and RNG.random() < 0.3
+                          else int(RNG.integers(-50, 50))),
+                }
+                for _ in range(rows_per_group)
+            ]
+            for row in rows:
+                w.write_row(row)
+            w.flush_row_group()
+            all_rows.append(rows)
+    return buf.getvalue(), all_rows
+
+
+def _matches(row, pred_fn):
+    return pred_fn(row)
+
+
+PREDS = [
+    (col("a") > 3500, lambda r: r["a"] > 3500),
+    (col("a") <= 1200, lambda r: r["a"] <= 1200),
+    ((col("a") >= 2000) & (col("a") < 3000),
+     lambda r: 2000 <= r["a"] < 3000),
+    (col("b") < 2.0, lambda r: r["b"] < 2.0),
+    ((col("a") > 6800) | (col("b") < 0.5),
+     lambda r: r["a"] > 6800 or r["b"] < 0.5),
+    (~(col("a") > 3500), lambda r: not (r["a"] > 3500)),
+    (col("a") == 123456, lambda r: r["a"] == 123456),
+    (col("x").is_null(), lambda r: r["x"] is None),
+    (col("x").not_null(), lambda r: r["x"] is not None),
+    (col("a").between(1000, 1999), lambda r: 1000 <= r["a"] <= 1999),
+]
+
+
+@pytest.mark.parametrize("idx", range(len(PREDS)))
+def test_pruning_soundness(idx):
+    pred, oracle = PREDS[idx]
+    data, all_rows = _file()
+    with FileReader(io.BytesIO(data)) as r:
+        keep = prune_row_groups(r.metadata, r.schema, pred)
+    assert len(keep) == len(all_rows)
+    for g, (kept, rows) in enumerate(zip(keep, all_rows)):
+        if not kept:
+            assert not any(oracle(row) for row in rows), (
+                f"group {g} pruned but contains matching rows"
+            )
+
+
+def test_pruning_actually_prunes():
+    data, _ = _file()
+    with FileReader(io.BytesIO(data), row_filter=col("a") > 6000) as r:
+        kept = [i for i in range(r.num_row_groups) if r.row_group_selected(i)]
+        assert 0 < len(kept) < r.num_row_groups  # prunes some, not all
+        groups = list(r.iter_row_groups())
+        assert len(groups) == len(kept)
+        # every surviving group's max >= filter bound
+        for cols in groups:
+            assert int(np.asarray(cols["a"].values).max()) > 6000
+
+
+def test_iter_rows_respects_filter():
+    data, all_rows = _file()
+    pred, oracle = (col("a") <= 1200, lambda r: r["a"] <= 1200)
+    with FileReader(io.BytesIO(data), row_filter=pred) as r:
+        got = list(r.iter_rows())
+    # all matching rows are present (pruning never loses matches)
+    want_matching = [row for rows in all_rows for row in rows if oracle(row)]
+    got_a = {row["a"] for row in got}
+    for row in want_matching:
+        assert row["a"] in got_a
+
+
+def test_device_reader_filter():
+    data, all_rows = _file()
+    with DeviceFileReader(io.BytesIO(data), row_filter=col("a") > 6000) as r:
+        n_groups = sum(1 for _ in r.iter_row_groups())
+    with FileReader(io.BytesIO(data), row_filter=col("a") > 6000) as hr:
+        kept = [i for i in range(hr.num_row_groups) if hr.row_group_selected(i)]
+    assert n_groups == len(kept) < len(all_rows)
+
+
+def test_unknown_column_raises():
+    data, _ = _file()
+    with pytest.raises(ParquetError, match="unknown column"):
+        FileReader(io.BytesIO(data), row_filter=col("nope") > 1)
+
+
+def test_missing_stats_never_prunes():
+    data, _ = _file()
+    with FileReader(io.BytesIO(data)) as r:
+        # strip statistics from the footer copy
+        for rg in r.metadata.row_groups:
+            for c in rg.columns:
+                c.meta_data.statistics = None
+        keep = prune_row_groups(r.metadata, r.schema, col("a") > 10**9)
+    assert all(keep)
+
+
+def test_string_stats_pruning(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    p = tmp_path / "s.parquet"
+    names = [f"{c}{i:03d}" for c in "abcd" for i in range(100)]
+    pq.write_table(pa.table({"s": names}), p, row_group_size=100)
+    with FileReader(p, row_filter=col("s") >= "c") as r:
+        kept = [i for i in range(r.num_row_groups) if r.row_group_selected(i)]
+        assert kept == [2, 3]
+        vals = [v for cols in r.iter_row_groups()
+                for v in cols["s"].values.to_list()]
+    assert vals and all(v >= b"c" for v in vals)  # only c/d groups decoded
+
+
+def test_all_null_group_comparison_pruned():
+    schema = build_schema([data_column("x", Type.INT32, FRT.OPTIONAL)])
+    buf = io.BytesIO()
+    with FileWriter(buf, schema) as w:
+        for v in (None, 7):
+            w.write_row({"x": v})
+            w.flush_row_group()
+    with FileReader(io.BytesIO(buf.getvalue())) as r:
+        keep = prune_row_groups(r.metadata, r.schema, col("x") > 0)
+    assert keep == [False, True]  # all-null group can satisfy no comparison
+
+
+def test_float_nan_ne_not_pruned():
+    """A NaN row satisfies != and negated comparisons; float groups must
+    never be pruned by them (stats exclude NaNs)."""
+    schema = build_schema([data_column("b", Type.DOUBLE, FRT.REQUIRED)])
+    buf = io.BytesIO()
+    with FileWriter(buf, schema) as w:
+        w.write_row({"b": 5.0})
+        w.write_row({"b": float("nan")})
+    data = buf.getvalue()
+    with FileReader(io.BytesIO(data)) as r:
+        for pred in (col("b") != 5.0, ~(col("b") < 6.0), ~(col("b") <= 5.0)):
+            keep = prune_row_groups(r.metadata, r.schema, pred)
+            assert keep == [True], pred
+
+
+def test_unsigned_logical_type_not_pruned():
+    """logicalType-only UINT columns: signed decode of stats is wrong-order;
+    must degrade to no-evidence instead of pruning."""
+    from tpu_parquet.format import IntType, LogicalType
+    from tpu_parquet.schema.core import ColumnParameters
+
+    schema = build_schema([data_column(
+        "u", Type.INT32, FRT.REQUIRED,
+        ColumnParameters(logical_type=LogicalType(
+            INTEGER=IntType(bitWidth=32, isSigned=False))),
+    )])
+    buf = io.BytesIO()
+    with FileWriter(buf, schema) as w:
+        # stored bits 0xFFFFFFFF = unsigned 4294967295; signed decode sees -1
+        w.write_row({"u": -1})
+    with FileReader(io.BytesIO(buf.getvalue())) as r:
+        keep = prune_row_groups(r.metadata, r.schema,
+                                col("u") > 3_000_000_000)
+    assert keep == [True]
+
+
+def test_num_selected_rows():
+    data, all_rows = _file()
+    with FileReader(io.BytesIO(data), row_filter=col("a") > 6000) as r:
+        kept = [i for i in range(r.num_row_groups) if r.row_group_selected(i)]
+        assert r.num_selected_rows == sum(
+            r.row_group_num_rows(i) for i in kept)
+        assert r.num_rows == sum(len(rows) for rows in all_rows)

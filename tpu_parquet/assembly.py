@@ -247,6 +247,9 @@ class RowIterator:
         while self._pos >= len(self._rows):
             if self._group >= self.reader.num_row_groups:
                 raise StopIteration
+            if not self.reader.row_group_selected(self._group):
+                self._group += 1  # pruned by row_filter: skip without IO
+                continue
             if (
                 self.reader._current_row_group == self._group
                 and self.reader._preloaded is not None

@@ -975,12 +975,14 @@ class DeviceFileReader:
     """
 
     def __init__(self, source, columns=None, validate_crc: bool = False,
-                 profile_dir: "str | None" = None, max_memory: int = 0):
+                 profile_dir: "str | None" = None, max_memory: int = 0,
+                 row_filter=None):
         from .reader import FileReader
 
         self._host = FileReader(source, columns=columns,
                                 validate_crc=validate_crc,
-                                max_memory=max_memory)
+                                max_memory=max_memory,
+                                row_filter=row_filter)
         self.metadata = self._host.metadata
         self.schema = self._host.schema
         self.validate_crc = validate_crc
@@ -1238,8 +1240,9 @@ class DeviceFileReader:
         from concurrent.futures import ThreadPoolExecutor
         import contextlib
 
-        n = self.num_row_groups
-        if n == 0:
+        indices = [i for i in range(self.num_row_groups)
+                   if self._host.row_group_selected(i)]
+        if not indices:
             self.finalize()
             return
         trace = (jax.profiler.trace(self.profile_dir) if self.profile_dir
@@ -1260,7 +1263,7 @@ class DeviceFileReader:
 
         with trace, ThreadPoolExecutor(1) as ex:
             prev = None  # (prepared, future staging the device buffer)
-            for i in range(n):
+            for i in indices:
                 prepared = self._prepare_row_group(i)
                 fut = ex.submit(timed_stage, prepared[2]) if prepared[1] else None
                 if prev is not None:

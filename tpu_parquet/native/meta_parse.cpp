@@ -103,7 +103,13 @@ i64 tpq_delta_meta(const u8 *buf, i64 len, i64 pos, i64 *header_out,
     // turn the bound checks below into out-of-bounds reads (the Python
     // reference walk does this arithmetic in unbounded ints)
     u128 vpm128 = values_per_mini;
-    if (minis_per_block > (u128)len + 1) return ERR_TRUNC_WIDTHS;
+    // width vectors are only read when there are deltas to decode: a
+    // total<=1 stream legally ends right after the header (the Go reference
+    // reads blocks lazily and never touches one for a single value), so the
+    // truncation pre-check must not fire for it.  minis_per_block <=
+    // block_size <= 2^30 here (the %-check above), so the cast is safe.
+    if (n_deltas > 0 && minis_per_block > (u128)len + 1)
+        return ERR_TRUNC_WIDTHS;
     i64 mpb = (i64)minis_per_block;
     while (got < n_deltas) {
         u128 min_delta;

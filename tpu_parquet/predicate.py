@@ -1,0 +1,311 @@
+"""Statistics-based row-group pruning (predicate pushdown).
+
+The reference writes chunk statistics (stats.go, chunk_writer.go:283-290) but
+leaves filtering to the caller; a TPU input pipeline wants the reader to skip
+row groups that cannot match *before* paying IO + decode, so this module
+evaluates a small predicate algebra against the footer's per-chunk min/max/
+null_count — no data pages are read for pruned groups (the skipChunk
+discipline, chunk_reader.go:271-297, lifted to whole row groups).
+
+    from tpu_parquet.predicate import col
+    pred = (col("l_shipdate") >= 8766) & (col("l_quantity") < 24)
+    with FileReader(path, row_filter=pred) as r:      # or DeviceFileReader
+        for cols in r.iter_row_groups():              # pruned groups skipped
+            ...
+
+Soundness: every node evaluates to a pair of bounds — ``can_match`` (False
+only when NO row in the group can satisfy the predicate) and ``always_match``
+(True only when EVERY row must).  Missing or unreadable statistics degrade to
+(True, False) — never prune on absent evidence.  SQL comparison semantics:
+a NULL value satisfies no comparison, so ``~(col > v)`` is NOT ``col <= v``
+— negation swaps the two bounds, which stays sound for both.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Optional
+
+from .format import ConvertedType, Type
+from .errors import ParquetError
+
+__all__ = ["col", "Predicate", "prune_row_groups", "chunk_stats_range"]
+
+
+_INT_FMT = {Type.INT32: "<i", Type.INT64: "<q"}
+_FLT_FMT = {Type.FLOAT: "<f", Type.DOUBLE: "<d"}
+
+
+def _is_unsigned(elem) -> bool:
+    ct = getattr(elem, "converted_type", None)
+    if ct in (ConvertedType.UINT_8, ConvertedType.UINT_16,
+              ConvertedType.UINT_32, ConvertedType.UINT_64):
+        return True
+    lt = getattr(elem, "logicalType", None)
+    it = getattr(lt, "INTEGER", None) if lt is not None else None
+    return it is not None and it.isSigned is False
+
+
+def _decode_bound(raw: Optional[bytes], ptype: int, elem,
+                  deprecated: bool) -> Optional[object]:
+    """Decode one serialized min/max bound to a comparable Python value.
+
+    ``deprecated`` marks the legacy Statistics.min/max fields, whose ordering
+    is ambiguous for anything but plain signed numerics (PARQUET-251: old
+    writers compared BYTE_ARRAY with *signed* bytes) — they degrade to
+    no-evidence except for INT/FLOAT/DOUBLE.
+    """
+    if raw is None:
+        return None
+    try:
+        if ptype in _INT_FMT:
+            if len(raw) != struct.calcsize(_INT_FMT[ptype]):
+                return None
+            # unsigned columns (converted OR logical type) sort differently
+            # than the signed decode: degrade to no-evidence
+            if _is_unsigned(elem):
+                return None
+            return struct.unpack(_INT_FMT[ptype], raw)[0]
+        if ptype in _FLT_FMT:
+            if len(raw) != struct.calcsize(_FLT_FMT[ptype]):
+                return None
+            return struct.unpack(_FLT_FMT[ptype], raw)[0]
+        if ptype == Type.BYTE_ARRAY and not deprecated:
+            return bytes(raw)
+    except (struct.error, TypeError):
+        return None
+    return None
+
+
+def chunk_stats_range(md, elem):
+    """(min, max, null_count, num_values, ptype) from one chunk's metadata;
+    None bounds where statistics are absent/undecodable."""
+    st = md.statistics
+    if st is None:
+        return None, None, None, md.num_values, md.type
+    if st.min_value is not None or st.max_value is not None:
+        mn_raw, mx_raw, deprecated = st.min_value, st.max_value, False
+    else:
+        mn_raw, mx_raw, deprecated = st.min, st.max, True
+    mn = _decode_bound(mn_raw, md.type, elem, deprecated)
+    mx = _decode_bound(mx_raw, md.type, elem, deprecated)
+    return mn, mx, st.null_count, md.num_values, md.type
+
+
+@dataclass(frozen=True)
+class _Bounds:
+    can: bool      # upper bound: group MAY contain a matching row
+    always: bool   # lower bound: EVERY row in the group matches
+
+    def __invert__(self):
+        return _Bounds(can=not self.always, always=not self.can)
+
+
+_NO_EVIDENCE = _Bounds(True, False)
+
+
+class Predicate:
+    """Base class; combine with ``&``, ``|``, ``~``."""
+
+    def __and__(self, other):
+        return _And(self, other)
+
+    def __or__(self, other):
+        return _Or(self, other)
+
+    def __invert__(self):
+        return _Not(self)
+
+    # -- evaluation ---------------------------------------------------------
+
+    def _bounds(self, stats_of) -> _Bounds:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def columns(self) -> set:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class _And(Predicate):
+    a: Predicate
+    b: Predicate
+
+    def _bounds(self, stats_of):
+        x, y = self.a._bounds(stats_of), self.b._bounds(stats_of)
+        return _Bounds(x.can and y.can, x.always and y.always)
+
+    def columns(self):
+        return self.a.columns() | self.b.columns()
+
+
+@dataclass(frozen=True)
+class _Or(Predicate):
+    a: Predicate
+    b: Predicate
+
+    def _bounds(self, stats_of):
+        x, y = self.a._bounds(stats_of), self.b._bounds(stats_of)
+        return _Bounds(x.can or y.can, x.always or y.always)
+
+    def columns(self):
+        return self.a.columns() | self.b.columns()
+
+
+@dataclass(frozen=True)
+class _Not(Predicate):
+    a: Predicate
+
+    def _bounds(self, stats_of):
+        return ~self.a._bounds(stats_of)
+
+    def columns(self):
+        return self.a.columns()
+
+
+@dataclass(frozen=True)
+class _Cmp(Predicate):
+    """column <op> literal.  NULL rows satisfy no comparison."""
+
+    column: str
+    op: str  # lt le gt ge eq ne
+    value: object
+
+    def columns(self):
+        return {self.column}
+
+    def _bounds(self, stats_of):
+        got = stats_of(self.column)
+        if got is None:
+            return _NO_EVIDENCE
+        mn, mx, nulls, num, ptype = got
+        v = self.value
+        if isinstance(v, str):
+            v = v.encode()
+        all_null = nulls is not None and num is not None and nulls == num
+        if all_null:
+            return _Bounds(False, False)  # no non-null row to satisfy anything
+        no_nulls = nulls == 0
+        if mn is None or mx is None:
+            return _NO_EVIDENCE
+        # FLOAT/DOUBLE stats exclude NaN rows (this repo's stats.py; other
+        # writers vary).  A NaN row satisfies NO ordered comparison and EVERY
+        # inequality — so for floats the 'always' bound can never be proven
+        # from min/max, and 'ne' may always match.
+        is_float = ptype in _FLT_FMT
+        try:
+            if self.op == "lt":
+                can, always = mn < v, mx < v
+            elif self.op == "le":
+                can, always = mn <= v, mx <= v
+            elif self.op == "gt":
+                can, always = mx > v, mn > v
+            elif self.op == "ge":
+                can, always = mx >= v, mn >= v
+            elif self.op == "eq":
+                can, always = mn <= v <= mx, mn == v == mx
+            elif self.op == "ne":
+                can, always = is_float or not (mn == v == mx), v < mn or v > mx
+            else:  # pragma: no cover
+                raise ParquetError(f"unknown predicate op {self.op}")
+        except TypeError:
+            return _NO_EVIDENCE  # incomparable literal: no evidence
+        if is_float:
+            always = False  # a possible NaN row breaks every 'always' proof
+        return _Bounds(can, always and no_nulls)
+
+
+@dataclass(frozen=True)
+class _IsNull(Predicate):
+    column: str
+    want_null: bool
+
+    def columns(self):
+        return {self.column}
+
+    def _bounds(self, stats_of):
+        got = stats_of(self.column)
+        if got is None:
+            return _NO_EVIDENCE
+        _, _, nulls, num, _ = got
+        if nulls is None or num is None:
+            return _NO_EVIDENCE
+        has_null = nulls > 0
+        all_null = nulls == num
+        if self.want_null:
+            return _Bounds(has_null, all_null)
+        return _Bounds(not all_null, not has_null)
+
+
+class _Column:
+    """Comparison builder: ``col("a") > 3`` etc."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __lt__(self, v):
+        return _Cmp(self._name, "lt", v)
+
+    def __le__(self, v):
+        return _Cmp(self._name, "le", v)
+
+    def __gt__(self, v):
+        return _Cmp(self._name, "gt", v)
+
+    def __ge__(self, v):
+        return _Cmp(self._name, "ge", v)
+
+    def __eq__(self, v):  # noqa: PLR0124
+        return _Cmp(self._name, "eq", v)
+
+    def __ne__(self, v):
+        return _Cmp(self._name, "ne", v)
+
+    def __hash__(self):
+        return hash(self._name)
+
+    def is_null(self):
+        return _IsNull(self._name, True)
+
+    def not_null(self):
+        return _IsNull(self._name, False)
+
+    def between(self, lo, hi):
+        """lo <= col <= hi (inclusive both ends)."""
+        return _Cmp(self._name, "ge", lo) & _Cmp(self._name, "le", hi)
+
+
+def col(name: str) -> _Column:
+    """Start a predicate on a (dotted) column path."""
+    return _Column(name)
+
+
+def prune_row_groups(metadata, schema, predicate: Predicate) -> list[bool]:
+    """Per-row-group keep/skip flags: False means NO row can match.
+
+    Unknown columns raise (a typo would silently disable pruning);
+    group/repeated columns and absent stats never cause pruning.
+    """
+    leaves = {".".join(l.path): l for l in schema.leaves}
+    for name in predicate.columns():
+        if name not in leaves:
+            raise ParquetError(f"row_filter references unknown column {name!r}")
+    keep = []
+    for rg in metadata.row_groups:
+        by_name = {}
+        for chunk in rg.columns or []:
+            md = chunk.meta_data
+            if md is not None and md.path_in_schema:
+                by_name[".".join(md.path_in_schema)] = md
+
+        def stats_of(name, _by=by_name):
+            md = _by.get(name)
+            if md is None:
+                return None
+            leaf = leaves[name]
+            if leaf.max_rep > 0:
+                return None  # repeated: row<->value mapping is not 1:1
+            return chunk_stats_range(md, leaf.element)
+
+        keep.append(predicate._bounds(stats_of).can)
+    return keep

@@ -45,6 +45,7 @@ class FileReader:
         validate_crc: bool = False,
         max_memory: int = 0,
         metadata: Optional[FileMetaData] = None,
+        row_filter=None,
     ):
         if isinstance(source, (str, os.PathLike)):
             self._f: BinaryIO = open(source, "rb")
@@ -70,6 +71,34 @@ class FileReader:
         self.alloc = AllocTracker(max_memory)
         self._current_row_group = 0
         self._preloaded: Optional[dict[str, ColumnData]] = None
+        # statistics-based row-group pruning (predicate pushdown): groups
+        # whose footer stats prove the predicate can never match are skipped
+        # by the iteration APIs — their bytes are never read
+        self.row_filter = row_filter
+        if row_filter is not None:
+            from .predicate import prune_row_groups
+
+            self._rg_keep = prune_row_groups(self.metadata, self.schema,
+                                             row_filter)
+        else:
+            self._rg_keep = None
+
+    def row_group_selected(self, index: int) -> bool:
+        """False when ``row_filter`` proves row group ``index`` cannot match."""
+        return self._rg_keep is None or self._rg_keep[index]
+
+    @property
+    def num_selected_rows(self) -> int:
+        """Total rows in the row groups that survive ``row_filter`` — the
+        count the iteration APIs will actually yield (``num_rows`` stays the
+        footer total; pruning is group-granular, so surviving groups may
+        still contain rows the predicate rejects)."""
+        if self._rg_keep is None:
+            return self.metadata.num_rows
+        return sum(
+            rg.num_rows for rg, keep in
+            zip(self.metadata.row_groups, self._rg_keep) if keep
+        )
 
     # -- context management ---------------------------------------------------
 
@@ -143,6 +172,8 @@ class FileReader:
 
     def iter_row_groups(self):
         for i in range(self.num_row_groups):
+            if not self.row_group_selected(i):
+                continue  # pruned: its bytes are never read
             yield self.read_row_group(i)
 
     def read_all(self) -> dict[str, ColumnData]:

@@ -161,6 +161,10 @@ class Schema:
                     raise SchemaError("schema element list shorter than num_children")
                 e = elems[pos]
                 pos += 1
+                if not isinstance(e.name, str):
+                    # a None/absent name breaks every path join downstream
+                    # (readColumnSchema parity: "name is required")
+                    raise SchemaError("schema element missing name")
                 nc = e.num_children or 0
                 if nc > 0:
                     node = SchemaNode(e, read_children(nc))
