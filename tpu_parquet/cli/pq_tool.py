@@ -44,12 +44,20 @@ def _json_default(v):
     return repr(v)
 
 
+def _row_filter(args):
+    if getattr(args, "filter", None) is None:
+        return None
+    from ..predicate import parse_filter
+
+    return parse_filter(args.filter)
+
+
 def cmd_cat(args, out=sys.stdout) -> int:
     """Shared handler for cat and head (identical modulo the -n default)."""
     from ..floor import Reader
 
     count = 0
-    with Reader(args.file) as r:
+    with Reader(args.file, row_filter=_row_filter(args)) as r:
         for row in r:
             if args.n is not None and count >= args.n:
                 break
@@ -107,8 +115,44 @@ def cmd_schema(args, out=sys.stdout) -> int:
 
 
 def cmd_rowcount(args, out=sys.stdout) -> int:
+    with FileReader(args.file, row_filter=_row_filter(args)) as r:
+        # surviving groups' total; equals num_rows when no filter is set
+        out.write(f"{r.num_selected_rows}\n")
+    return 0
+
+
+def cmd_stats(args, out=sys.stdout) -> int:
+    """Per-row-group, per-column statistics (the pruning evidence)."""
+    from ..predicate import chunk_stats_range
+
+    def fmt(v):
+        if v is None:
+            return "-"
+        if isinstance(v, bytes):
+            try:
+                return repr(v.decode("utf-8"))
+            except UnicodeDecodeError:
+                return v.hex()
+        return str(v)
+
     with FileReader(args.file) as r:
-        out.write(f"{r.num_rows}\n")
+        leaves = {".".join(l.path): l for l in r.schema.leaves}
+        name_w = max((len(n) for n in leaves), default=4)
+        for i, rg in enumerate(r.metadata.row_groups):
+            out.write(f"row group {i}: rows={rg.num_rows}\n")
+            for chunk in rg.columns or []:
+                md = chunk.meta_data
+                if md is None or not md.path_in_schema:
+                    continue
+                name = ".".join(md.path_in_schema)
+                leaf = leaves.get(name)
+                if leaf is None:
+                    continue
+                mn, mx, nulls, _, _ = chunk_stats_range(md, leaf.element)
+                out.write(
+                    f"  {name:<{name_w}}  min={fmt(mn)} max={fmt(mx)} "
+                    f"nulls={fmt(nulls)}\n"
+                )
     return 0
 
 
@@ -176,13 +220,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sub = p.add_subparsers(dest="command", required=True)
 
+    FILTER_HELP = ("row-group pruning predicate, e.g. \"a > 5 and b == 'x'\" "
+                   "(skips groups whose stats cannot match)")
     c = sub.add_parser("cat", help="print all records as JSON lines")
     c.add_argument("-n", type=int, default=None, help="limit record count")
+    c.add_argument("--filter", default=None, help=FILTER_HELP)
     c.add_argument("file")
     c.set_defaults(func=cmd_cat)
 
     h = sub.add_parser("head", help="print the first N records")
     h.add_argument("-n", type=int, default=5)
+    h.add_argument("--filter", default=None, help=FILTER_HELP)
     h.add_argument("file")
     h.set_defaults(func=cmd_cat)
 
@@ -195,8 +243,15 @@ def build_parser() -> argparse.ArgumentParser:
     s.set_defaults(func=cmd_schema)
 
     rc = sub.add_parser("rowcount", help="print the number of rows")
+    rc.add_argument("--filter", default=None,
+                    help=FILTER_HELP + "; prints surviving groups' row total")
     rc.add_argument("file")
     rc.set_defaults(func=cmd_rowcount)
+
+    st = sub.add_parser("stats",
+                        help="per-row-group column min/max/null statistics")
+    st.add_argument("file")
+    st.set_defaults(func=cmd_stats)
 
     sp = sub.add_parser("split", help="split into files of at most SIZE bytes")
     sp.add_argument("--size", required=True, help="max part size, e.g. 100MB")

@@ -30,7 +30,8 @@ from typing import Optional
 from .format import ConvertedType, Type
 from .errors import ParquetError
 
-__all__ = ["col", "Predicate", "prune_row_groups", "chunk_stats_range"]
+__all__ = ["col", "Predicate", "prune_row_groups", "chunk_stats_range",
+           "parse_filter"]
 
 
 _INT_FMT = {Type.INT32: "<i", Type.INT64: "<q"}
@@ -47,6 +48,17 @@ def _is_unsigned(elem) -> bool:
     return it is not None and it.isSigned is False
 
 
+def _is_decimal(elem) -> bool:
+    """DECIMAL stats order by signed numeric value, not by the raw-int or
+    lexicographic order this module compares with — and the row APIs yield
+    SCALED Decimal values, so even int-backed decimals would compare against
+    the wrong magnitude.  Degrade to no-evidence."""
+    if getattr(elem, "converted_type", None) == ConvertedType.DECIMAL:
+        return True
+    lt = getattr(elem, "logicalType", None)
+    return lt is not None and getattr(lt, "DECIMAL", None) is not None
+
+
 def _decode_bound(raw: Optional[bytes], ptype: int, elem,
                   deprecated: bool) -> Optional[object]:
     """Decode one serialized min/max bound to a comparable Python value.
@@ -57,6 +69,8 @@ def _decode_bound(raw: Optional[bytes], ptype: int, elem,
     no-evidence except for INT/FLOAT/DOUBLE.
     """
     if raw is None:
+        return None
+    if _is_decimal(elem):
         return None
     try:
         if ptype in _INT_FMT:
@@ -278,6 +292,83 @@ class _Column:
 def col(name: str) -> _Column:
     """Start a predicate on a (dotted) column path."""
     return _Column(name)
+
+
+def parse_filter(text: str) -> Predicate:
+    """Parse a textual predicate: ``"a > 5 and (b == 'x' or not c <= 3.5)"``.
+
+    Python expression syntax via the ``ast`` module (no eval): comparisons of
+    a column name against an int/float/str/bytes literal, combined with
+    ``and``/``or``/``not``; ``col == None`` / ``col != None`` map to
+    is_null/not_null.  Dotted column paths are written ``a.b.c``.
+    """
+    import ast
+
+    try:
+        tree = ast.parse(text, mode="eval")
+    except SyntaxError as e:
+        raise ParquetError(f"invalid filter expression: {e}") from None
+
+    def name_of(node):
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            raise ParquetError("filter: column must be a (dotted) name")
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+
+    def literal(node):
+        if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float, str, bytes, type(None))
+        ):
+            return node.value
+        if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)
+                and isinstance(node.operand, ast.Constant)
+                and isinstance(node.operand.value, (int, float))):
+            return -node.operand.value
+        raise ParquetError("filter: literal must be int/float/str/None")
+
+    OPS = {ast.Lt: "lt", ast.LtE: "le", ast.Gt: "gt", ast.GtE: "ge",
+           ast.Eq: "eq", ast.NotEq: "ne"}
+    FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+            "eq": "eq", "ne": "ne"}
+
+    def walk(node) -> Predicate:
+        if isinstance(node, ast.BoolOp):
+            parts = [walk(v) for v in node.values]
+            out = parts[0]
+            for nxt in parts[1:]:
+                out = (out & nxt) if isinstance(node.op, ast.And) else (out | nxt)
+            return out
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return ~walk(node.operand)
+        if isinstance(node, ast.Compare):
+            if len(node.ops) != 1:
+                raise ParquetError("filter: chained comparisons unsupported")
+            op = OPS.get(type(node.ops[0]))
+            if op is None:
+                raise ParquetError("filter: unsupported comparison operator")
+            lhs, rhs = node.left, node.comparators[0]
+            if isinstance(lhs, (ast.Name, ast.Attribute)):
+                name, lit = name_of(lhs), literal(rhs)
+            elif isinstance(rhs, (ast.Name, ast.Attribute)):
+                name, lit, op = name_of(rhs), literal(lhs), FLIP[op]
+            else:
+                raise ParquetError("filter: one side must be a column name")
+            if lit is None:
+                if op == "eq":
+                    return _IsNull(name, True)
+                if op == "ne":
+                    return _IsNull(name, False)
+                raise ParquetError("filter: None only supports ==/!=")
+            return _Cmp(name, op, lit)
+        raise ParquetError(
+            f"filter: unsupported syntax {ast.dump(node)[:40]}"
+        )
+
+    return walk(tree.body)
 
 
 def prune_row_groups(metadata, schema, predicate: Predicate) -> list[bool]:

@@ -56,32 +56,40 @@ class FileReader:
         else:
             self._f = source
             self._owns_file = False
-        self.metadata = metadata if metadata is not None else read_file_metadata(self._f)
-        self.schema = Schema.from_file_metadata(self.metadata)
-        if columns is not None:
-            paths = [_as_path_tuple(c) for c in columns]
-            self.schema.set_selected(paths)
-            if not self.schema.selected_leaves():
-                known = [".".join(l.path) for l in self.schema.leaves]
-                raise ParquetError(
-                    f"selected columns {['.'.join(p) for p in paths]} match no "
-                    f"schema columns; available: {known}"
-                )
-        self.validate_crc = validate_crc
-        self.alloc = AllocTracker(max_memory)
-        self._current_row_group = 0
-        self._preloaded: Optional[dict[str, ColumnData]] = None
-        # statistics-based row-group pruning (predicate pushdown): groups
-        # whose footer stats prove the predicate can never match are skipped
-        # by the iteration APIs — their bytes are never read
-        self.row_filter = row_filter
-        if row_filter is not None:
-            from .predicate import prune_row_groups
+        try:
+            self.metadata = (metadata if metadata is not None
+                             else read_file_metadata(self._f))
+            self.schema = Schema.from_file_metadata(self.metadata)
+            if columns is not None:
+                paths = [_as_path_tuple(c) for c in columns]
+                self.schema.set_selected(paths)
+                if not self.schema.selected_leaves():
+                    known = [".".join(l.path) for l in self.schema.leaves]
+                    raise ParquetError(
+                        f"selected columns {['.'.join(p) for p in paths]} "
+                        f"match no schema columns; available: {known}"
+                    )
+            self.validate_crc = validate_crc
+            self.alloc = AllocTracker(max_memory)
+            self._current_row_group = 0
+            self._preloaded: Optional[dict[str, ColumnData]] = None
+            # statistics-based row-group pruning (predicate pushdown): groups
+            # whose footer stats prove the predicate can never match are
+            # skipped by the iteration APIs — their bytes are never read
+            self.row_filter = row_filter
+            if row_filter is not None:
+                from .predicate import prune_row_groups
 
-            self._rg_keep = prune_row_groups(self.metadata, self.schema,
-                                             row_filter)
-        else:
-            self._rg_keep = None
+                self._rg_keep = prune_row_groups(self.metadata, self.schema,
+                                                 row_filter)
+            else:
+                self._rg_keep = None
+        except BaseException:
+            # a constructor failure (bad footer, bad projection, bad filter)
+            # must not leak the fd this reader opened
+            if self._owns_file:
+                self._f.close()
+            raise
 
     def row_group_selected(self, index: int) -> bool:
         """False when ``row_filter`` proves row group ``index`` cannot match."""
