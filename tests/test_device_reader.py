@@ -458,3 +458,50 @@ def test_growing_dict_width_fused(tmp_path):
         np.ascontiguousarray(got["d"].to_host()).view(np.uint8),
         np.ascontiguousarray(h["d"].values).view(np.uint8),
     )
+
+
+def test_flba_and_int96_fused(tmp_path):
+    """FLBA (UUID-like) and INT96 PLAIN chunks take the fused rows path,
+    not the per-page host fallback."""
+    import datetime
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(8)
+    n = 20_000
+    uuids = rng.integers(0, 256, (n, 16), dtype=np.uint8)
+    ts = [datetime.datetime(2001, 1, 1) + datetime.timedelta(seconds=int(s))
+          for s in rng.integers(0, 10**8, n)]
+    p = tmp_path / "f.parquet"
+    pq.write_table(
+        pa.table({
+            "u": pa.array([v.tobytes() for v in uuids],
+                          type=pa.binary(16)),
+            "t": pa.array(ts, type=pa.timestamp("ns")),
+        }),
+        p, use_dictionary=False, compression="snappy",
+        use_deprecated_int96_timestamps=True, data_page_size=32 << 10,
+    )
+    import tpu_parquet.device_reader as drmod
+
+    calls = []
+    orig = drmod._ChunkAssembler._finish_host
+
+    def spy(self, common):
+        calls.append(tuple(self.leaf.path))
+        return orig(self, common)
+
+    drmod._ChunkAssembler._finish_host = spy
+    try:
+        with DeviceFileReader(p) as dr:
+            d = dr.read_row_group(0)
+    finally:
+        drmod._ChunkAssembler._finish_host = orig
+    assert not calls, f"fell back to page-at-a-time host path for {calls}"
+    with FileReader(p) as hr:
+        h = hr.read_row_group(0)
+    gu = d["u"].to_host()
+    np.testing.assert_array_equal(gu.offsets, h["u"].values.offsets)
+    np.testing.assert_array_equal(gu.heap, h["u"].values.heap)
+    np.testing.assert_array_equal(d["t"].to_host(), h["t"].values)

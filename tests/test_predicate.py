@@ -282,17 +282,23 @@ def test_decimal_columns_never_pruned(tmp_path):
 
 
 def test_constructor_failure_closes_file(tmp_path):
-    import gc
+    """The fd must close EAGERLY on constructor failure — not by refcount
+    luck.  Holding every exception's traceback keeps the half-built reader
+    (and, absent the fix, its open file object) alive, so a leak would show
+    up as a growing /proc/self/fd count."""
+    import os
 
     data, _ = _file()
     p = tmp_path / "f.parquet"
     p.write_bytes(data)
-    import resource
+    held = []
+    before = len(os.listdir("/proc/self/fd"))
     for _ in range(8):
-        with pytest.raises(ParquetError):
+        try:
             FileReader(str(p), row_filter=col("typo") > 1)
-    gc.collect()
-    # the fds must have been closed eagerly, not by GC luck: open a reader
-    # normally to prove the path still works
-    with FileReader(str(p)) as r:
-        assert r.num_rows > 0
+            raise AssertionError("expected ParquetError")
+        except ParquetError as e:
+            held.append(e)  # tb pins the half-built reader alive
+    after = len(os.listdir("/proc/self/fd"))
+    assert after == before, f"leaked {after - before} fds"
+    del held

@@ -1,0 +1,93 @@
+"""End-to-end TPU input pipeline: parquet file → device batches → sharded
+jitted train step.
+
+The product story in one test: a pyarrow-written file is decoded by
+DeviceFileReader (with predicate pushdown), iter_batches yields fixed-shape
+device arrays, each batch is laid out over an 8-device mesh with a
+NamedSharding, and a jitted SGD step (whose gradients reduce over the mesh
+via XLA-inserted collectives) consumes them — one compile for the whole run.
+Runs on the virtual CPU mesh (conftest); the same program compiles for a TPU
+pod slice unchanged.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_parquet.device_reader import DeviceFileReader
+from tpu_parquet.parallel import make_mesh
+from tpu_parquet.predicate import col
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(0)
+    n = 40_000
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    w_true = np.arange(1, 9, dtype=np.float32)
+    y = x @ w_true + 0.01 * rng.normal(size=n).astype(np.float32)
+    split = rng.integers(0, 10, n)  # column used for pushdown
+    p = tmp_path_factory.mktemp("pipe") / "train.parquet"
+    cols = {f"f{j}": x[:, j] for j in range(8)}
+    cols["label"] = y.astype(np.float32)
+    cols["fold"] = split.astype(np.int32)
+    pq.write_table(pa.table(cols), p, row_group_size=5000,
+                   use_dictionary=False, compression="snappy")
+    return p, w_true
+
+
+def test_train_step_over_mesh(dataset):
+    path, w_true = dataset
+    mesh = make_mesh()  # 1-D data mesh over the 8 virtual devices
+    batch_sharding = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+
+    feat_names = [f"f{j}" for j in range(8)]
+
+    @jax.jit
+    def train_step(w, feats, label):
+        def loss(w):
+            pred = feats @ w
+            return jnp.mean((pred - label) ** 2)
+
+        g = jax.grad(loss)(w)
+        return w - 0.1 * g
+
+    w = jax.device_put(jnp.zeros(8, dtype=jnp.float32), repl)
+    n_batches = 0
+    compiled_shapes = set()
+    for _epoch in range(4):
+        with DeviceFileReader(path) as r:
+            for batch in r.iter_batches(4096):
+                feats = jnp.stack([batch[k] for k in feat_names], axis=1)
+                feats = jax.device_put(feats, batch_sharding)
+                label = jax.device_put(batch["label"], batch_sharding)
+                w = train_step(w, feats, label)
+                compiled_shapes.add((feats.shape, label.shape))
+                n_batches += 1
+    w = np.asarray(w)
+    assert n_batches == 4 * (40_000 // 4096)
+    assert len(compiled_shapes) == 1  # fixed shapes: one executable
+    # converged toward the generating weights
+    assert np.allclose(w, w_true, atol=0.1), w
+
+
+def test_pipeline_with_pushdown(dataset):
+    path, _ = dataset
+    pred = col("fold") < 3  # conservative: keeps groups that may match
+    with DeviceFileReader(path, row_filter=pred) as r:
+        total = sum(
+            int(cols["label"].num_values) for cols in r.iter_row_groups()
+        )
+        # fold is uniform 0..9 per group, so stats ranges span everything
+        # and nothing can be pruned — the pipeline still runs end to end
+        assert total == r._host.num_selected_rows
+    # a selective predicate on a clustered column does prune
+    with DeviceFileReader(path, row_filter=col("label") > 1e9) as r:
+        assert sum(1 for _ in r.iter_row_groups()) == 0

@@ -7,7 +7,11 @@ Command parity with the reference's parquet-tool (cmd/parquet-tool/cmds/):
     meta      flat schema + per-column R/D levels + row group info (meta.go)
     schema    print the textual schema definition  (schema.go:16-37)
     rowcount  number of rows from the footer       (rowcount.go:16-37)
+    stats     per-row-group column min/max/null_count (beyond the reference)
     split     re-shard into parts of at most a given size (split.go:31-117)
+
+cat/head/rowcount take --filter "a > 5 and b == 'x'" for statistics-based
+row-group pruning (tpu_parquet.predicate).
 
 Usage: python -m tpu_parquet.cli.pq_tool <command> [options] <file>
 """

@@ -44,8 +44,8 @@ from .format import Encoding, PageType, Type, parse_encoding
 from .jax_decode import (
     DeviceColumnData, ParsedDataPage, _bucket, _bucket_bytes, _bucket_count,
     _SLACK, _concat_jit, _concat_ragged_jit, _dict_gather_bytes_jit,
-    _hybrid_jit, _hybrid_vw_jit, _max_jit, _plain_jit, _PTYPE_TO_NAME,
-    _stack_jit,
+    _hybrid_jit, _hybrid_vw_jit, _max_jit, _plain_flba_jit, _plain_jit,
+    _plain_rows_jit, _PTYPE_TO_NAME, _stack_jit,
     host_decode_dictionary, parse_data_page, parse_hybrid_meta, parse_delta_meta,
 )
 from .schema.core import SchemaNode
@@ -358,6 +358,14 @@ class _ChunkAssembler:
                 value_fn = self._finish_plain_bool(common, stager)
             elif enc == Encoding.PLAIN and leaf.physical_type == Type.BYTE_ARRAY:
                 value_fn = self._finish_plain_bytes(common, stager)
+            elif (enc == Encoding.PLAIN and leaf.physical_type == Type.INT96):
+                value_fn = self._finish_plain_rows(common, stager, 12)
+            elif (enc == Encoding.PLAIN
+                  and leaf.physical_type == Type.FIXED_LEN_BYTE_ARRAY
+                  and (leaf.type_length or 0) > 0):
+                value_fn = self._finish_plain_rows(common, stager,
+                                                   leaf.type_length,
+                                                   flba=True)
             elif enc == Encoding.DELTA_BINARY_PACKED:
                 value_fn = self._finish_delta(common, stager)
             else:
@@ -446,29 +454,60 @@ class _ChunkAssembler:
             (p.raw, p.value_pos, len(p.raw) - p.value_pos) for p in self.pages
         ])
 
-    def _finish_plain_fixed(self, common, stager):
-        name = _PTYPE_TO_NAME[self.leaf.physical_type]
-        itemsize = np.dtype(name).itemsize
+    def _stage_fixed_width(self, stager, width: int):
+        """Register exactly the pages' value bytes back-to-back for a
+        ``width``-bytes-per-value PLAIN stream.
+
+        Returns (base, defined, count): the staged byte base, the real value
+        count, and the bucketed static count the kernel decodes — it reads
+        past the segments into whatever follows in the staged buffer
+        (harmless garbage past n_values, in-bounds by note_read_extent), so
+        one executable is shared across chunks.
+        """
         defined = sum(p.defined for p in self.pages)
         for p in self.pages:
-            if len(p.raw) - p.value_pos < p.defined * itemsize:
+            if len(p.raw) - p.value_pos < p.defined * width:
                 raise ParquetError(
                     f"PLAIN data truncated: {len(p.raw) - p.value_pos} "
-                    f"< {p.defined * itemsize}"
+                    f"< {p.defined * width}"
                 )
-        # exactly the value bytes back-to-back → one contiguous bitcast; the
-        # bitcast reads a BUCKETED count (executable shared across chunks),
-        # overreading into whatever follows in the staged buffer — harmless
-        # garbage past n_values, guaranteed in-bounds by note_read_extent
-        segs = [(p.raw, p.value_pos, p.defined * itemsize) for p in self.pages]
-        base = int(stager.add_segments(segs)[0]) if segs else stager._reserve(0, None)
+        segs = [(p.raw, p.value_pos, p.defined * width) for p in self.pages]
+        base = (int(stager.add_segments(segs)[0]) if segs
+                else stager._reserve(0, None))
         count = _bucket_count(defined)
-        stager.note_read_extent(base, count * itemsize)
+        stager.note_read_extent(base, count * width)
+        return base, defined, count
+
+    def _finish_plain_fixed(self, common, stager):
+        name = _PTYPE_TO_NAME[self.leaf.physical_type]
+        base, defined, count = self._stage_fixed_width(
+            stager, np.dtype(name).itemsize
+        )
         return lambda buf_dev: DeviceColumnData(
             values=_plain_jit(buf_dev, np.int64(base), dtype=name, count=count),
             n_values=defined,
             **common,
         )
+
+    def _finish_plain_rows(self, common, stager, k: int, flba: bool = False):
+        """PLAIN fixed-length rows: exactly the value bytes back-to-back, one
+        bucketed slice — INT96 as u32[n,3] values, FLBA as the uniform
+        (offsets, heap) ragged form (matching the host decoder)."""
+        base, defined, count = self._stage_fixed_width(stager, k)
+
+        def run(buf_dev):
+            col = DeviceColumnData(n_values=defined, **common)
+            if flba:
+                col.offsets, col.heap = _plain_flba_jit(
+                    buf_dev, np.int64(base), k=k, count=count
+                )
+            else:
+                col.values = _plain_rows_jit(
+                    buf_dev, np.int64(base), k=k, count=count
+                )
+            return col
+
+        return run
 
     def _finish_plain_bool(self, common, stager):
         defined = sum(p.defined for p in self.pages)

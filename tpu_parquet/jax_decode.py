@@ -577,6 +577,25 @@ def _plain_jit(buf, off, *, dtype, count):
     return K.plain_decode_fixed(raw, dtype, count)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "count"))
+def _plain_rows_jit(buf, off, *, k, count):
+    """PLAIN INT96 rows: 12-byte rows bitcast to little-endian u32[count, 3]
+    (the host decoder's layout)."""
+    raw = jax.lax.dynamic_slice(buf, (off,), (count * k,))
+    return jax.lax.bitcast_convert_type(
+        raw.reshape(count, k // 4, 4), jnp.uint32
+    ).reshape(count, k // 4)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "count"))
+def _plain_flba_jit(buf, off, *, k, count):
+    """PLAIN FIXED_LEN_BYTE_ARRAY: uniform (offsets, heap) ragged form —
+    the host decoder's representation (kernels/plain.py FLBA)."""
+    heap = jax.lax.dynamic_slice(buf, (off,), (count * k,))
+    offsets = jnp.arange(count + 1, dtype=jnp.int64) * k
+    return offsets, heap
+
+
 @functools.partial(jax.jit, static_argnames=("dtype", "count"))
 def _bss_jit(buf, off, *, dtype, count):
     nbytes = 8 if dtype in ("int64", "float64") else 4
