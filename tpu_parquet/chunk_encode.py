@@ -63,22 +63,72 @@ def _values_slice(values, lo: int, hi: int):
     return values[lo:hi]
 
 
+def _unique_bytes_seq(values: ByteArrayData):
+    """Sequential dict walk: O(heap) memory, bails at MAX_DICT_SIZE+1 distinct.
+    The fallback for columns whose dominant length class would make the
+    vectorized gather's transient memory excessive."""
+    seen: dict = {}
+    idx = np.empty(len(values), dtype=np.int64)
+    for i, v in enumerate(values.to_list()):
+        j = seen.get(v)
+        if j is None:
+            j = len(seen)
+            if j >= MAX_DICT_SIZE:
+                return None
+            seen[v] = j
+        idx[i] = j
+    return ByteArrayData.from_list(list(seen)), idx
+
+
+def _unique_bytes(values: ByteArrayData):
+    """Vectorized first-appearance uniquing of a ragged byte column.
+
+    Values are grouped by length; each group's bytes gather into a fixed
+    (m, L) u8 matrix that np.unique(axis=0) dedups at C speed — no per-value
+    Python loop (the dict-of-bytes walk cost ~40% of writer time on string
+    columns).  Distinct ids are then renumbered by global first appearance,
+    matching the sequential walk's output exactly.
+    """
+    off = np.asarray(values.offsets)
+    heap = np.asarray(values.heap)
+    n = len(values)
+    lens = np.diff(off)
+    idx_out = np.empty(n, dtype=np.int64)
+    groups = []  # (global_first[int64[k]], sel, inv) per length class
+    distinct = 0
+    for length in np.unique(lens):
+        sel = np.flatnonzero(lens == length)
+        ln = int(length)
+        # the gather materializes ~9x this class's heap bytes transiently
+        # (int64 index matrix + row copy + unique's sort buffers); past a
+        # sane cap, the O(heap)-memory sequential walk is the better deal
+        if len(sel) * max(ln, 1) * 9 > 512 << 20:
+            return _unique_bytes_seq(values)
+        rows = heap[off[sel][:, None] + np.arange(ln, dtype=np.int64)]
+        _, first, inv = np.unique(rows, axis=0, return_index=True,
+                                  return_inverse=True)
+        distinct += len(first)
+        if distinct > MAX_DICT_SIZE:
+            return None  # early bail: don't unique the remaining classes
+        groups.append((sel[first], sel, inv.reshape(-1)))
+    all_first = np.concatenate([g[0] for g in groups])
+    order = np.argsort(all_first, kind="stable")
+    rank = np.empty(len(all_first), dtype=np.int64)
+    rank[order] = np.arange(len(order))
+    pos = 0
+    for g_first, sel, inv in groups:
+        idx_out[sel] = rank[pos : pos + len(g_first)][inv]
+        pos += len(g_first)
+    return values.take(all_first[order]), idx_out
+
+
 def _unique_with_indices(values, ptype: Type):
     """(dict_values, indices) preserving first-appearance order, or None if the
     distinct count exceeds the reference's MaxInt16 threshold."""
     if isinstance(values, ByteArrayData):
-        seen: dict = {}
-        idx = np.empty(len(values), dtype=np.int64)
-        items = values.to_list()
-        for i, v in enumerate(items):
-            j = seen.get(v)
-            if j is None:
-                j = len(seen)
-                if j >= MAX_DICT_SIZE:  # would exceed 32767 distinct values
-                    return None
-                seen[v] = j
-            idx[i] = j
-        return ByteArrayData.from_list(list(seen)), idx
+        if len(values) == 0:
+            return ByteArrayData.from_list([]), np.zeros(0, dtype=np.int64)
+        return _unique_bytes(values)
     arr = np.asarray(values)
     if ptype == Type.INT96:
         return None  # no dictionary for int96 (reference parity)

@@ -242,6 +242,8 @@ def register_codec(codec: int, compressor: BlockCompressor) -> None:
 
 
 def get_codec(codec: int) -> BlockCompressor:
+    if codec is None:  # absent thrift field (fuzz: file_reader-7c7d4874355f)
+        raise CompressionError("column chunk missing compression codec")
     with _registry_lock:
         c = _registry.get(int(codec))
     if c is None:

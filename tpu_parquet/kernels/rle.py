@@ -259,13 +259,16 @@ def encode(
     # Only the final bit-packed run may be zero-padded (decoder trims by count).
     change = np.flatnonzero(np.diff(vals)) + 1
     bounds = np.concatenate([[0], change, [n]])
-    pending_start = 0  # start of accumulated not-yet-emitted span
+    run_starts = bounds[:-1]
+    run_lens = np.diff(bounds)
     min_rle = max(min_rle_run, 8)
-    for i in range(len(bounds) - 1):
-        start, end = int(bounds[i]), int(bounds[i + 1])
-        run_len = end - start
-        if run_len < min_rle:
-            continue
+    # only constant runs >= min_rle can become RLE; everything else stays in
+    # the buffered bit-packed span — iterating candidates (few) instead of
+    # every segment (~n for high-cardinality data) keeps this O(runs_emitted)
+    pending_start = 0  # start of accumulated not-yet-emitted span
+    for ci in np.flatnonzero(run_lens >= min_rle):
+        start = int(run_starts[ci])
+        run_len = int(run_lens[ci])
         pend = start - pending_start
         borrow = (-pend) % 8
         if run_len - borrow < min_rle:
@@ -273,7 +276,7 @@ def encode(
         if pend + borrow:
             put_bitpacked(vals[pending_start : start + borrow])
         put_rle(int(vals[start]), run_len - borrow)
-        pending_start = end
+        pending_start = start + run_len
     if n > pending_start:
         put_bitpacked(vals[pending_start:])
     return bytes(out)
