@@ -44,8 +44,8 @@ from .format import Encoding, PageType, Type, parse_encoding
 from .jax_decode import (
     DeviceColumnData, ParsedDataPage, _bucket, _bucket_bytes, _bucket_count,
     _SLACK, _concat_jit, _concat_ragged_jit, _dict_gather_bytes_jit,
-    _hybrid_jit, _hybrid_vw_jit, _max_jit, _plain_flba_jit, _plain_jit,
-    _plain_rows_jit, _PTYPE_TO_NAME, _stack_jit,
+    _dict_rows_jit, _hybrid_jit, _hybrid_vw_jit, _max_jit, _plain_flba_jit,
+    _plain_jit, _plain_rows_jit, _PTYPE_TO_NAME, _stack_jit,
     host_decode_dictionary, parse_data_page, parse_hybrid_meta, parse_delta_meta,
 )
 from .schema.core import SchemaNode
@@ -662,14 +662,18 @@ class _ChunkAssembler:
                 f"in column {'.'.join(self.leaf.path)}"
             )
         dict_u8 = self.dict_u8
+        dict_base = dict_kp = dict_itemsize = None
         if dict_u8 is not None:
-            # pad dictionary rows to a bucketed row count so the gather
-            # executable is shared across chunks with different dict sizes
-            kp = _bucket(max(self.dict_len, 1))
-            if kp != dict_u8.shape[0]:
-                pad = np.zeros((kp - dict_u8.shape[0],) + dict_u8.shape[1:],
-                               dtype=dict_u8.dtype)
-                dict_u8 = np.concatenate([dict_u8, pad])
+            # dictionary bytes ride the row-group buffer (no extra transfer);
+            # the row count is bucketed so the slice/gather executables are
+            # shared across chunks with different dict sizes
+            dict_kp = _bucket(max(self.dict_len, 1))
+            dict_itemsize = int(dict_u8.shape[1])
+            # zero-filled reserve (NOT a read-extent overlap): clamped
+            # out-of-range gathers on the deferred-check path must see zeros,
+            # never a neighboring chunk's staged bytes
+            dict_base = stager.add(np.ascontiguousarray(dict_u8),
+                                   reserve=dict_kp * dict_itemsize)
 
         def run(buf_dev):
             if uniform:
@@ -698,7 +702,10 @@ class _ChunkAssembler:
                 )
             col = DeviceDictColumn(indices=idx, n_values=prefix, **common)
             if dict_u8 is not None:
-                col.dict_u8 = jnp.asarray(dict_u8)
+                col.dict_u8 = _dict_rows_jit(
+                    buf_dev, np.int64(dict_base), k=dict_kp,
+                    itemsize=dict_itemsize,
+                )
                 col.dict_dtype = self.dict_dtype
             else:
                 col.dict_offsets = jnp.asarray(self.dict_ragged.offsets)

@@ -614,6 +614,21 @@ def _dict_gather_bytes_jit(dict_u8, indices, *, dtype):
     return K.dict_gather_bytes(dict_u8, indices, dtype)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "itemsize"))
+def _dict_rows_jit(buf, base, *, k, itemsize):
+    """Cut a dictionary's (k, itemsize) u8 rows out of the staged buffer.
+
+    The dictionary bytes ride the one row-group transfer instead of a
+    separate jnp.asarray per chunk (each such transfer costs a fixed
+    ~50-100ms tunnel round trip); this on-device slice is an async dispatch.
+    ``k`` is bucketed — rows past the real dictionary are in-bounds garbage
+    that range-checked indices never gather.
+    """
+    return jax.lax.dynamic_slice(buf, (base,), (k * itemsize,)).reshape(
+        k, itemsize
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("out_heap_size",))
 def _ragged_take_jit(offsets, heap, indices, *, out_heap_size):
     return K.ragged_take(offsets, heap, indices, out_heap_size)
