@@ -206,29 +206,41 @@ def gen_lineitem16(path, rows):
 
 
 def gen_nested(path, rows):
-    """NYC-taxi-like nested shapes, written by pyarrow (foreign writer)."""
+    """NYC-taxi-like nested shapes, written by pyarrow (foreign writer).
+
+    TWO files (BASELINE config 5 is a multi-file row-group scan); the bench
+    paths discover the `.part2` sibling and scan both."""
     import numpy as np
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    rng = np.random.default_rng(5)
-    n = rows
-    lens = rng.integers(0, 5, n)
-    flat = rng.integers(0, 300, int(lens.sum()))
-    offs = np.zeros(n + 1, dtype=np.int32)
-    np.cumsum(lens, out=offs[1:])
-    zones = pa.ListArray.from_arrays(pa.array(offs), pa.array(flat))
-    keys = ["fare", "tip", "tolls"]
-    mk = [{k: float(rng.uniform(1, 60)) for k in keys[: rng.integers(1, 4)]}
-          for _ in range(256)]
-    t = pa.table({
-        "trip_id": np.arange(n, dtype=np.int64),
-        "zones": zones,
-        "charges": pa.array([mk[i % 256] for i in range(n)],
-                            type=pa.map_(pa.string(), pa.float64())),
-        "distance": rng.uniform(0.3, 40.0, n),
-    })
-    pq.write_table(t, path, compression="snappy", row_group_size=1 << 20)
+    # the .part2 sibling is written FIRST: the main file is the generation
+    # cache key, so its existence must imply the sibling exists too
+    for part, (seed, out) in enumerate([(6, path + ".part2"), (5, path)]):
+        rng = np.random.default_rng(seed)
+        n = rows // 2 if part == 0 else rows - rows // 2
+        lens = rng.integers(0, 5, n)
+        flat = rng.integers(0, 300, int(lens.sum()))
+        offs = np.zeros(n + 1, dtype=np.int32)
+        np.cumsum(lens, out=offs[1:])
+        zones = pa.ListArray.from_arrays(pa.array(offs), pa.array(flat))
+        keys = ["fare", "tip", "tolls"]
+        mk = [{k: float(rng.uniform(1, 60)) for k in keys[: rng.integers(1, 4)]}
+              for _ in range(256)]
+        t = pa.table({
+            "trip_id": np.arange(n, dtype=np.int64),
+            "zones": zones,
+            "charges": pa.array([mk[i % 256] for i in range(n)],
+                                type=pa.map_(pa.string(), pa.float64())),
+            "distance": rng.uniform(0.3, 40.0, n),
+        })
+        pq.write_table(t, out, compression="snappy", row_group_size=1 << 20)
+
+
+def _bench_paths(path):
+    """The config's file set: the main file plus the multi-file siblings."""
+    sib = path + ".part2"
+    return [path, sib] if os.path.exists(sib) else [path]
 
 
 # ---------------------------------------------------------------------------
@@ -238,11 +250,14 @@ def gen_nested(path, rows):
 def _uncompressed_mb(path):
     from tpu_parquet.reader import FileReader
 
-    with FileReader(path) as r:
-        return sum(
-            cc.meta_data.total_uncompressed_size or 0
-            for rg in r.metadata.row_groups for cc in rg.columns
-        ) / 1e6
+    total = 0
+    for p in _bench_paths(path):
+        with FileReader(p) as r:
+            total += sum(
+                cc.meta_data.total_uncompressed_size or 0
+                for rg in r.metadata.row_groups for cc in rg.columns
+            )
+    return total / 1e6
 
 
 def bench_device(path, rows):
@@ -250,15 +265,16 @@ def bench_device(path, rows):
     from tpu_parquet.device_reader import DeviceFileReader
 
     def run():
-        with DeviceFileReader(path) as r:
-            outs = []
-            for cols in r.iter_row_groups():
-                outs.extend(cols.values())
-            arrs = [a for o in outs
-                    for a in (o.values, o.offsets, o.heap,
-                              getattr(o, "indices", None))
-                    if a is not None]
-            jax.block_until_ready(arrs)
+        outs = []
+        for p in _bench_paths(path):
+            with DeviceFileReader(p) as r:
+                for cols in r.iter_row_groups():
+                    outs.extend(cols.values())
+        arrs = [a for o in outs
+                for a in (o.values, o.offsets, o.heap,
+                          getattr(o, "indices", None))
+                if a is not None]
+        jax.block_until_ready(arrs)
 
     run()  # warm: XLA executables cached after this
     best = float("inf")
@@ -268,11 +284,13 @@ def bench_device(path, rows):
         dt = time.perf_counter() - t0
         log(f"  device rep {i}: {dt:.3f}s ({rows/dt/1e6:.2f} M rows/s)")
         best = min(best, dt)
-    # observability counters from one instrumented pass (SURVEY.md §5.5)
-    with DeviceFileReader(path) as r:
-        for cols in r.iter_row_groups():
-            pass
-        log(f"  reader stats: {r.stats().as_dict()}")
+    # observability counters from one instrumented pass (SURVEY.md §5.5),
+    # accumulated over every file of the config (multi-file nested scan)
+    for p in _bench_paths(path):
+        with DeviceFileReader(p) as r:
+            for cols in r.iter_row_groups():
+                pass
+            log(f"  reader stats[{os.path.basename(p)}]: {r.stats().as_dict()}")
     return best
 
 
@@ -286,19 +304,21 @@ def bench_host(path, rows, upload=False):
     from tpu_parquet.reader import FileReader
 
     def run():
-        with FileReader(path) as r:
-            staged = []
-            for rg in r.iter_row_groups():
-                if upload:
-                    for cd in rg.values():
-                        v = cd.values
-                        if isinstance(v, ByteArrayData):
-                            staged.append(jax.device_put(v.offsets))
-                            staged.append(jax.device_put(v.heap))
-                        else:
-                            staged.append(jax.device_put(np.ascontiguousarray(v)))
-            if staged:
-                jax.block_until_ready(staged)
+        staged = []
+        for p in _bench_paths(path):
+            with FileReader(p) as r:
+                for rg in r.iter_row_groups():
+                    if upload:
+                        for cd in rg.values():
+                            v = cd.values
+                            if isinstance(v, ByteArrayData):
+                                staged.append(jax.device_put(v.offsets))
+                                staged.append(jax.device_put(v.heap))
+                            else:
+                                staged.append(
+                                    jax.device_put(np.ascontiguousarray(v)))
+        if staged:
+            jax.block_until_ready(staged)
 
     run()
     best = float("inf")
@@ -401,7 +421,10 @@ def main():
         name, gen, base_rows = CONFIGS[key]
         rows = int(base_rows * SCALE)
         path = f"/tmp/tpq_bench_{name}_{rows}.parquet"
-        if not os.path.exists(path):
+        # the nested config is multi-file: ALL parts must exist or the scan
+        # quietly under-reads while `rows` stays the full denominator
+        required = [path] + ([path + ".part2"] if name == "nested" else [])
+        if not all(os.path.exists(p) for p in required):
             t0 = time.perf_counter()
             try:
                 gen(path, rows)
@@ -410,7 +433,8 @@ def main():
                 if os.path.exists(path):
                     os.unlink(path)
                 continue
-            log(f"generated {path}: {os.path.getsize(path)/1e6:.1f} MB "
+            gen_mb = sum(os.path.getsize(p) for p in required) / 1e6
+            log(f"generated {path} ({len(required)} file(s)): {gen_mb:.1f} MB "
                 f"in {time.perf_counter()-t0:.1f}s")
         mb = _uncompressed_mb(path)
         log(f"config {key} {name}: {rows} rows, {mb:.0f} MB uncompressed")

@@ -621,8 +621,11 @@ def _dict_rows_jit(buf, base, *, k, itemsize):
     The dictionary bytes ride the one row-group transfer instead of a
     separate jnp.asarray per chunk (each such transfer costs a fixed
     ~50-100ms tunnel round trip); this on-device slice is an async dispatch.
-    ``k`` is bucketed — rows past the real dictionary are in-bounds garbage
-    that range-checked indices never gather.
+    ``k`` is bucketed, and the caller MUST stage the dictionary with a
+    zero-filled reserve covering k*itemsize (stager.add(..., reserve=...)):
+    on the deferred range-check path, clamped out-of-range indices DO gather
+    the tail rows before validation resolves, and they must read as zeros —
+    never a neighboring chunk's staged bytes (see device_reader._finish_dict).
     """
     return jax.lax.dynamic_slice(buf, (base,), (k * itemsize,)).reshape(
         k, itemsize
