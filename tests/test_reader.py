@@ -279,3 +279,55 @@ def test_empty_table(tmp_path):
         assert r.num_rows == 0
         cols = r.read_all()
         assert len(cols["v"].values) == 0
+
+
+def test_set_selected_columns_midread(tmp_path):
+    """SetSelectedColumns parity (schema.go:347-367): re-project between row
+    groups; unselected chunks are seeked past, not decoded."""
+    import io
+
+    from tpu_parquet.format import FieldRepetitionType as FRT, Type
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.writer import FileWriter
+
+    schema = build_schema([
+        data_column("a", Type.INT64, FRT.REQUIRED),
+        data_column("b", Type.INT64, FRT.REQUIRED),
+    ])
+    buf = io.BytesIO()
+    with FileWriter(buf, schema) as w:
+        for g in range(3):
+            for i in range(10):
+                w.write_row({"a": g * 100 + i, "b": -(g * 100 + i)})
+            w.flush_row_group()
+    with FileReader(io.BytesIO(buf.getvalue())) as r:
+        g0 = r.read_row_group(0)
+        assert set(g0) == {"a", "b"}
+        r.set_selected_columns(["b"])
+        g1 = r.read_row_group(1)
+        assert set(g1) == {"b"} and g1["b"].values[0] == -100
+        r.set_selected_columns(None)
+        g2 = r.read_row_group(2)
+        assert set(g2) == {"a", "b"}
+        with pytest.raises(ParquetError, match="no schema columns"):
+            r.set_selected_columns(["nope"])
+
+
+def test_set_selected_columns_failure_keeps_selection(tmp_path):
+    """A failed re-projection must leave the previous selection intact —
+    not an applied-empty selection that silently reads {}."""
+    import io
+
+    from tpu_parquet.format import FieldRepetitionType as FRT, Type
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.writer import FileWriter
+
+    schema = build_schema([data_column("a", Type.INT64, FRT.REQUIRED)])
+    buf = io.BytesIO()
+    with FileWriter(buf, schema) as w:
+        w.write_row({"a": 7})
+    with FileReader(io.BytesIO(buf.getvalue())) as r:
+        with pytest.raises(ParquetError):
+            r.set_selected_columns(["typo"])
+        g = r.read_row_group(0)  # selection unchanged: still decodes "a"
+        assert set(g) == {"a"} and g["a"].values[0] == 7

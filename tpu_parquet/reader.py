@@ -60,15 +60,9 @@ class FileReader:
             self.metadata = (metadata if metadata is not None
                              else read_file_metadata(self._f))
             self.schema = Schema.from_file_metadata(self.metadata)
+            self._preloaded: Optional[dict[str, ColumnData]] = None
             if columns is not None:
-                paths = [_as_path_tuple(c) for c in columns]
-                self.schema.set_selected(paths)
-                if not self.schema.selected_leaves():
-                    known = [".".join(l.path) for l in self.schema.leaves]
-                    raise ParquetError(
-                        f"selected columns {['.'.join(p) for p in paths]} "
-                        f"match no schema columns; available: {known}"
-                    )
+                self.set_selected_columns(columns)
             self.validate_crc = validate_crc
             self.alloc = AllocTracker(max_memory)
             self._current_row_group = 0
@@ -90,6 +84,26 @@ class FileReader:
             if self._owns_file:
                 self._f.close()
             raise
+
+    def set_selected_columns(self, columns) -> None:
+        """Re-project mid-read (SetSelectedColumns parity, schema.go:347-367):
+        subsequent row-group reads decode only these columns, seeking past the
+        rest.  ``None`` restores all columns.  Clears any preloaded group.
+        Validates BEFORE applying: a failed call leaves the selection as it
+        was (an applied-then-raised empty selection would make later reads
+        silently return {})."""
+        if columns is None:
+            self.schema.set_selected(None)
+        else:
+            paths = [_as_path_tuple(c) for c in columns]
+            if not self.schema.selection_matches(paths):
+                known = [".".join(l.path) for l in self.schema.leaves]
+                raise ParquetError(
+                    f"selected columns {['.'.join(p) for p in paths]} "
+                    f"match no schema columns; available: {known}"
+                )
+            self.schema.set_selected(paths)
+        self._preloaded = None
 
     def row_group_selected(self, index: int) -> bool:
         """False when ``row_filter`` proves row group ``index`` cannot match."""

@@ -249,6 +249,15 @@ class Schema:
     def selected_leaves(self) -> list[SchemaNode]:
         return [l for l in self.leaves if self.is_selected(l.path)]
 
+    def selection_matches(self, paths) -> bool:
+        """Would ``set_selected(paths)`` select at least one leaf?  Lets
+        callers validate BEFORE mutating the live selection."""
+        sel = {tuple(p) for p in paths}
+        return any(
+            l.path[: len(s)] == s or s[: len(l.path)] == l.path
+            for l in self.leaves for s in sel
+        )
+
     # -- lookup --------------------------------------------------------------
 
     def leaf_by_path(self, path: Sequence[str]) -> Optional[SchemaNode]:
