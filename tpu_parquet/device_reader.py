@@ -25,6 +25,7 @@ on host (inherently sequential, SURVEY.md §7.4.2/§7.4.4) and stage their
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -260,6 +261,132 @@ class _RowGroupStager:
         return jnp.asarray(buf)
 
 
+def _pallas_interpret_mode():
+    """Whether hybrid decode routes through the Pallas unpack kernel.
+
+    Returns None (off — use the XLA extract path), False (native Mosaic, the
+    TPU default), or True (Pallas interpreter — CPU test parity).  Default-on
+    for TPU backends per the round-3 directive: the plane kernel is the
+    fastest unpack primitive in the repo and BP staging also drops the RLE
+    bytes from the transfer.  ``TPQ_PALLAS=0`` forces the XLA path
+    everywhere; ``TPQ_PALLAS=1`` forces the interpreter on non-TPU backends
+    (tests A/B the two paths with it).
+    """
+    env = os.environ.get("TPQ_PALLAS", "").strip()
+    if env == "0":
+        return None
+    from .pallas_kernels import pallas_available
+
+    if pallas_available():
+        return False
+    return True if env == "1" else None
+
+
+# BP payloads are staged as one host-side segment copy per bit-packed run;
+# streams shattered into very many tiny runs (adversarial or ultra-alternating
+# data) would make that copy loop the bottleneck, so they keep the XLA
+# extract path whose staging is one segment per page.
+_PALLAS_MAX_SEGS = 4096
+
+
+@functools.partial(jax.jit, static_argnames=("count",))
+def _hybrid_combine_jit(vals, run_ends, run_is_rle, run_values, bp_idx_base,
+                        n_valid, *, count):
+    """Combine Pallas-unpacked BP values with RLE runs into stream order.
+
+    ``vals`` uint32[8 * groups_pad] — BP groups unpacked from the contiguous
+    staged payload.  Every output position finds its run with one
+    searchsorted (same structure as expand_rle_hybrid), then either
+    broadcasts the RLE value or picks its BP element at
+    ``bp_idx_base[run] + pos`` — a single u32 gather instead of per-value
+    multi-byte extraction.  All index math is int32 (chunk value counts are
+    far below 2^31), so the trace is x64-agnostic.
+    """
+    pos = jnp.arange(count, dtype=jnp.int32)
+    r = jnp.searchsorted(run_ends, pos, side="right").astype(jnp.int32)
+    r = jnp.minimum(r, run_ends.shape[0] - 1)
+    bp_idx = jnp.clip(bp_idx_base[r] + pos, 0, vals.shape[0] - 1)
+    out = jnp.where(run_is_rle[r], run_values[r], vals[bp_idx])
+    return jnp.where(pos < n_valid, out, jnp.zeros((), dtype=out.dtype))
+
+
+def _plan_hybrid_pallas(stager: _RowGroupStager, pages_info, width: int,
+                        total: int, count_pad: int, interpret: bool):
+    """Plan a hybrid expansion through the Pallas BP-group kernel.
+
+    ``pages_info``: [(HybridMeta, source_buffer, page_value_count)] in stream
+    order.  Registers each bit-packed run's payload with the stager so the
+    staged buffer holds ALL BP groups contiguously (RLE headers/values never
+    ship — they live in the run table), then returns
+    ``fn(buf_dev) -> uint32[count_pad]``.  Returns None when the stream has
+    no Pallas-eligible shape (width 0, no BP groups, or a pathological run
+    count) — callers fall back to the XLA extract path.
+    """
+    if width <= 0 or width > 32:
+        return None
+    ends_l, isr_l, rv_l, bib_l = [], [], [], []
+    segs: list[tuple] = []
+    prefix = 0   # global value position
+    cumg = 0     # global BP group count
+    for meta, src, pcount in pages_info:
+        n = meta.n_runs
+        ends = meta.run_ends[:n].astype(np.int64)
+        isr = meta.run_is_rle[:n]
+        rv = meta.run_values[:n]
+        bst = meta.run_bit_starts[:n]
+        rstart = np.empty(n, np.int64)
+        if n:
+            rstart[0] = 0
+            rstart[1:] = ends[:-1]
+        # payload byte position in src coords: run_bit_starts stores
+        # pos*8 - run_start*width (see parse_hybrid_meta)
+        pay = (bst + rstart * width) >> 3
+        groups = np.where(isr, 0, -(-(ends - rstart) // 8))
+        for i in np.flatnonzero(~isr & (groups > 0)):
+            segs.append((src, int(pay[i]), int(groups[i]) * width))
+            if len(segs) > _PALLAS_MAX_SEGS:  # bail before O(runs) staging work
+                return None
+        gbase = (cumg + np.concatenate([[0], np.cumsum(groups[:-1])])
+                 if n else np.zeros(0, np.int64))
+        cumg += int(groups.sum())
+        ends_l.append(ends + prefix)
+        isr_l.append(isr)
+        rv_l.append(rv)
+        bib_l.append(np.where(isr, 0, gbase * 8 - (rstart + prefix)))
+        prefix += pcount
+    if cumg == 0:
+        return None
+    from .pallas_kernels import bp_groups_pad, unpack_bp_groups
+
+    ends64, isr, rvals, bib64 = _merge_run_tables(
+        ends_l, isr_l, rv_l, bib_l, fill_end=total
+    )
+    ends = ends64.astype(np.int32)
+    bib = bib64.astype(np.int32)
+    gpad = bp_groups_pad(cumg)
+    if stager.total + gpad * width > np.iinfo(np.int32).max:
+        # the kernel's x64-free trace addresses the staged buffer with i32;
+        # a >=2 GiB stager region can't — the XLA extract path handles it
+        return None
+    bases = stager.add_segments(segs)
+    bp_base = int(bases[0])
+    # the unpack reads gpad*width bytes from bp_base: past the real payload
+    # it sees later regions' bytes — garbage values the combine never
+    # selects (positions past `total` are masked, real positions always map
+    # into real groups)
+    stager.note_read_extent(bp_base, gpad * width)
+
+    def run(buf_dev):
+        vals = unpack_bp_groups(buf_dev, bp_base, width, gpad,
+                                interpret=interpret)
+        return _hybrid_combine_jit(
+            vals, jnp.asarray(ends), jnp.asarray(isr), jnp.asarray(rvals),
+            jnp.asarray(bib), np.int32(total), count=count_pad,
+        )
+
+    return run
+
+
 def _merge_run_tables(ends_l, rle_l, vals_l, starts_l, fill_end,
                       widths_l=None):
     """Pad per-page hybrid run lists into one bucketed chunk-global table.
@@ -417,14 +544,27 @@ class _ChunkAssembler:
             raise ParquetError(
                 "internal: level stream span missing on the batched path"
             )
+        metas = [
+            m if m is not None else parse_hybrid_meta(
+                src, width, p.num_values, pos=start, end=start + size
+            )
+            for (src, start, size), p, m in zip(streams, self.pages, metas)
+        ]
+        interp = _pallas_interpret_mode()
+        if interp is not None:
+            plan = _plan_hybrid_pallas(
+                stager,
+                [(m, src, p.num_values)
+                 for (src, _, _), p, m in zip(streams, self.pages, metas)],
+                width, slots, slots_pad, interp,
+            )
+            if plan is not None:
+                return plan
         bases = stager.add_segments(list(streams))
         ends_l, rle_l, vals_l, starts_l = [], [], [], []
         prefix = 0
-        for (src, start, size), base, p, m in zip(streams, bases, self.pages,
-                                                  metas):
-            meta = m if m is not None else parse_hybrid_meta(
-                src, width, p.num_values, pos=start, end=start + size
-            )
+        for (src, start, size), base, p, meta in zip(streams, bases,
+                                                     self.pages, metas):
             n = meta.n_runs
             ends_l.append(meta.run_ends[:n] + prefix)
             rle_l.append(meta.run_is_rle[:n])
@@ -587,7 +727,8 @@ class _ChunkAssembler:
     def _parse_dict_index_page(self, p, host_max):
         """Parse one RLE_DICTIONARY page's index stream; folds the host-side
         max (None = unknown, defer to device check).  Shared by the pure-dict
-        and mixed dict+PLAIN finish paths."""
+        and mixed dict+PLAIN finish paths.  Returns the sliced stream too so
+        callers staging payload segments reference the parsed coords."""
         stream = p.raw[p.value_pos :]
         if len(stream) < 1:
             raise ParquetError("dictionary page data truncated (missing width)")
@@ -602,7 +743,7 @@ class _ChunkAssembler:
             host_max = max(host_max, meta.max_value)
         else:
             host_max = None
-        return meta, width, host_max
+        return meta, width, stream, host_max
 
     def _check_dict_range(self, prefix, host_max):
         if prefix and self.dict_len == 0:
@@ -616,51 +757,46 @@ class _ChunkAssembler:
     def _finish_dict(self, common, stager):
         if self.dict_u8 is None and self.dict_ragged is None:
             raise ParquetError("dictionary-encoded page but no dictionary page seen")
+        # parse every page's index stream once (host_max folds the native
+        # walk's per-page maxima; None defers the range check to device)
+        parsed = []  # (page, stream, meta)
         page_widths = []
+        host_max = 0 if self.pages else None
         for p in self.pages:
-            stream = p.raw[p.value_pos :]
-            if len(stream) < 1:
-                raise ParquetError("dictionary page data truncated (missing width)")
-            if stream[0] > 32:
-                raise ParquetError(f"dictionary index width {stream[0]} invalid")
-            page_widths.append(stream[0])
+            meta, pw, stream, host_max = self._parse_dict_index_page(p, host_max)
+            parsed.append((p, stream, meta))
+            page_widths.append(pw)
         uniform = len(set(page_widths)) <= 1
         width = page_widths[0] if page_widths else 0
-        bases = self._value_segments(stager)
-        ends_l, rle_l, vals_l, starts_l, widths_l = [], [], [], [], []
-        prefix = 0
-        host_max = 0 if self.pages else None
-        for p, base, pw in zip(self.pages, bases, page_widths):
-            stream = p.raw[p.value_pos :]
-            meta = parse_hybrid_meta(stream, pw, p.defined, pos=1,
-                                     compute_max=True)
-            if p.defined == 0:
-                pass  # no indices: nothing to fold into the max
-            elif host_max is not None and meta.max_value is not None:
-                host_max = max(host_max, meta.max_value)
-            else:
-                host_max = None  # Python fallback walk: defer check to device
-            n = meta.n_runs
-            ends_l.append(meta.run_ends[:n] + prefix)
-            rle_l.append(meta.run_is_rle[:n])
-            vals_l.append(meta.run_values[:n])
-            # global bit base: page byte base within buf, re-zeroed for the
-            # global value position (see jax_kernels.expand_rle_hybrid)
-            starts_l.append(
-                meta.run_bit_starts[:n] + base * 8 - prefix * pw
+        prefix = sum(p.defined for p in self.pages)
+        interp = _pallas_interpret_mode()
+        plan = None
+        if uniform and prefix and interp is not None:
+            plan = _plan_hybrid_pallas(
+                stager, [(m, s, p.defined) for p, s, m in parsed],
+                width, prefix, _bucket_count(prefix), interp,
             )
-            widths_l.append(np.full(n, pw, dtype=np.uint32))
-            prefix += p.defined
-        ends, is_rle, rvals, starts, rwidths = _merge_run_tables(
-            ends_l, rle_l, vals_l, starts_l, fill_end=prefix, widths_l=widths_l
-        )
-        if prefix and self.dict_len == 0:
-            raise ParquetError("dictionary indices with empty dictionary")
-        if prefix and host_max is not None and host_max >= self.dict_len:
-            raise ParquetError(
-                f"dictionary index {host_max} out of range ({self.dict_len}) "
-                f"in column {'.'.join(self.leaf.path)}"
+        if plan is None:
+            bases = self._value_segments(stager)
+            ends_l, rle_l, vals_l, starts_l, widths_l = [], [], [], [], []
+            pos0 = 0
+            for (p, stream, meta), base, pw in zip(parsed, bases, page_widths):
+                n = meta.n_runs
+                ends_l.append(meta.run_ends[:n] + pos0)
+                rle_l.append(meta.run_is_rle[:n])
+                vals_l.append(meta.run_values[:n])
+                # global bit base: page byte base within buf, re-zeroed for
+                # the global value position (see jax_kernels.expand_rle_hybrid)
+                starts_l.append(
+                    meta.run_bit_starts[:n] + base * 8 - pos0 * pw
+                )
+                widths_l.append(np.full(n, pw, dtype=np.uint32))
+                pos0 += p.defined
+            ends, is_rle, rvals, starts, rwidths = _merge_run_tables(
+                ends_l, rle_l, vals_l, starts_l, fill_end=prefix,
+                widths_l=widths_l,
             )
+        self._check_dict_range(prefix, host_max)
         dict_u8 = self.dict_u8
         dict_base = dict_kp = dict_itemsize = None
         if dict_u8 is not None:
@@ -676,7 +812,9 @@ class _ChunkAssembler:
                                    reserve=dict_kp * dict_itemsize)
 
         def run(buf_dev):
-            if uniform:
+            if plan is not None:
+                idx = plan(buf_dev)
+            elif uniform:
                 idx = _hybrid_jit(
                     buf_dev, jnp.asarray(ends), jnp.asarray(is_rle),
                     jnp.asarray(rvals), jnp.asarray(starts), np.int64(prefix),
@@ -806,7 +944,7 @@ class _ChunkAssembler:
         prefix = 0
         host_max = 0
         for p, base in zip(dict_pages, bases[:n_dict]):
-            meta, width, host_max = self._parse_dict_index_page(p, host_max)
+            meta, width, _, host_max = self._parse_dict_index_page(p, host_max)
             dict_calls.append((
                 meta.run_ends, meta.run_is_rle, meta.run_values,
                 meta.run_bit_starts + int(base) * 8, int(width), p.defined,

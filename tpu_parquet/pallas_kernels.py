@@ -31,7 +31,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["unpack_bits_pallas", "build_planes", "pallas_available"]
+__all__ = ["unpack_bits_pallas", "unpack_bp_groups", "bp_groups_pad",
+           "build_planes", "pallas_available"]
 
 _GROUPS_PER_TILE = 1024  # 8192 values per grid step; (1024,) = one 8x128 tile
 
@@ -74,21 +75,29 @@ def _unpack_kernel(width: int, in_ref, out_ref):
         out_ref[:, j] = val & mask
 
 
-@functools.partial(jax.jit, static_argnames=("width", "count", "interpret"))
-def _unpack_pallas_jit(planes, *, width, count, interpret):
+def _unpack_call(planes, width: int, groups: int, interpret: bool):
+    """The one pallas_call site: (width, groups) byte planes -> u32[groups, 8].
+
+    The BlockSpec layout here IS the Mosaic miscompile workaround documented
+    on _unpack_kernel (leading-dim plane indexing, never strided u8 column
+    slices) — both jit entry points share it so they can't drift apart.
+    """
     from jax.experimental import pallas as pl
 
-    groups = planes.shape[1]
-    grid = groups // _GROUPS_PER_TILE
-    out = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_unpack_kernel, width),
         out_shape=jax.ShapeDtypeStruct((groups, 8), jnp.uint32),
-        grid=(grid,),
+        grid=(groups // _GROUPS_PER_TILE,),
         in_specs=[pl.BlockSpec((width, _GROUPS_PER_TILE), lambda t: (0, t))],
         out_specs=pl.BlockSpec((_GROUPS_PER_TILE, 8), lambda t: (t, 0)),
         interpret=interpret,
     )(planes)
-    return out.reshape(-1)[:count]
+
+
+@functools.partial(jax.jit, static_argnames=("width", "count", "interpret"))
+def _unpack_pallas_jit(planes, *, width, count, interpret):
+    groups = planes.shape[1]
+    return _unpack_call(planes, width, groups, interpret).reshape(-1)[:count]
 
 
 def build_planes(buf, width: int, count: int) -> jax.Array:
@@ -109,6 +118,51 @@ def build_planes(buf, width: int, count: int) -> jax.Array:
     padded = np.zeros(need, dtype=np.uint8)
     padded[: min(len(host), need)] = host[:need]
     return jnp.asarray(np.ascontiguousarray(padded.reshape(gpad, width).T))
+
+
+def bp_groups_pad(groups: int) -> int:
+    """Pad a group count to a whole number of kernel tiles (bucketed first so
+    the (width, groups_pad) executable set stays bounded across chunks)."""
+    from .jax_decode import _bucket_count
+
+    b = _bucket_count(max(groups, 1))
+    return -(-b // _GROUPS_PER_TILE) * _GROUPS_PER_TILE
+
+
+@functools.partial(
+    jax.jit, static_argnames=("width", "groups_pad", "interpret")
+)
+def _bp_groups_jit(buf, bp_base, *, width, groups_pad, interpret):
+    bp = jax.lax.dynamic_slice(buf, (bp_base,), (groups_pad * width,))
+    planes = bp.reshape(groups_pad, width).T
+    return _unpack_call(planes, width, groups_pad, interpret).reshape(-1)
+
+
+def unpack_bp_groups(buf_dev, bp_base: int, width: int, groups_pad: int,
+                     interpret: bool = False):
+    """Unpack ``groups_pad`` 8-value groups of ``width``-bit values starting
+    at byte ``bp_base`` of the staged device buffer.
+
+    The production entry point the batched reader routes hybrid bit-packed
+    runs through: BP payloads are staged *contiguously* (group-aligned, a
+    structural property of the RLE/BP hybrid format — every BP run is whole
+    8-value groups starting on a byte boundary), so the unpack is the exact
+    fixed-width affine case this kernel exists for — no gathers at all.
+    Returns uint32[groups_pad * 8]; bytes past the real payload decode to
+    garbage values that callers never select (combine masks by run table).
+
+    ``groups_pad`` must come from :func:`bp_groups_pad`.  Traced with x64
+    disabled regardless of ambient context (the decode paths run under
+    scoped_x64, but Mosaic refuses i64 grid index maps — see the NOTE on
+    :func:`unpack_bits_pallas`); the uint32 result is x64-agnostic.
+    """
+    if groups_pad % _GROUPS_PER_TILE:
+        raise ValueError(f"groups_pad {groups_pad} not a multiple of "
+                         f"{_GROUPS_PER_TILE}")
+    with jax.enable_x64(False):
+        return _bp_groups_jit(buf_dev, np.int32(bp_base), width=width,
+                              groups_pad=groups_pad,
+                              interpret=bool(interpret))
 
 
 def unpack_bits_pallas(buf, width: int, count: int, interpret: bool | None = None):
