@@ -192,13 +192,29 @@ class _RowGroupStager:
     streams, level arrays, byte-array heaps); ``stage()`` ships ONE buffer and
     each chunk's kernels address into it by base offset — the transfer
     granularity and the executable granularity are decoupled.
+
+    With an ``executor`` (the reader's staging worker), registration also
+    *streams*: every time the arena grows past a 16 MiB strip boundary the
+    completed strip is copied and device_put on the worker while the main
+    thread is still decompressing the row group's remaining chunks, and
+    ``stage()`` concatenates the strips on device.  That overlaps host parse
+    with transfer *within* a row group — the single-large-row-group case
+    (one 128 MB group per file) that the cross-row-group pipeline cannot
+    overlap at all.  Row groups smaller than one strip take the original
+    single-buffer path byte for byte, so small-file executable shapes (and
+    the warm compile cache) are untouched.
     """
 
-    def __init__(self):
+    STRIP = 16 << 20
+
+    def __init__(self, executor=None):
         # ("arr", u8, base, nbytes) | ("segs", segments, base, nbytes)
         self._parts: list[tuple] = []
         self.total = 0
         self._max_read_end = 0
+        self._ex = executor
+        self._strip_futs: list = []
+        self._flushed = 0  # arena bytes handed to strip jobs (STRIP multiple)
 
     def _reserve(self, nbytes: int, reserve: int | None) -> int:
         base = self.total
@@ -216,7 +232,51 @@ class _RowGroupStager:
         u8 = arr.reshape(-1).view(np.uint8) if arr.dtype != np.uint8 else arr.reshape(-1)
         base = self._reserve(u8.nbytes, reserve)
         self._parts.append(("arr", u8, base, u8.nbytes))
+        self._flush_ready()
         return base
+
+    def _copy_range(self, buf: np.ndarray, lo: int, hi: int) -> None:
+        """Zero-fill ``buf`` and copy every registered byte in [lo, hi) into
+        it.  Parts are appended in ascending base order and never mutated, so
+        a worker thread may scan the list while the main thread appends."""
+        buf[:] = 0
+        for kind, payload, base, nbytes in self._parts:
+            if base >= hi:
+                break
+            if base + nbytes <= lo:
+                continue
+            if kind == "arr":
+                s = max(lo, base)
+                e = min(hi, base + nbytes)
+                buf[s - lo : e - lo] = payload[s - base : e - base]
+            else:
+                off = base
+                for raw, start, size in payload:
+                    if off >= hi:
+                        break
+                    if off + size > lo:
+                        s = max(lo, off)
+                        e = min(hi, off + size)
+                        buf[s - lo : e - lo] = np.frombuffer(
+                            raw, np.uint8, e - s, start + (s - off)
+                        )
+                    off += size
+
+    def _flush_ready(self) -> None:
+        """Hand every newly completed strip to the worker (copy + device_put
+        run there, overlapping the main thread's decompress/parse)."""
+        if self._ex is None:
+            return
+        while self.total - self._flushed >= self.STRIP:
+            lo = self._flushed
+            self._flushed += self.STRIP
+
+            def job(lo=lo, hi=self._flushed):
+                buf = np.empty(self.STRIP, dtype=np.uint8)
+                self._copy_range(buf, lo, hi)
+                return jnp.asarray(buf)
+
+            self._strip_futs.append(self._ex.submit(job))
 
     def add_segments(self, segments: list[tuple[bytes, int, int]]) -> np.ndarray:
         """Register byte slices (buf, offset, size) laid back to back.
@@ -232,6 +292,7 @@ class _RowGroupStager:
             nbytes += size
         base = self._reserve(nbytes, None)
         self._parts.append(("segs", segments, base, nbytes))
+        self._flush_ready()
         return bases + base
 
     def note_read_extent(self, base: int, nbytes: int) -> None:
@@ -244,21 +305,33 @@ class _RowGroupStager:
 
     def stage(self) -> jax.Array:
         need = max(self.total, self._max_read_end)
-        buf = np.empty(_bucket_bytes(need + _SLACK, 64), dtype=np.uint8)
-        pos = 0
-        for kind, payload, base, nbytes in self._parts:
-            if base > pos:
-                buf[pos:base] = 0
-            if kind == "arr":
-                buf[base : base + nbytes] = payload
-            else:
-                off = base
-                for raw, start, size in payload:
-                    buf[off : off + size] = np.frombuffer(raw, np.uint8, size, start)
-                    off += size
-            pos = base + nbytes
-        buf[pos:] = 0
-        return jnp.asarray(buf)
+        if not self._strip_futs:
+            # single-transfer path (row group under one strip, or no worker)
+            buf = np.empty(_bucket_bytes(need + _SLACK, 64), dtype=np.uint8)
+            pos = 0
+            for kind, payload, base, nbytes in self._parts:
+                if base > pos:
+                    buf[pos:base] = 0
+                if kind == "arr":
+                    buf[base : base + nbytes] = payload
+                else:
+                    off = base
+                    for raw, start, size in payload:
+                        buf[off : off + size] = np.frombuffer(raw, np.uint8,
+                                                              size, start)
+                        off += size
+                pos = base + nbytes
+            buf[pos:] = 0
+            return jnp.asarray(buf)
+        # streaming path: strips are already in flight; copy+ship the tail,
+        # then assemble on device (HBM-bandwidth concat, one executable per
+        # (strip count, tail bucket) shape set)
+        tail_len = _bucket_bytes(need + _SLACK - self._flushed, 64)
+        tail = np.empty(tail_len, dtype=np.uint8)
+        self._copy_range(tail, self._flushed, self._flushed + tail_len)
+        parts = [f.result() for f in self._strip_futs] + [jnp.asarray(tail)]
+        self._strip_futs.clear()  # release strip buffers once concat owns them
+        return _concat_jit(parts)
 
 
 def _pallas_interpret_mode():
@@ -354,7 +427,9 @@ def _plan_hybrid_pallas(stager: _RowGroupStager, pages_info, width: int,
         rv_l.append(rv)
         bib_l.append(np.where(isr, 0, gbase * 8 - (rstart + prefix)))
         prefix += pcount
-    if cumg == 0:
+    if cumg == 0 or total > np.iinfo(np.int32).max:
+        # i32 combine math also covers the value positions; >=2^31-value
+        # chunks keep the XLA path (int64 throughout)
         return None
     from .pallas_kernels import bp_groups_pad, unpack_bp_groups
 
@@ -1156,6 +1231,18 @@ class DeviceFileReader:
     groups as the work unit, nothing blocks until ``finalize()`` (called by
     ``read_row_group``; pass ``finalize=False`` to pipeline several row groups
     and call it once).
+
+    Zero-decode-work policy: a PLAIN fixed-width chunk has no device compute
+    — decoding it here is a pure host→HBM transfer, so against a host decode
+    + async upload pipeline the information-theoretic ceiling on a
+    transfer-bound link is ~1× (both paths move the same bytes; encoded
+    columns — dict/RLE/delta — are where the device path wins by shipping
+    FEWER bytes and expanding on device).  ``iter_row_groups`` reaches that
+    ceiling by streaming staged strips during host decompress (see
+    _RowGroupStager) rather than serializing parse→transfer; callers that
+    want host-resident arrays for such columns should read them with the
+    host FileReader (project them out of the device reader) and skip the
+    transfer entirely.
     """
 
     def __init__(self, source, columns=None, validate_crc: bool = False,
@@ -1195,9 +1282,13 @@ class DeviceFileReader:
         return self._host.num_row_groups
 
     @scoped_x64
-    def _prepare_row_group(self, index: int):
+    def _prepare_row_group(self, index: int, executor=None):
         """Host phase: decompress + parse every chunk of the row group,
         registering all byte regions with ONE stager.
+
+        With ``executor`` (the iter_row_groups staging worker) the stager
+        streams completed 16 MiB strips to the device while this thread is
+        still decompressing later chunks — see _RowGroupStager.
 
         No device calls on the common paths (plain/bool/bytes/dict/delta);
         the _finish_host fallback (mixed encodings, FLBA, INT96, delta byte
@@ -1214,7 +1305,7 @@ class DeviceFileReader:
         out: dict[str, DeviceColumnData] = {}
         f = self._host._f
         self.alloc.reset()
-        stager = _RowGroupStager()
+        stager = _RowGroupStager(executor)
         plans: list[tuple[str, object]] = []
         for chunk in rg.columns or []:
             md = chunk.meta_data
@@ -1448,7 +1539,7 @@ class DeviceFileReader:
         with trace, ThreadPoolExecutor(1) as ex:
             prev = None  # (prepared, future staging the device buffer)
             for i in indices:
-                prepared = self._prepare_row_group(i)
+                prepared = self._prepare_row_group(i, executor=ex)
                 fut = ex.submit(timed_stage, prepared[2]) if prepared[1] else None
                 if prev is not None:
                     p_prepared, p_fut = prev
