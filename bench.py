@@ -294,6 +294,29 @@ def bench_device(path, rows):
     return best
 
 
+def bench_pyarrow(path, rows):
+    """Independent cross-check denominator: pyarrow.parquet.read_table on the
+    identical files (Apache Arrow C++, multi-threaded).  The self-measured
+    NumPy host decoder stays the primary vs_baseline denominator (it mirrors
+    the reference's single-threaded decode loop); this number anchors it
+    against code this repo didn't write."""
+    import pyarrow.parquet as pq
+
+    def run():
+        for p in _bench_paths(path):
+            pq.read_table(p)
+
+    run()
+    best = float("inf")
+    for i in range(max(REPS - 1, 1)):
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        log(f"  pyarrow rep {i}: {dt:.3f}s ({rows/dt/1e6:.2f} M rows/s)")
+        best = min(best, dt)
+    return best
+
+
 def bench_host(path, rows, upload=False):
     """Host NumPy decode; with ``upload``, decoded arrays are also staged to
     the device — the apples-to-apples pipeline baseline, since the device
@@ -456,6 +479,12 @@ def main():
         except Exception as e:  # noqa: BLE001 — keep the paid-for device
             # numbers even when the host baseline dies
             log(f"config {key} host baseline FAILED: {e!r}")
+        try:
+            pa_t = bench_pyarrow(path, rows)
+            r["pyarrow_rows_per_sec"] = round(rows / pa_t, 1)
+            r["device_vs_pyarrow"] = round(pa_t / dev_t, 3)
+        except Exception as e:  # noqa: BLE001 — independent denominator only
+            log(f"config {key} pyarrow baseline FAILED: {e!r}")
         if not over_budget():
             # both paths ending device-resident (the training-pipeline view);
             # skippable under time pressure — the primary metrics above are
@@ -467,8 +496,10 @@ def main():
                 log(f"config {key} upload baseline FAILED: {e!r}")
         results[name] = r
         pipe = r.get("device_vs_host_pipeline")
+        vs = r.get("device_vs_host")
         log(f"config {key} {name}: device {r['device_rows_per_sec']/1e6:.1f} M rows/s "
-            f"({r['device_mb_per_sec']:.0f} MB/s), {r['device_vs_host']:.1f}x host"
+            f"({r['device_mb_per_sec']:.0f} MB/s)"
+            + (f", {vs:.1f}x host" if vs is not None else "")
             + (f", {pipe:.1f}x host+upload pipeline" if pipe is not None else ""))
         if name == "lineitem16":
             headline = r

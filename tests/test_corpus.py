@@ -1,0 +1,87 @@
+"""Env-gated apache/parquet-testing corpus runner.
+
+The reference's ground truth is the parquet-testing sample-file corpus, gated
+on the files being present (/root/reference/parquet_test.go:12-15 skips each
+file with os.Open + SkipNow when absent).  This image has no network, so the
+same gating applies here: point ``PARQUET_TESTING_ROOT`` at a checkout of
+https://github.com/apache/parquet-testing and every readable ``data/*.parquet``
+file is decoded by this library and value-compared against pyarrow row for
+row.  Offline the whole module skips cleanly — the loader existing (and
+running in any corpus-equipped CI) is the point.
+
+Unlike the reference's fixed 20-file list, the runner globs the corpus so new
+upstream sample files are picked up automatically.  Files exercising features
+out of scope are skipped explicitly with the feature named:
+
+- encrypted files (``*.parquet.encrypted``, AES footers): encryption metadata
+  parses (format/__init__.py structs) but decryption is unsupported, same as
+  the reference (parquet.go has no decryptor).
+- codecs outside {UNCOMPRESSED, SNAPPY, GZIP, ZSTD} (LZ4/BROTLI/LZO): the
+  registry raises a codec error; register_codec() is the documented hook.
+- pyarrow-unreadable files (malformed/*, corrupt samples): no oracle values.
+"""
+
+import glob
+import os
+
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+pq = pytest.importorskip("pyarrow.parquet")
+
+from tpu_parquet.errors import ParquetError
+from tpu_parquet.reader import FileReader
+
+from test_conformance import norm, roundtrip_rows
+
+ROOT = os.environ.get("PARQUET_TESTING_ROOT")
+
+pytestmark = pytest.mark.skipif(
+    not (ROOT and os.path.isdir(os.path.join(ROOT or "", "data"))),
+    reason="PARQUET_TESTING_ROOT not set (apache/parquet-testing checkout)",
+)
+
+# substrings of codec/feature error messages that mark a file as exercising
+# an out-of-scope feature rather than a reader bug.  Deliberately narrow:
+# only codecs outside the supported set and encryption qualify — an error
+# mentioning a *supported* codec (e.g. a snappy corruption) must FAIL.
+_UNSUPPORTED_MARKERS = ("lz4", "brotli", "lzo", "encrypt")
+
+
+def _corpus_files():
+    if not ROOT:
+        return []
+    return sorted(glob.glob(os.path.join(ROOT, "data", "*.parquet")))
+
+
+@pytest.mark.parametrize("path", _corpus_files(),
+                         ids=lambda p: os.path.basename(p))
+def test_corpus_file_matches_pyarrow(path):
+    try:
+        expected = pq.read_table(path).to_pylist()
+    except Exception as e:  # noqa: BLE001 — no oracle, nothing to compare
+        pytest.skip(f"pyarrow cannot read {os.path.basename(path)}: {e!r}")
+    try:
+        got = roundtrip_rows(path)
+    except ParquetError as e:
+        if any(m.lower() in str(e).lower() for m in _UNSUPPORTED_MARKERS):
+            pytest.skip(f"out-of-scope feature: {e}")
+        raise
+    assert len(got) == len(expected), (len(got), len(expected))
+    for i, (g, e) in enumerate(zip(got, expected)):
+        assert norm(g) == norm(e), f"row {i}: {g!r} != {e!r}"
+
+
+@pytest.mark.parametrize("path", _corpus_files(),
+                         ids=lambda p: os.path.basename(p))
+def test_corpus_file_metadata_parses(path):
+    """Footer + schema parse must never crash on any corpus file (even ones
+    whose data pages use out-of-scope codecs)."""
+    try:
+        with FileReader(path) as r:
+            assert r.metadata.num_rows is not None
+            assert r.schema.root is not None
+    except ParquetError as e:
+        if any(m.lower() in str(e).lower() for m in _UNSUPPORTED_MARKERS):
+            pytest.skip(f"out-of-scope feature: {e}")
+        raise

@@ -317,3 +317,37 @@ def test_parse_reference_sample_schemas():
         assert [l.path for l in s.leaves] == [l.path for l in s2.leaves]
         count += 1
     assert count >= 7
+
+
+# ---------------------------------------------------------------------------
+# File fixtures: the reference ships 7 sample .schema files
+# (parquetschema/schema-files/test{1..7}.schema, loaded by
+# parquetschema/schema_parser_test.go TestParseSchemaFiles); the same grammar
+# corners live in tests/schema-files/ here -- field-id suffixes, MAP with
+# MAP_KEY_VALUE, LIST-of-LIST nesting, bare MAP key_value, TIMESTAMP(NANOS),
+# DATE, UUID over fixed_len_byte_array(16).
+# ---------------------------------------------------------------------------
+
+import glob as _glob
+import os as _os
+
+_SCHEMA_DIR = _os.path.join(_os.path.dirname(__file__), "schema-files")
+
+
+@pytest.mark.parametrize(
+    "path", sorted(_glob.glob(_os.path.join(_SCHEMA_DIR, "*.schema"))),
+    ids=lambda p: _os.path.basename(p),
+)
+def test_schema_file_fixture_roundtrip(path):
+    """Each fixture parses, prints, and re-parses to the same tree."""
+    text = open(path).read()
+    schema = parse_schema_definition(text)
+    printed = schema_to_string(schema)
+    again = parse_schema_definition(printed)
+    assert schema_to_string(again) == printed
+    # strict validation accepts every fixture (they are all spec-legal)
+    validate_strict(schema)
+
+
+def test_schema_file_fixtures_present():
+    assert len(_glob.glob(_os.path.join(_SCHEMA_DIR, "*.schema"))) == 7

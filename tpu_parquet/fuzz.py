@@ -170,6 +170,86 @@ def fuzz_schema_dsl(data: bytes) -> None:
         pass
 
 
+def _force_cpu_jax() -> None:
+    """Pin JAX to CPU before the first backend query (the axon site hook
+    pins the platform via env early, so the config update is load-bearing —
+    same pattern as tests/conftest.py).  Fuzzing must never burn TPU time."""
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — already initialized on CPU
+        pass
+
+
+def fuzz_device_reader(data: bytes) -> None:
+    """Differential: batched device decoder vs host reader on the same bytes.
+
+    The staging/bucketing/fused-dispatch logic (device_reader.py) is attack
+    surface none of the host targets touch.  Contract: the two paths must
+    agree on acceptance, and for accepted files every column's values and
+    def levels must match bit for bit.  Runs on the CPU backend (the XLA
+    decode path; set TPQ_PALLAS=1 to fuzz the Pallas interpreter route).
+    """
+    _force_cpu_jax()
+    from .device_reader import DeviceFileReader
+    from .reader import FileReader
+
+    try:
+        host_cols: dict = {}
+        with FileReader(io.BytesIO(data)) as r:
+            for rg in r.iter_row_groups():
+                for k, v in rg.items():
+                    host_cols.setdefault(k, []).append(v)
+        host_err = None
+    except ParquetError as e:
+        host_err = e
+    try:
+        dev_cols: dict = {}
+        with DeviceFileReader(io.BytesIO(data)) as r:
+            for rg in r.iter_row_groups():
+                for k, v in rg.items():
+                    dev_cols.setdefault(k, []).append(v)
+        dev_err = None
+    except ParquetError as e:
+        dev_err = e
+    if (host_err is None) != (dev_err is None):
+        h = repr(host_err) if host_err else "accept"
+        d = repr(dev_err) if dev_err else "accept"
+        raise AssertionError(f"host/device acceptance mismatch: host={h} device={d}")
+    if host_err is not None:
+        return
+    if set(host_cols) != set(dev_cols):
+        raise AssertionError(
+            f"column sets differ: {sorted(host_cols)} vs {sorted(dev_cols)}"
+        )
+    from .column import ByteArrayData
+
+    for k, hlist in host_cols.items():
+        dlist = dev_cols[k]
+        if len(hlist) != len(dlist):
+            raise AssertionError(
+                f"row group count differs in {k}: {len(hlist)} vs {len(dlist)}"
+            )
+        for h, d in zip(hlist, dlist):
+            dh = d.to_host()
+            hv = h.values
+            if isinstance(hv, ByteArrayData):
+                if not (np.array_equal(hv.offsets, dh.offsets)
+                        and np.array_equal(hv.heap, dh.heap)):
+                    raise AssertionError(f"byte-array values differ in {k}")
+            elif not np.array_equal(np.asarray(hv), np.asarray(dh)):
+                raise AssertionError(f"values differ in {k}")
+            dd, dr = d.levels_to_host()
+            for name, hl, dl in (("def", h.def_levels, dd),
+                                 ("rep", h.rep_levels, dr)):
+                if (hl is None) != (dl is None) or (
+                    hl is not None and not np.array_equal(hl, dl)
+                ):
+                    raise AssertionError(f"{name} levels differ in {k}")
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -177,6 +257,7 @@ TARGETS = {
     "delta": fuzz_delta,
     "plain": fuzz_plain,
     "schema_dsl": fuzz_schema_dsl,
+    "device_reader": fuzz_device_reader,
 }
 
 
@@ -187,7 +268,7 @@ TARGETS = {
 def _seed_inputs(target: str) -> list[bytes]:
     """Valid inputs for the target, built in-process (corpus seeds)."""
     rng = np.random.default_rng(0)
-    if target in ("file_reader", "thrift"):
+    if target in ("file_reader", "thrift", "device_reader"):
         import io as _io
 
         from .format import (

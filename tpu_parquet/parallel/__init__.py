@@ -503,18 +503,25 @@ def global_column_array(
     length is per*n — consumers mask with valid_rows.
     """
     total = int(reader.metadata.num_rows)
-    devs = list(mesh.devices.flat)
-    n = len(devs)
+    # shard the work list along the NAMED axis only; other mesh axes (e.g. a
+    # model axis on a 2-D mesh) see the same rows replicated — each span is
+    # decoded once and placed on every device whose ``axis`` coordinate
+    # matches, so the function serves any mesh rank, not just 1-D
+    n = int(mesh.shape[axis])
+    ax = mesh.axis_names.index(axis)
     spans = shard_row_ranges(total, n)
     per = spans[0][1] - spans[0][0] if total else 0
     sharding = NamedSharding(mesh, P(axis))
     dtype = column_span_dtype(reader, column)
     if not per:
         return jnp.zeros((0,), dtype=dtype), 0
+    decoded = [
+        _pad_span(decode_row_span(reader, column, lo, hi), per, dtype)
+        for lo, hi in spans
+    ]
     pieces = [
-        jax.device_put(_pad_span(decode_row_span(reader, column, lo, hi),
-                                 per, dtype), dev)
-        for (lo, hi), dev in zip(spans, devs)
+        jax.device_put(decoded[idx[ax]], dev)
+        for idx, dev in np.ndenumerate(mesh.devices)
     ]
     global_shape = (per * n,)
     arr = jax.make_array_from_single_device_arrays(global_shape, sharding, pieces)
