@@ -505,3 +505,69 @@ def test_flba_and_int96_fused(tmp_path):
     np.testing.assert_array_equal(gu.offsets, h["u"].values.offsets)
     np.testing.assert_array_equal(gu.heap, h["u"].values.heap)
     np.testing.assert_array_equal(d["t"].to_host(), h["t"].values)
+
+
+def test_rle_dict_index_out_of_range_rejected_when_width_covered(tmp_path):
+    """RLE run values are raw unmasked bytes, so a dictionary whose length
+    covers the full bit-width range (dict_len >= 2^width) does NOT make every
+    encodable index valid: an RLE value byte patched out of range must be
+    rejected by the host AND the batched device reader alike (the covered
+    fast path may skip only the bit-packed O(values) scan)."""
+    import jax
+    import pytest
+
+    from tpu_parquet.chunk_decode import validate_chunk_meta, walk_pages
+    from tpu_parquet.column import ByteArrayData, ColumnData
+    from tpu_parquet.errors import ParquetError
+    from tpu_parquet.format import (
+        CompressionCodec, FieldRepetitionType as FRT, PageType, Type,
+    )
+    from tpu_parquet.jax_decode import parse_data_page
+    from tpu_parquet.reader import FileReader
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.writer import FileWriter
+
+    path = str(tmp_path / "oob.parquet")
+    schema = build_schema([data_column("s", Type.BYTE_ARRAY, FRT.REQUIRED)])
+    # 2-entry dictionary (width=1, covered), long repeated tail -> RLE run
+    vals = [b"aa"] * 4 + [b"bb"] * 200
+    heap = np.frombuffer(b"".join(vals), np.uint8).copy()
+    offs = np.cumsum([0] + [len(v) for v in vals]).astype(np.int64)
+    with FileWriter(path, schema, codec=CompressionCodec.UNCOMPRESSED,
+                    use_dictionary=True) as w:
+        w.write_columns({"s": ColumnData(values=ByteArrayData(
+            offsets=offs, heap=heap))})
+
+    # locate the index stream's final RLE run value byte and patch it OOB
+    with FileReader(path) as r:
+        leaf = next(iter(r.schema.selected_leaves()))
+        chunk = r.metadata.row_groups[0].columns[0]
+        md, off = validate_chunk_meta(chunk, leaf)
+        r._f.seek(off)
+        buf = r._f.read(md.total_compressed_size)
+        patched = None
+        for ps in walk_pages(buf, md.num_values):
+            if ps.header.type != PageType.DATA_PAGE:
+                continue
+            p = parse_data_page(ps, buf, md.codec, leaf)
+            stream_file_pos = off + ps.payload_start + p.value_pos
+            assert buf[ps.payload_start + p.value_pos] == 1  # width byte
+            patched = stream_file_pos + len(buf) - ps.payload_start \
+                - p.value_pos - 1  # last byte of the page = RLE value byte
+        assert patched is not None
+    data = bytearray(open(path, "rb").read())
+    assert data[patched] in (0, 1)
+    data[patched] = 3  # out of range for dict_len == 2
+    open(path, "wb").write(bytes(data))
+
+    with pytest.raises(ParquetError):
+        with FileReader(path) as r:
+            for _ in r.iter_row_groups():
+                pass
+    from tpu_parquet.device_reader import DeviceFileReader
+
+    with pytest.raises(ParquetError):
+        with DeviceFileReader(path) as r:
+            for _ in r.iter_row_groups():
+                pass
+            r.finalize()

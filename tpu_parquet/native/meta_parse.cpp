@@ -191,15 +191,28 @@ i64 tpq_hybrid_meta(const u8 *buf, i64 n, i64 pos, i64 width, i64 count,
             if (scan_bp && width > 0) {
                 // scan the run's real extent (padding past `take` is ignored,
                 // matching the device expansion's idx[:count] semantics)
+                // widths <= 56 guarantee width + bit-shift <= 63, so one
+                // unaligned little-endian u64 load covers any value's field:
+                // ~4x the byte-at-a-time walk (this scan is the hottest host
+                // cost on dictionary/null-heavy files).  The last 8 bytes of
+                // the buffer and widths > 56 take the byte-assembly path.
+                i64 safe_end = n - 8;
                 for (i64 k = 0; k < take; k++) {
                     i64 bit = pos * 8 + k * width;
                     i64 byte0 = bit >> 3;
                     int sh = (int)(bit & 7);
-                    u64 acc = 0;
-                    i64 nb = (width + sh + 7) / 8;
-                    for (i64 b = 0; b < nb && byte0 + b < n; b++)
-                        acc |= (u64)buf[byte0 + b] << (8 * b);
-                    u64 v = (acc >> sh) & mask;
+                    u64 v;
+                    if (width <= 56 && byte0 <= safe_end) {
+                        u64 acc;
+                        __builtin_memcpy(&acc, buf + byte0, 8);
+                        v = (acc >> sh) & mask;
+                    } else {
+                        u64 acc = 0;
+                        i64 nb = (width + sh + 7) / 8;
+                        for (i64 b = 0; b < nb && byte0 + b < n; b++)
+                            acc |= (u64)buf[byte0 + b] << (8 * b);
+                        v = (acc >> sh) & mask;
+                    }
                     if (v > max_val) max_val = v;
                     if (v == eq_target) eq_count++;
                 }
@@ -223,8 +236,14 @@ i64 tpq_hybrid_meta(const u8 *buf, i64 n, i64 pos, i64 width, i64 count,
             kinds[n_runs] = 1;
             vals[n_runs] = (u32)v;
             starts[n_runs] = 0;
-            if (want_max && (v & mask) > max_val) max_val = v & mask;
-            if (want_eq && (v & mask) == eq_target) eq_count += repeats;
+            // RLE run values are NOT masked to the stream width: the Python
+            // decoder, the run table (vals above), and the device expansion
+            // all broadcast the raw little-endian bytes, so max/eq must see
+            // the same value or a malformed file's defined-count diverges
+            // between the host and batched-device paths (found by the
+            // device_reader differential fuzzer).
+            if (want_max && v > max_val) max_val = v;
+            if (want_eq && v == eq_target) eq_count += repeats;
             total += repeats;
         }
         ends[n_runs] = total;
