@@ -141,6 +141,54 @@ def _delta_pages_jit(buf, firsts, starts, widths, mins, page_starts, *,
     return vals[p, within]
 
 
+@functools.partial(jax.jit, static_argnames=("count_pad", "heap_pad"))
+def _plain_bytes_pages_jit(buf, lens_base, page_byte_base, page_val_start,
+                           *, count_pad, heap_pad):
+    """PLAIN BYTE_ARRAY decode on device: lengths → offsets → heap compaction.
+
+    The host walks ONLY the u32 length prefixes (native
+    tpq_bytearray_lengths — O(values), no copies) and stages the RAW value
+    streams plus the lengths; this kernel does everything that touches the
+    value bytes (SURVEY §7.4.2's "sequential" length walk is sequential only
+    in *finding* the lengths — once they are known, offsets are one cumsum
+    and the heap compaction is data-parallel):
+
+      offsets  = cumsum(lens)                              (int64[count+1])
+      value r of heap byte j via a scatter-of-run-ends + cumsum
+      src[j]   = page_base[p] + within-page data offset + 4*(prefixes so far)
+
+    ``lens_base`` points at the staged uint32 lengths (zero-filled past the
+    real count, so pad values are empty).  ``page_val_start`` int32[P+1]
+    cumulative value counts; ``page_byte_base`` int64[P] staged byte base of
+    each page's raw stream.  Returns (offsets int64[count_pad+1],
+    heap uint8[heap_pad]) — callers slice by the real counts.
+    """
+    lens_raw = jax.lax.dynamic_slice(buf, (lens_base,), (count_pad * 4,))
+    lens = jax.lax.bitcast_convert_type(
+        lens_raw.reshape(count_pad, 4), jnp.uint32
+    ).reshape(count_pad)
+    offsets = jnp.concatenate([
+        jnp.zeros(1, dtype=jnp.int64),
+        jnp.cumsum(lens.astype(jnp.int64)),
+    ])
+    ends = jnp.clip(offsets[1:], 0, heap_pad)
+    marks = jnp.zeros(heap_pad + 1, dtype=jnp.int32).at[ends].add(
+        jnp.ones(count_pad, dtype=jnp.int32)
+    )
+    r = jnp.cumsum(marks[:heap_pad])  # value index of each heap byte
+    r = jnp.clip(r, 0, count_pad - 1)
+    p = jnp.searchsorted(page_val_start, r, side="right").astype(jnp.int32) - 1
+    p = jnp.clip(p, 0, page_byte_base.shape[0] - 1)
+    pvs = page_val_start[p].astype(jnp.int64)
+    j = jnp.arange(heap_pad, dtype=jnp.int64)
+    src = (page_byte_base[p]
+           + (offsets[r] - offsets[pvs])        # data bytes before r in page
+           + 4 * (r.astype(jnp.int64) - pvs + 1)  # prefixes up to & incl. r
+           + (j - offsets[r]))                  # byte within value r
+    heap = buf[jnp.clip(src, 0, buf.shape[0] - 1)]
+    return offsets, heap
+
+
 @functools.partial(jax.jit, static_argnames=("count",))
 def _bool_pages_jit(buf, page_byte_base, page_val_start, *, count):
     """PLAIN booleans across pages: bit position restarts at each page base."""
@@ -395,49 +443,56 @@ def _plan_hybrid_pallas(stager: _RowGroupStager, pages_info, width: int,
     no Pallas-eligible shape (width 0, no BP groups, or a pathological run
     count) — callers fall back to the XLA extract path.
     """
-    if width <= 0 or width > 32:
+    if width <= 0 or width > 32 or total > np.iinfo(np.int32).max:
+        # i32 combine math covers byte bases AND value positions; >=2^31
+        # value chunks keep the XLA path (int64 throughout)
         return None
-    ends_l, isr_l, rv_l, bib_l = [], [], [], []
-    segs: list[tuple] = []
-    prefix = 0   # global value position
-    cumg = 0     # global BP group count
-    for meta, src, pcount in pages_info:
-        n = meta.n_runs
-        ends = meta.run_ends[:n].astype(np.int64)
-        isr = meta.run_is_rle[:n]
-        rv = meta.run_values[:n]
-        bst = meta.run_bit_starts[:n]
-        rstart = np.empty(n, np.int64)
-        if n:
-            rstart[0] = 0
-            rstart[1:] = ends[:-1]
-        # payload byte position in src coords: run_bit_starts stores
-        # pos*8 - run_start*width (see parse_hybrid_meta)
-        pay = (bst + rstart * width) >> 3
-        groups = np.where(isr, 0, -(-(ends - rstart) // 8))
-        for i in np.flatnonzero(~isr & (groups > 0)):
-            segs.append((src, int(pay[i]), int(groups[i]) * width))
-            if len(segs) > _PALLAS_MAX_SEGS:  # bail before O(runs) staging work
-                return None
-        gbase = (cumg + np.concatenate([[0], np.cumsum(groups[:-1])])
-                 if n else np.zeros(0, np.int64))
-        cumg += int(groups.sum())
-        ends_l.append(ends + prefix)
-        isr_l.append(isr)
-        rv_l.append(rv)
-        bib_l.append(np.where(isr, 0, gbase * 8 - (rstart + prefix)))
-        prefix += pcount
-    if cumg == 0 or total > np.iinfo(np.int32).max:
-        # i32 combine math also covers the value positions; >=2^31-value
-        # chunks keep the XLA path (int64 throughout)
+    # one vectorized pass over the concatenated run tables (a per-page
+    # Python loop here was ~30% of the nested config's host phase)
+    ks = np.array([m.n_runs for m, _, _ in pages_info], dtype=np.int64)
+    nr = int(ks.sum())
+    if nr == 0:
         return None
+    ends_c = np.concatenate([m.run_ends[: m.n_runs] for m, _, _ in pages_info])
+    isr = np.concatenate([m.run_is_rle[: m.n_runs] for m, _, _ in pages_info])
+    rvals = np.concatenate([m.run_values[: m.n_runs] for m, _, _ in pages_info])
+    bst = np.concatenate(
+        [m.run_bit_starts[: m.n_runs] for m, _, _ in pages_info]
+    )
+    run_page_start = np.repeat(np.cumsum(ks) - ks, ks)  # first run idx of page
+    page_of = np.repeat(np.arange(len(ks)), ks)
+    pcounts = np.array([c for _, _, c in pages_info], dtype=np.int64)
+    prefix = np.concatenate([[0], np.cumsum(pcounts)[:-1]])
+    # within-page run start = previous run's end (0 for a page's first run)
+    rstart = np.empty(nr, np.int64)
+    rstart[0] = 0
+    rstart[1:] = ends_c[:-1]
+    first = np.arange(nr) == run_page_start
+    rstart[first] = 0
+    # payload byte position in src coords: run_bit_starts stores
+    # pos*8 - run_start*width (see parse_hybrid_meta)
+    pay = (bst + rstart * width) >> 3
+    groups = np.where(isr, 0, -(-(ends_c - rstart) // 8))
+    sel = np.flatnonzero(groups > 0)
+    if len(sel) > _PALLAS_MAX_SEGS or not len(sel):
+        return None
+    cumg = int(groups.sum())
+    gbase = np.cumsum(groups) - groups  # exclusive prefix (global group base)
+    ends = (ends_c + prefix[page_of]).astype(np.int32)
+    bib = np.where(isr, 0,
+                   gbase * 8 - (rstart + prefix[page_of])).astype(np.int32)
+    srcs = [s for _, s, _ in pages_info]
+    segs = [(srcs[p], int(b), int(g) * width)
+            for p, b, g in zip(page_of[sel], pay[sel], groups[sel])]
     from .pallas_kernels import bp_groups_pad, unpack_bp_groups
 
-    ends64, isr, rvals, bib64 = _merge_run_tables(
-        ends_l, isr_l, rv_l, bib_l, fill_end=total
-    )
-    ends = ends64.astype(np.int32)
-    bib = bib64.astype(np.int32)
+    rp = _bucket(max(nr, 1))
+    if rp > nr:
+        pad = rp - nr
+        ends = np.concatenate([ends, np.full(pad, total, np.int32)])
+        isr = np.concatenate([isr, np.zeros(pad, bool)])
+        rvals = np.concatenate([rvals, np.zeros(pad, np.uint32)])
+        bib = np.concatenate([bib, np.zeros(pad, np.int32)])
     gpad = bp_groups_pad(cumg)
     if stager.total + gpad * width > np.iinfo(np.int32).max:
         # the kernel's x64-free trace addresses the staged buffer with i32;
@@ -752,6 +807,57 @@ class _ChunkAssembler:
         )
 
     def _finish_plain_bytes(self, common, stager):
+        """PLAIN BYTE_ARRAY chunk: host walks only the length prefixes
+        (native, no copies); the raw streams + lengths stage and the heap
+        compaction/offset cumsum run on device (_plain_bytes_pages_jit).
+        Falls back to the round-2 host-decode staging when the native
+        library is unavailable."""
+        from . import native
+
+        lens_l, span_l = [], []
+        for p in self.pages:
+            # whole page buffer + offset: no host copy of the value stream
+            res = native.bytearray_lengths(p.raw, p.defined, pos=p.value_pos)
+            if res is None:
+                return self._finish_plain_bytes_host(common, stager)
+            if isinstance(res, int):
+                if res == -20:
+                    raise ParquetError("byte array: truncated length prefix")
+                raise ParquetError("byte array: length exceeds buffer")
+            lens, end = res
+            lens_l.append(lens)
+            span_l.append(end - p.value_pos)
+        n = sum(p.defined for p in self.pages)
+        # stage exactly the walked stream spans, back to back
+        bases = stager.add_segments([
+            (p.raw, p.value_pos, c) for p, c in zip(self.pages, span_l)
+        ])
+        count_pad = _bucket_count(n)
+        lens_all = (np.concatenate(lens_l) if lens_l
+                    else np.zeros(0, np.uint32))
+        total_heap = int(lens_all.astype(np.int64).sum())
+        # zero-filled reserve: pad values past n must read length 0
+        lens_base = stager.add(lens_all, reserve=count_pad * 4)
+        heap_pad = _bucket_bytes(max(total_heap, 1), 64)
+        n_pages = _bucket(len(self.pages))
+        page_base = np.zeros(n_pages, dtype=np.int64)
+        page_base[: len(bases)] = bases
+        pvs = np.full(n_pages + 1, n, dtype=np.int32)
+        pvs[0] = 0
+        np.cumsum([p.defined for p in self.pages],
+                  out=pvs[1 : len(self.pages) + 1])
+
+        def run(buf_dev):
+            offsets, heap = _plain_bytes_pages_jit(
+                buf_dev, np.int64(lens_base), jnp.asarray(page_base),
+                jnp.asarray(pvs), count_pad=count_pad, heap_pad=heap_pad,
+            )
+            return DeviceColumnData(offsets=offsets, heap=heap, n_values=n,
+                                    **common)
+
+        return run
+
+    def _finish_plain_bytes_host(self, common, stager):
         """PLAIN BYTE_ARRAY chunk: native host walk per page, merged offsets,
         heap shipped in the row-group buffer (no per-page transfers)."""
         from .kernels import plain as plain_host

@@ -304,6 +304,23 @@ def _seed_inputs(target: str) -> list[bytes]:
             # footer thrift bytes only (between data end and trailing len+magic)
             flen = int.from_bytes(whole[-8:-4], "little")
             return [whole[-8 - flen : -8]]
+        if target == "device_reader":
+            # second seed: PLAIN (non-dictionary) strings — the device-side
+            # lengths/heap-compaction path has no dict analogue
+            sink2 = _io.BytesIO()
+            schema2 = build_schema([
+                data_column("s", Type.BYTE_ARRAY, FRT.REQUIRED),
+            ])
+            from .column import ByteArrayData, ColumnData
+
+            svals = [b"alpha", b"", b"bb", b"gamma-gamma", b"x"] * 8
+            with FileWriter(sink2, schema2, codec=CompressionCodec.SNAPPY,
+                            use_dictionary=False) as w2:
+                w2.write_columns({"s": ColumnData(values=ByteArrayData(
+                    offsets=np.cumsum([0] + [len(v) for v in svals]),
+                    heap=np.frombuffer(b"".join(svals), np.uint8).copy(),
+                ))})
+            return [whole, sink2.getvalue()]
         return [whole]
     if target == "hybrid":
         from .kernels import rle

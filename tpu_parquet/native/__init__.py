@@ -88,6 +88,10 @@ def load():
                 ctypes.c_char_p, c_ll, c_ll, p(ctypes.c_longlong),
                 p(ctypes.c_uint8),
             ]
+            lib.tpq_bytearray_lengths.restype = c_ll
+            lib.tpq_bytearray_lengths.argtypes = [
+                ctypes.c_char_p, c_ll, c_ll, c_ll, p(ctypes.c_uint32),
+            ]
             lib.tpq_delta_meta.restype = c_ll
             lib.tpq_delta_meta.argtypes = [
                 ctypes.c_char_p, c_ll, c_ll, p(ctypes.c_longlong),
@@ -283,6 +287,30 @@ def bytearray_walk(buf: bytes, count: int):
     if rc < 0:
         return int(rc)
     return offsets, heap[: int(rc)]
+
+
+def bytearray_lengths(buf: bytes, count: int, pos: int = 0):
+    """Validate PLAIN BYTE_ARRAY prefixes from ``pos`` and return the u32
+    lengths only (no copies anywhere: the caller passes the whole page
+    buffer + offset, and the device compacts the heap from the raw stream).
+
+    Returns (lens uint32[count], consumed_end int — the stream position
+    after the last value), a negative error code (int), or None when the
+    native library is unavailable.
+    """
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    lens = np.empty(count, dtype=np.uint32)
+    rc = lib.tpq_bytearray_lengths(
+        buf, len(buf), pos, count,
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+    )
+    if rc < 0:
+        return int(rc)
+    return lens, int(rc)
 
 
 def delta_ba_stitch(prefix_lens, suf_off, suf_heap, out_off, heap) -> "int | None":

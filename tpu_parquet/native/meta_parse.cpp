@@ -279,6 +279,26 @@ i64 tpq_bytearray_walk(const u8 *buf, i64 n, i64 count, i64 *offsets,
     return total;
 }
 
+// Lengths-only variant of tpq_bytearray_walk: validate the same prefix walk
+// (starting at `pos` of the page buffer — callers never slice/copy the
+// stream) but write only the u32 value lengths — no heap copy.  The batched
+// device reader stages the RAW stream and compacts the heap on device
+// (offsets = cumsum of these lengths there), so the host never touches the
+// value bytes.  Returns the position after the last value, or
+// ERR_TRUNC_PREFIX / ERR_LEN_RANGE.
+i64 tpq_bytearray_lengths(const u8 *buf, i64 n, i64 pos, i64 count,
+                          u32 *lens) {
+    for (i64 i = 0; i < count; i++) {
+        if (pos + 4 > n) return -20;  // truncated length prefix
+        u32 ln = (u32)buf[pos] | ((u32)buf[pos + 1] << 8) |
+                 ((u32)buf[pos + 2] << 16) | ((u32)buf[pos + 3] << 24);
+        if ((u128)pos + 4 + ln > (u128)n) return -21;  // length exceeds buffer
+        lens[i] = ln;
+        pos += 4 + (i64)ln;
+    }
+    return pos;
+}
+
 // DELTA_BYTE_ARRAY prefix stitching (type_bytearray.go:189-292 semantics):
 // value i = previous value's first prefix_lens[i] bytes + suffix i.  The
 // chain is inherently sequential (SURVEY.md §7.4.4) — this runs it at memcpy
