@@ -35,7 +35,7 @@ from .format import (
 )
 from .kernels import bitpack, bytearray as ba_codec, delta, plain, rle
 from .schema.core import SchemaNode
-from .stats import compute_statistics, merge_statistics
+from .stats import compute_statistics
 from .thrift import serialize
 
 MAX_DICT_SIZE = 32767  # MaxInt16, the reference's dictionary fallback threshold
@@ -80,11 +80,43 @@ def _unique_bytes_seq(values: ByteArrayData):
     return ByteArrayData.from_list(list(seen)), idx
 
 
+def _unique_rows(rows: np.ndarray):
+    """(first_indices, inverse) for the distinct rows of a (m, L) u8 matrix.
+
+    np.unique(axis=0) argsorts void-dtype rows — the single hottest writer
+    cost on string columns (~80% of dict-encode time).  Instead: one
+    vectorized FNV-1a pass gives a u64 hash per row, np.unique on the hashes
+    sorts plain integers (~20x faster), and an exact vectorized compare of
+    every row against its class representative guards correctness — any
+    hash collision (never seen on real data, constructible adversarially)
+    falls back to the sort-based path, so output never depends on hash
+    quality.
+    """
+    m, ln = rows.shape
+    if m <= 64 or ln > 512:
+        # few rows, or very long values: the hash loop is one numpy op PER
+        # BYTE COLUMN, so sort-based dedup (C over the whole matrix) wins
+        _, first, inv = np.unique(rows, axis=0, return_index=True,
+                                  return_inverse=True)
+        return first, inv.reshape(-1)
+    h = np.full(m, 14695981039346656037, dtype=np.uint64)
+    fnv = np.uint64(1099511628211)
+    for k in range(ln):
+        h = (h ^ rows[:, k]) * fnv
+    _, first, inv = np.unique(h, return_index=True, return_inverse=True)
+    inv = inv.reshape(-1)
+    if not (rows == rows[first[inv]]).all():
+        _, first, inv = np.unique(rows, axis=0, return_index=True,
+                                  return_inverse=True)
+        inv = inv.reshape(-1)
+    return first, inv
+
+
 def _unique_bytes(values: ByteArrayData):
     """Vectorized first-appearance uniquing of a ragged byte column.
 
     Values are grouped by length; each group's bytes gather into a fixed
-    (m, L) u8 matrix that np.unique(axis=0) dedups at C speed — no per-value
+    (m, L) u8 matrix that _unique_rows dedups at C speed — no per-value
     Python loop (the dict-of-bytes walk cost ~40% of writer time on string
     columns).  Distinct ids are then renumbered by global first appearance,
     matching the sequential walk's output exactly.
@@ -105,12 +137,11 @@ def _unique_bytes(values: ByteArrayData):
         if len(sel) * max(ln, 1) * 9 > 512 << 20:
             return _unique_bytes_seq(values)
         rows = heap[off[sel][:, None] + np.arange(ln, dtype=np.int64)]
-        _, first, inv = np.unique(rows, axis=0, return_index=True,
-                                  return_inverse=True)
+        first, inv = _unique_rows(rows)
         distinct += len(first)
         if distinct > MAX_DICT_SIZE:
             return None  # early bail: don't unique the remaining classes
-        groups.append((sel[first], sel, inv.reshape(-1)))
+        groups.append((sel[first], sel, inv))
     all_first = np.concatenate([g[0] for g in groups])
     order = np.argsort(all_first, kind="stable")
     rank = np.empty(len(all_first), dtype=np.int64)
@@ -321,14 +352,18 @@ class ChunkEncoder:
                 data_page_offset = offset + len(out)
             out += page_bytes
             total_uncompressed += raw_len + hdr_len
-            if self.write_statistics:
-                pstats = compute_statistics(
-                    _values_slice(cd.values, vlo, vhi), ptype,
-                    null_count=(hi - lo) - (vhi - vlo),
-                )
-                chunk_stats = merge_statistics(chunk_stats, pstats, ptype)
             encodings.add(int(encoding_used))
         encodings.add(int(Encoding.RLE))  # level (and dict-index) encoding
+
+        if self.write_statistics:
+            # chunk stats == fold of per-page stats (min of mins, summed
+            # nulls), so compute them ONCE over the chunk's defined values —
+            # per-page passes were the writer's hottest path after uniquing
+            n_slots = (len(cd.def_levels) if cd.def_levels is not None
+                       else len(cd.values))
+            chunk_stats = compute_statistics(
+                cd.values, ptype, null_count=n_slots - len(cd.values),
+            )
 
         sink.write(bytes(out))
 

@@ -114,6 +114,31 @@ class DeviceDictColumn(DeviceColumnData):
 
 @functools.partial(
     jax.jit,
+    static_argnames=("values_per_mini", "count", "bits", "max_width", "total",
+                     "n_pages", "m_max"),
+)
+def _delta_pages_staged_jit(buf, tbase, *, values_per_mini, count, bits,
+                            max_width, total, n_pages, m_max):
+    """_delta_pages_jit with every metadata table read from the staged
+    buffer at ``tbase`` (layout: firsts i64[P] | starts i64[P,M] |
+    widths i32[P,M] | mins u64[P,M] | page_starts i64[P+1]) — one transfer
+    per row group instead of five per delta chunk."""
+    P, M = n_pages, m_max
+    o = 0
+    firsts = _tslice(buf, tbase, o, P, jnp.int64); o += P * 8
+    starts = _tslice(buf, tbase, o, P * M, jnp.int64).reshape(P, M); o += P * M * 8
+    widths = _tslice(buf, tbase, o, P * M, jnp.int32).reshape(P, M); o += P * M * 4
+    mins = _tslice(buf, tbase, o, P * M, jnp.uint64).reshape(P, M); o += P * M * 8
+    page_starts = _tslice(buf, tbase, o, P + 1, jnp.int64)
+    return _delta_pages_jit(
+        buf, firsts, starts, widths, mins, page_starts,
+        values_per_mini=values_per_mini, count=count, bits=bits,
+        max_width=max_width, total=total,
+    )
+
+
+@functools.partial(
+    jax.jit,
     static_argnames=("values_per_mini", "count", "bits", "max_width", "total"),
 )
 def _delta_pages_jit(buf, firsts, starts, widths, mins, page_starts, *,
@@ -139,6 +164,20 @@ def _delta_pages_jit(buf, firsts, starts, widths, mins, page_starts, *,
     p = jnp.clip(p, 0, vals.shape[0] - 1)
     within = jnp.clip(i - page_starts[p], 0, count - 1)
     return vals[p, within]
+
+
+@functools.partial(jax.jit, static_argnames=("count_pad", "heap_pad",
+                                             "n_pages"))
+def _plain_bytes_staged_jit(buf, lens_base, tbase, *, count_pad, heap_pad,
+                            n_pages):
+    """_plain_bytes_pages_jit with the page tables read from the staged
+    buffer (layout: page_byte_base i64[P] | page_val_start i32[P+1])."""
+    page_byte_base = _tslice(buf, tbase, 0, n_pages, jnp.int64)
+    page_val_start = _tslice(buf, tbase, n_pages * 8, n_pages + 1, jnp.int32)
+    return _plain_bytes_pages_jit(
+        buf, lens_base, page_byte_base, page_val_start,
+        count_pad=count_pad, heap_pad=heap_pad,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("count_pad", "heap_pad"))
@@ -410,24 +449,57 @@ def _pallas_interpret_mode():
 _PALLAS_MAX_SEGS = 4096
 
 
-@functools.partial(jax.jit, static_argnames=("count",))
-def _hybrid_combine_jit(vals, run_ends, run_is_rle, run_values, bp_idx_base,
-                        n_valid, *, count):
+def _pack_tables(stager: _RowGroupStager, arrays) -> int:
+    """Pack np arrays into ONE staged region; returns its byte base.
+
+    Every per-chunk metadata table shipped as its own ``jnp.asarray`` costs a
+    full tunnel round trip (~2.5 ms measured) — at 800 chunks × 4 tables that
+    is the dominant wall-clock at multi-GB scale, dwarfing the decode.
+    Packing the tables into the row-group buffer makes them part of the ONE
+    staged transfer; consuming jits slice them back out at static offsets
+    (shapes are bucketed, so offsets are static relative to a traced base).
+    Arrays are staged back to back in call order; callers compute the same
+    static layout at trace time.
+    """
+    cat = np.concatenate([np.ascontiguousarray(a).reshape(-1).view(np.uint8)
+                          for a in arrays])
+    return stager.add(cat)
+
+
+def _tslice(buf, base, off: int, n: int, dtype):
+    """Slice a packed table back out of the staged buffer (trace-time
+    helper; ``off``/``n`` static, ``base`` traced)."""
+    nbytes = np.dtype(dtype).itemsize
+    raw = jax.lax.dynamic_slice(buf, (base + off,), (n * nbytes,))
+    if nbytes == 1:
+        return raw
+    return jax.lax.bitcast_convert_type(
+        raw.reshape(n, nbytes), dtype
+    ).reshape(n)
+
+
+@functools.partial(jax.jit, static_argnames=("count", "rp"))
+def _hybrid_combine_staged_jit(vals, buf, tbase, n_valid, *, count, rp):
     """Combine Pallas-unpacked BP values with RLE runs into stream order.
 
     ``vals`` uint32[8 * groups_pad] — BP groups unpacked from the contiguous
     staged payload.  Every output position finds its run with one
     searchsorted (same structure as expand_rle_hybrid), then either
     broadcasts the RLE value or picks its BP element at
-    ``bp_idx_base[run] + pos`` — a single u32 gather instead of per-value
-    multi-byte extraction.  All index math is int32 (chunk value counts are
-    far below 2^31), so the trace is x64-agnostic.
-    """
+    ``bp_idx_base[run] + pos`` — one u32 gather instead of per-value
+    multi-byte extraction.  Run tables ride the staged buffer at ``tbase``
+    (layout [ends i32 | is_rle u8 | values u32 | bp_idx_base i32] × rp —
+    see _pack_tables); all index math is int32, so the trace is
+    x64-agnostic."""
+    ends = _tslice(buf, tbase, 0, rp, np.int32)
+    isr = _tslice(buf, tbase, rp * 4, rp, np.uint8) != 0
+    rvals = _tslice(buf, tbase, rp * 5, rp, np.uint32)
+    bib = _tslice(buf, tbase, rp * 9, rp, np.int32)
     pos = jnp.arange(count, dtype=jnp.int32)
-    r = jnp.searchsorted(run_ends, pos, side="right").astype(jnp.int32)
-    r = jnp.minimum(r, run_ends.shape[0] - 1)
-    bp_idx = jnp.clip(bp_idx_base[r] + pos, 0, vals.shape[0] - 1)
-    out = jnp.where(run_is_rle[r], run_values[r], vals[bp_idx])
+    r = jnp.searchsorted(ends, pos, side="right").astype(jnp.int32)
+    r = jnp.minimum(r, rp - 1)
+    bp_idx = jnp.clip(bib[r] + pos, 0, vals.shape[0] - 1)
+    out = jnp.where(isr[r], rvals[r], vals[bp_idx])
     return jnp.where(pos < n_valid, out, jnp.zeros((), dtype=out.dtype))
 
 
@@ -497,7 +569,9 @@ def _plan_hybrid_pallas(stager: _RowGroupStager, pages_info, width: int,
     if stager.total + gpad * width > np.iinfo(np.int32).max:
         # the kernel's x64-free trace addresses the staged buffer with i32;
         # a >=2 GiB stager region can't — the XLA extract path handles it
+        # (checked before ANY stager mutation so fallback leaves no dead bytes)
         return None
+    tbase = _pack_tables(stager, [ends, isr.astype(np.uint8), rvals, bib])
     bases = stager.add_segments(segs)
     bp_base = int(bases[0])
     # the unpack reads gpad*width bytes from bp_base: past the real payload
@@ -509,9 +583,9 @@ def _plan_hybrid_pallas(stager: _RowGroupStager, pages_info, width: int,
     def run(buf_dev):
         vals = unpack_bp_groups(buf_dev, bp_base, width, gpad,
                                 interpret=interpret)
-        return _hybrid_combine_jit(
-            vals, jnp.asarray(ends), jnp.asarray(isr), jnp.asarray(rvals),
-            jnp.asarray(bib), np.int32(total), count=count_pad,
+        return _hybrid_combine_staged_jit(
+            vals, buf_dev, np.int64(tbase), np.int32(total),
+            count=count_pad, rp=rp,
         )
 
     return run
@@ -846,11 +920,12 @@ class _ChunkAssembler:
         pvs[0] = 0
         np.cumsum([p.defined for p in self.pages],
                   out=pvs[1 : len(self.pages) + 1])
+        tbase = _pack_tables(stager, [page_base, pvs])
 
         def run(buf_dev):
-            offsets, heap = _plain_bytes_pages_jit(
-                buf_dev, np.int64(lens_base), jnp.asarray(page_base),
-                jnp.asarray(pvs), count_pad=count_pad, heap_pad=heap_pad,
+            offsets, heap = _plain_bytes_staged_jit(
+                buf_dev, np.int64(lens_base), np.int64(tbase),
+                count_pad=count_pad, heap_pad=heap_pad, n_pages=n_pages,
             )
             return DeviceColumnData(offsets=offsets, heap=heap, n_values=n,
                                     **common)
@@ -1003,6 +1078,7 @@ class _ChunkAssembler:
         self._check_dict_range(prefix, host_max)
         dict_u8 = self.dict_u8
         dict_base = dict_kp = dict_itemsize = None
+        roff_base = rheap_base = roff_n = rheap_room = None
         if dict_u8 is not None:
             # dictionary bytes ride the row-group buffer (no extra transfer);
             # the row count is bucketed so the slice/gather executables are
@@ -1014,6 +1090,17 @@ class _ChunkAssembler:
             # never a neighboring chunk's staged bytes
             dict_base = stager.add(np.ascontiguousarray(dict_u8),
                                    reserve=dict_kp * dict_itemsize)
+        elif self.dict_ragged is not None:
+            # ragged (string) dictionaries ride the buffer too — two
+            # jnp.asarray transfers per chunk otherwise dominate dict-heavy
+            # scans at many-row-group scale (~2.5 ms per transfer)
+            roff = np.ascontiguousarray(self.dict_ragged.offsets,
+                                        dtype=np.int64)
+            roff_n = _bucket_count(len(roff))
+            roff_base = stager.add(roff, reserve=roff_n * 8)
+            rheap = np.ascontiguousarray(self.dict_ragged.heap)
+            rheap_room = _bucket_bytes(max(rheap.nbytes, 1), 64)
+            rheap_base = stager.add(rheap, reserve=rheap_room)
 
         def run(buf_dev):
             if plan is not None:
@@ -1050,8 +1137,15 @@ class _ChunkAssembler:
                 )
                 col.dict_dtype = self.dict_dtype
             else:
-                col.dict_offsets = jnp.asarray(self.dict_ragged.offsets)
-                col.dict_heap = jnp.asarray(self.dict_ragged.heap)
+                # device slices of the staged ragged dictionary (padding past
+                # the real offsets is garbage consumers never index: every
+                # valid dict index is < dict_len)
+                col.dict_offsets = _plain_jit(
+                    buf_dev, np.int64(roff_base), dtype="int64", count=roff_n
+                )
+                col.dict_heap = _dynslice_jit(
+                    buf_dev, np.int64(rheap_base), size=rheap_room
+                )
             return col
 
         return run
@@ -1096,14 +1190,15 @@ class _ChunkAssembler:
                   out=page_starts[1 : len(metas) + 1])
         max_width = max(1, int(widths.max(initial=0)))
         max_width = min((max_width + 7) // 8 * 8, 64)  # byte-rounded: 8 shapes
+        tbase = _pack_tables(stager, [firsts, starts, widths, mins,
+                                      page_starts])
         return lambda buf_dev: DeviceColumnData(
-            values=_delta_pages_jit(
-                buf_dev, jnp.asarray(firsts), jnp.asarray(starts),
-                jnp.asarray(widths), jnp.asarray(mins),
-                jnp.asarray(page_starts),
+            values=_delta_pages_staged_jit(
+                buf_dev, np.int64(tbase),
                 values_per_mini=metas[0].values_per_mini, count=count,
                 bits=bits, max_width=max_width,
                 total=_bucket_count(total_real),
+                n_pages=n_pages, m_max=m_max,
             ),
             n_values=total_real,
             **common,
