@@ -113,6 +113,8 @@ def load():
 
 
 def snappy_decompress(data: bytes, max_size: int = -1) -> bytes:
+    import numpy as np
+
     lib = load()
     if lib is None:
         raise RuntimeError("native library unavailable")
@@ -125,11 +127,15 @@ def snappy_decompress(data: bytes, max_size: int = -1) -> bytes:
         raise ValueError(
             f"snappy stream claims {n} bytes, page declared {max_size}"
         )
-    out = ctypes.create_string_buffer(n)
-    rc = lib.tpq_snappy_decompress(data, len(data), out, n)
+    # np.empty skips create_string_buffer's zero-init memset (decompress
+    # overwrites every byte on success; failures discard the buffer)
+    out = np.empty(n, dtype=np.uint8)
+    rc = lib.tpq_snappy_decompress(
+        data, len(data), out.ctypes.data_as(ctypes.c_char_p), n
+    )
     if rc != 0:
         raise ValueError(f"malformed snappy data (error {rc})")
-    return out.raw
+    return out.tobytes()
 
 
 def snappy_compress(data: bytes) -> bytes:
