@@ -267,14 +267,14 @@ def _uncompressed_mb(path):
 
 def bench_device(path, rows):
     import jax
-    from tpu_parquet.device_reader import DeviceFileReader
+    from tpu_parquet.device_reader import DeviceFileReader, scan_files
 
     def run():
         outs = []
-        for p in _bench_paths(path):
-            with DeviceFileReader(p) as r:
-                for cols in r.iter_row_groups():
-                    outs.extend(cols.values())
+        # one continuous pipeline across the config's whole file set (the
+        # multi-file dataset scan of BASELINE config 5)
+        for cols in scan_files(_bench_paths(path)):
+            outs.extend(cols.values())
         arrs = [a for o in outs
                 for a in (o.values, o.offsets, o.heap,
                           getattr(o, "indices", None))

@@ -630,3 +630,123 @@ def test_plain_byte_array_device_compaction_matches_host(tmp_path):
             dd, _ = d.levels_to_host()
             if h.def_levels is not None:
                 np.testing.assert_array_equal(h.def_levels, dd)
+
+
+def test_scan_files_multi_file_pipeline(tmp_path):
+    """scan_files yields every file's row groups in order, equal to per-file
+    reads, closes readers, and still raises deferred errors per file."""
+    from tpu_parquet.column import ColumnData
+    from tpu_parquet.device_reader import DeviceFileReader, scan_files
+    from tpu_parquet.format import CompressionCodec, FieldRepetitionType as FRT, Type
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.writer import FileWriter
+
+    rng = np.random.default_rng(5)
+    schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
+    paths, expect = [], []
+    for f in range(3):
+        p = str(tmp_path / f"part{f}.parquet")
+        vals = rng.integers(-100, 100, 5000 + f * 111)
+        with FileWriter(p, schema, codec=CompressionCodec.SNAPPY,
+                        row_group_size=16 << 10) as w:
+            w.write_columns({"v": ColumnData(values=vals)})
+        paths.append(p)
+        expect.append(vals)
+
+    got = {p: [] for p in paths}
+    for p, cols in scan_files(paths, with_path=True):
+        got[p].append(np.asarray(cols["v"].to_host()))
+    for p, vals in zip(paths, expect):
+        np.testing.assert_array_equal(np.concatenate(got[p]), vals)
+
+    # parity with per-file iteration (row group boundaries included)
+    for p in paths:
+        per_file = []
+        with DeviceFileReader(p) as r:
+            for cols in r.iter_row_groups():
+                per_file.append(np.asarray(cols["v"].to_host()))
+        assert len(per_file) == len(got[p])
+        for a, b in zip(per_file, got[p]):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_scan_files_closes_readers_at_boundary_and_on_error(
+    tmp_path, monkeypatch
+):
+    """A finished file's reader closes as soon as its last group is yielded
+    (descriptors stay bounded over many shards), and an error mid-scan still
+    closes every opened reader."""
+    from tpu_parquet.device_reader import DeviceFileReader, scan_files
+    from tpu_parquet.errors import ParquetError
+
+    good = str(tmp_path / "good.parquet")
+    good2 = str(tmp_path / "good2.parquet")
+    bad = str(tmp_path / "bad.parquet")
+    _write_oob_dict_file(good, patch=False)
+    _write_oob_dict_file(good2, patch=False)
+    _write_oob_dict_file(bad, patch=True)
+
+    created = []
+    orig = DeviceFileReader.__init__
+
+    def spy(self, *a, **k):
+        orig(self, *a, **k)
+        created.append(self)
+
+    monkeypatch.setattr(DeviceFileReader, "__init__", spy)
+
+    # boundary closing: by the time file 2's group arrives, file 1 is closed
+    seen = []
+    for p, cols in scan_files([good, good2], with_path=True):
+        seen.append(p)
+        if p == good2:
+            assert created[0]._host._f.closed
+    assert seen == [good, good2]
+    assert all(r._host._f.closed for r in created)
+
+    # error propagation: the bad file's out-of-range dictionary index raises
+    # (eagerly, during its prepare — pipeline depth means the preceding
+    # yield is preempted), and the finally closes every reader
+    created.clear()
+    with pytest.raises(ParquetError):
+        for cols in scan_files([good, bad]):
+            pass
+    assert len(created) == 2
+    assert all(r._host._f.closed for r in created)
+
+
+def _write_oob_dict_file(path, patch: bool):
+    """A 2-entry-dictionary file; with ``patch`` its RLE index run value is
+    rewritten out of range (the deferred/covered-width check must reject)."""
+    from tpu_parquet.chunk_decode import validate_chunk_meta, walk_pages
+    from tpu_parquet.column import ColumnData
+    from tpu_parquet.format import PageType
+    from tpu_parquet.jax_decode import parse_data_page
+
+    schema = build_schema([data_column("s", Type.BYTE_ARRAY, FRT.REQUIRED)])
+    vals = [b"aa"] * 4 + [b"bb"] * 200
+    heap = np.frombuffer(b"".join(vals), np.uint8).copy()
+    offs = np.cumsum([0] + [len(v) for v in vals]).astype(np.int64)
+    with FileWriter(path, schema, codec=CompressionCodec.UNCOMPRESSED,
+                    use_dictionary=True) as w:
+        w.write_columns({"s": ColumnData(values=ByteArrayData(
+            offsets=offs, heap=heap))})
+    if not patch:
+        return
+    with FileReader(path) as r:
+        leaf = next(iter(r.schema.selected_leaves()))
+        chunk = r.metadata.row_groups[0].columns[0]
+        md, off = validate_chunk_meta(chunk, leaf)
+        r._f.seek(off)
+        buf = r._f.read(md.total_compressed_size)
+        patched = None
+        for ps in walk_pages(buf, md.num_values):
+            if ps.header.type != PageType.DATA_PAGE:
+                continue
+            parse_data_page(ps, buf, md.codec, leaf)
+            patched = off + len(buf) - 1  # last byte = RLE run value byte
+        assert patched is not None
+    data = bytearray(open(path, "rb").read())
+    assert data[patched] in (0, 1)
+    data[patched] = 3
+    open(path, "wb").write(bytes(data))

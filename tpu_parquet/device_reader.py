@@ -51,7 +51,8 @@ from .jax_decode import (
 )
 from .schema.core import SchemaNode
 
-__all__ = ["DeviceFileReader", "ReaderStats", "decode_chunk_batched", "DeviceDictColumn"]
+__all__ = ["DeviceFileReader", "ReaderStats", "decode_chunk_batched",
+           "DeviceDictColumn", "scan_files"]
 
 
 @dataclass
@@ -1754,35 +1755,103 @@ class DeviceFileReader:
             return
         trace = (jax.profiler.trace(self.profile_dir) if self.profile_dir
                  else contextlib.nullcontext())
-        def _add_device_seconds(dt: float) -> None:
-            with self._stats_lock:
-                self._stats.device_seconds += dt
-
-        def timed_stage(stager):
-            import time as _time
-
-            t0 = _time.perf_counter()
-            buf_dev = stager.stage()
-            # the worker thread and the dispatching main thread both touch
-            # device_seconds; += is not atomic across bytecodes
-            _add_device_seconds(_time.perf_counter() - t0)
-            return buf_dev
-
         with trace, ThreadPoolExecutor(1) as ex:
-            prev = None  # (prepared, future staging the device buffer)
-            for i in indices:
-                prepared = self._prepare_row_group(i, executor=ex)
-                fut = ex.submit(timed_stage, prepared[2]) if prepared[1] else None
-                if prev is not None:
-                    p_prepared, p_fut = prev
-                    yield self._dispatch_row_group(
-                        p_prepared, p_fut.result() if p_fut else None
-                    )
-                    if finalize_each:
-                        self.finalize()
-                prev = (prepared, fut)
-            p_prepared, p_fut = prev
-            yield self._dispatch_row_group(
-                p_prepared, p_fut.result() if p_fut else None
+            for _, out in _scan_pipeline(
+                ((self, None, i) for i in indices), ex,
+                finalize_each=finalize_each,
+            ):
+                yield out
+
+
+def _timed_stage(reader: DeviceFileReader, stager: _RowGroupStager):
+    """Stage on the worker, attributing wall time to the owning reader's
+    stats (the worker and dispatching threads both touch device_seconds;
+    += is not atomic across bytecodes, hence the lock)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    buf_dev = stager.stage()
+    with reader._stats_lock:
+        reader._stats.device_seconds += _time.perf_counter() - t0
+    return buf_dev
+
+
+def _scan_pipeline(work, ex, finalize_each: bool = False,
+                   close_finished: bool = False):
+    """The one-deep prepare/stage/dispatch pipeline shared by
+    ``DeviceFileReader.iter_row_groups`` (one reader) and :func:`scan_files`
+    (many).  ``work`` yields ``(reader, path, row_group_index)``; this yields
+    ``(path, columns)`` per row group.
+
+    Ordering contract: a row group is always YIELDED before its reader's
+    deferred checks can raise (finalize runs after the yield, either at a
+    file boundary or at the end), matching iter_row_groups' yield-then-raise
+    semantics.  With ``close_finished`` a reader is closed as soon as its
+    last row group is delivered, bounding open file descriptors to one.
+    """
+    prev = None  # (reader, path, prepared, staging future)
+    for r, path, i in work:
+        prepared = r._prepare_row_group(i, executor=ex)
+        fut = ex.submit(_timed_stage, r, prepared[2]) if prepared[1] else None
+        if prev is not None:
+            pr, pp, pprep, pfut = prev
+            yield pp, pr._dispatch_row_group(
+                pprep, pfut.result() if pfut else None
             )
-        self.finalize()
+            if finalize_each or pr is not r:
+                pr.finalize()
+                if close_finished and pr is not r:
+                    pr.close()
+        prev = (r, path, prepared, fut)
+    if prev is not None:
+        pr, pp, pprep, pfut = prev
+        yield pp, pr._dispatch_row_group(
+            pprep, pfut.result() if pfut else None
+        )
+        pr.finalize()
+
+
+def scan_files(paths, columns=None, validate_crc: bool = False,
+               max_memory: int = 0, row_filter=None, with_path: bool = False):
+    """Scan several files' row groups through ONE continuous transfer pipeline.
+
+    The multi-file dataset form of ``DeviceFileReader.iter_row_groups``
+    (BASELINE config 5 is a multi-file row-group scan): per-file iteration
+    drains the transfer pipeline at every file boundary — the last row
+    group's staging ships with nothing overlapping it, and the next file's
+    footer parse waits for it.  Here one staging worker spans the whole
+    dataset, so file N+1's footer/decompress overlaps file N's tail
+    transfers exactly like adjacent row groups within a file.
+
+    Yields one ``{column: DeviceColumnData}`` dict per row group (in file
+    order); ``with_path=True`` yields ``(path, cols)`` pairs.  Deferred
+    dictionary range checks run per file AFTER its last group is yielded
+    (iter_row_groups' yield-then-raise ordering); eager per-chunk errors
+    raise from the pipelined prepare and may preempt the preceding group's
+    yield by one (the pipeline's depth), exactly as within one file.
+    Finished files close at the boundary (open descriptors stay bounded for
+    arbitrarily many shards), and every reader is closed on exit even on
+    error.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    readers: list[DeviceFileReader] = []
+
+    def work():
+        for path in paths:
+            r = DeviceFileReader(
+                path, columns=columns, validate_crc=validate_crc,
+                max_memory=max_memory, row_filter=row_filter,
+            )
+            readers.append(r)
+            for i in range(r.num_row_groups):
+                if r._host.row_group_selected(i):
+                    yield r, path, i
+
+    try:
+        with ThreadPoolExecutor(1) as ex:
+            for pp, out in _scan_pipeline(work(), ex, close_finished=True):
+                yield (pp, out) if with_path else out
+    finally:
+        for r in readers:
+            r.close()
