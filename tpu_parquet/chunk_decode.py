@@ -38,6 +38,31 @@ class PageSlice:
     payload_end: int
 
 
+# native page-header parse error codes → the python engine's diagnostics
+_NATIVE_THRIFT_ERRORS = {
+    -40: "truncated thrift input",
+    -41: "varint too long",
+    -42: "thrift container exceeds sanity cap",
+    -43: "thrift nesting too deep",
+}
+
+
+def _read_page_header(buf: bytes, pos: int):
+    """One PageHeader at ``pos``: native C parse (meta_parse.cpp, the
+    per-page host hot path — ~100 µs of python thrift per page otherwise)
+    with the python engine as fallback and fuzz-parity oracle."""
+    from . import native
+
+    res = native.page_header(buf, pos)
+    if res is None:
+        return read_struct(PageHeader, buf, pos)
+    if isinstance(res, int):
+        raise ThriftError(
+            _NATIVE_THRIFT_ERRORS.get(res, f"thrift parse error {res}")
+        )
+    return res
+
+
 def walk_pages(buf: bytes, total_values: int) -> list[PageSlice]:
     """Parse page headers until the chunk's declared value count is consumed.
 
@@ -55,7 +80,7 @@ def walk_pages(buf: bytes, total_values: int) -> list[PageSlice]:
                 f"chunk exhausted at {seen_values}/{total_values} values"
             )
         try:
-            header, pos = read_struct(PageHeader, buf, pos)
+            header, pos = _read_page_header(buf, pos)
         except ThriftError as e:
             raise ParquetError(f"corrupt page header: {e}") from e
         if header.compressed_page_size is None or header.compressed_page_size < 0:

@@ -250,6 +250,41 @@ def fuzz_device_reader(data: bytes) -> None:
                     raise AssertionError(f"{name} levels differ in {k}")
 
 
+def fuzz_page_header(data: bytes) -> None:
+    """Native vs python PageHeader parse parity (the C parser replicates
+    thrift.py's compact-protocol semantics byte for byte — same
+    accept/reject set, same consumed length, same extracted fields; page
+    Statistics are the one documented difference and are excluded)."""
+    from . import native
+    from .format import PageHeader
+    from .thrift import ThriftError, read_struct
+
+    res = native.page_header(data, 0)
+    if res is None:
+        return  # no native library: nothing to differentiate
+    try:
+        py, py_end = read_struct(PageHeader, data, 0)
+    except ThriftError:
+        py = ThriftError
+    if isinstance(res, int):
+        if py is not ThriftError:
+            raise AssertionError(
+                f"native rejected ({res}) where python accepted"
+            )
+        return
+    if py is ThriftError:
+        raise AssertionError("native accepted where python rejected")
+    c, c_end = res
+    if c_end != py_end:
+        raise AssertionError(f"consumed mismatch: {c_end} != {py_end}")
+    if py.data_page_header is not None:
+        py.data_page_header.statistics = None  # documented difference
+    if py.data_page_header_v2 is not None:
+        py.data_page_header_v2.statistics = None
+    if c != py:
+        raise AssertionError(f"field mismatch: {c!r} != {py!r}")
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -258,6 +293,7 @@ TARGETS = {
     "plain": fuzz_plain,
     "schema_dsl": fuzz_schema_dsl,
     "device_reader": fuzz_device_reader,
+    "page_header": fuzz_page_header,
 }
 
 
@@ -338,6 +374,34 @@ def _seed_inputs(target: str) -> list[bytes]:
             len(s).to_bytes(4, "little") + s
             for s in (b"alpha", b"", b"beta") * 7
         )]
+    if target == "page_header":
+        from .format import (
+            DataPageHeader, DataPageHeaderV2, DictionaryPageHeader, PageHeader,
+        )
+        from .thrift import write_struct
+
+        v1 = PageHeader(
+            type=0, uncompressed_page_size=1000, compressed_page_size=600,
+            crc=123456, data_page_header=DataPageHeader(
+                num_values=300, encoding=3, definition_level_encoding=3,
+                repetition_level_encoding=3,
+            ),
+        )
+        v2 = PageHeader(
+            type=3, uncompressed_page_size=2048, compressed_page_size=900,
+            data_page_header_v2=DataPageHeaderV2(
+                num_values=128, num_nulls=5, num_rows=100, encoding=8,
+                definition_levels_byte_length=17,
+                repetition_levels_byte_length=0, is_compressed=True,
+            ),
+        )
+        d = PageHeader(
+            type=2, uncompressed_page_size=64, compressed_page_size=64,
+            dictionary_page_header=DictionaryPageHeader(
+                num_values=16, encoding=0, is_sorted=False,
+            ),
+        )
+        return [write_struct(x) for x in (v1, v2, d)]
     if target == "schema_dsl":
         return [b"message m { required int64 a; optional group l (LIST) "
                 b"{ repeated group list { optional binary element (STRING); } } }"]

@@ -92,6 +92,10 @@ def load():
             lib.tpq_bytearray_lengths.argtypes = [
                 ctypes.c_char_p, c_ll, c_ll, c_ll, p(ctypes.c_uint32),
             ]
+            lib.tpq_page_header.restype = c_ll
+            lib.tpq_page_header.argtypes = [
+                ctypes.c_char_p, c_ll, c_ll, p(ctypes.c_longlong),
+            ]
             lib.tpq_delta_meta.restype = c_ll
             lib.tpq_delta_meta.argtypes = [
                 ctypes.c_char_p, c_ll, c_ll, p(ctypes.c_longlong),
@@ -317,6 +321,65 @@ def bytearray_lengths(buf: bytes, count: int, pos: int = 0):
     if rc < 0:
         return int(rc)
     return lens, int(rc)
+
+
+def page_header(buf: bytes, pos: int = 0):
+    """Parse one thrift compact PageHeader natively (meta_parse.cpp).
+
+    Returns (PageHeader, end_pos), a negative error code (int — TERR_*
+    values, same accept/reject set as the Python engine), or None when the
+    native library is unavailable.  Page-level Statistics are skipped (no
+    reader consumes them); everything else the readers touch is populated,
+    including sub-struct presence (a missing DataPageHeader stays None).
+    """
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    out = np.zeros(20, dtype=np.int64)
+    rc = lib.tpq_page_header(
+        buf, len(buf), pos,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+    )
+    if rc < 0:
+        return int(rc)
+    from ..format import (
+        DataPageHeader, DataPageHeaderV2, DictionaryPageHeader,
+        IndexPageHeader, PageHeader,
+    )
+
+    mask = int(out[18])
+
+    def g(i):
+        return int(out[i]) if mask >> i & 1 else None
+
+    h = PageHeader(
+        type=g(0), uncompressed_page_size=g(1),
+        compressed_page_size=g(2), crc=g(3),
+    )
+    if mask >> 60 & 1:
+        h.data_page_header = DataPageHeader(
+            num_values=g(4), encoding=g(5),
+            definition_level_encoding=g(6), repetition_level_encoding=g(7),
+        )
+    if mask >> 59 & 1:
+        h.index_page_header = IndexPageHeader()
+    if mask >> 61 & 1:
+        dph = DictionaryPageHeader(num_values=g(8), encoding=g(9))
+        if mask >> 10 & 1:
+            dph.is_sorted = bool(out[10])
+        h.dictionary_page_header = dph
+    if mask >> 62 & 1:
+        v2 = DataPageHeaderV2(
+            num_values=g(11), num_nulls=g(12), num_rows=g(13),
+            encoding=g(14), definition_levels_byte_length=g(15),
+            repetition_levels_byte_length=g(16),
+        )
+        if mask >> 17 & 1:
+            v2.is_compressed = bool(out[17])
+        h.data_page_header_v2 = v2
+    return h, int(out[19])
 
 
 def delta_ba_stitch(prefix_lens, suf_off, suf_heap, out_off, heap) -> "int | None":

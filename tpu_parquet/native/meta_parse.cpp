@@ -299,6 +299,268 @@ i64 tpq_bytearray_lengths(const u8 *buf, i64 n, i64 pos, i64 count,
     return pos;
 }
 
+// ---------------------------------------------------------------------------
+// Thrift compact-protocol PageHeader parse (the per-page host hot path).
+//
+// Semantics mirror tpu_parquet/thrift.py's CompactReader EXACTLY (that engine
+// is the reference and the fuzz-parity oracle): varints reject >10 bytes and
+// 64-bit overflow, field ids arrive as header deltas or zigzag varints, bool
+// field values ride the header ctype, containers are capped at 2^24, nesting
+// at depth 32, and a known field id carrying the wrong wire type is skipped
+// by its wire type (leaving the field absent).  Only the fields the readers
+// consume are extracted; everything else (incl. page Statistics, which no
+// consumer reads — predicate pushdown uses chunk metadata stats) is skipped
+// by wire type.
+// ---------------------------------------------------------------------------
+
+enum {
+    TERR_TRUNC = -40,      // truncated input
+    TERR_VARLONG = -41,    // varint too long / exceeds 64 bits
+    TERR_CONTAINER = -42,  // container exceeds sanity cap
+    TERR_DEPTH = -43,      // nesting too deep
+};
+
+static const i64 T_MAX_CONTAINER = (i64)1 << 24;
+
+static int t_varint(const u8 *buf, i64 n, i64 *pos, u64 *out) {
+    u64 result = 0;
+    int shift = 0;
+    i64 p = *pos;
+    while (1) {
+        if (p >= n) return TERR_TRUNC;
+        u8 b = buf[p++];
+        result |= (u64)(b & 0x7F) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+        if (shift > 63) return TERR_VARLONG;
+    }
+    // shift==63 with a 2-bit payload would exceed 64 bits; the python engine
+    // rejects via `result >> 64`, which the shift cap above already covers
+    // except for the final byte's high bits — replicate the exact check:
+    if (shift == 63 && (buf[p - 1] & 0x7E)) return TERR_VARLONG;
+    *pos = p;
+    *out = result;
+    return 0;
+}
+
+static int t_zigzag(const u8 *buf, i64 n, i64 *pos, i64 *out) {
+    u64 v;
+    int rc = t_varint(buf, n, pos, &v);
+    if (rc) return rc;
+    *out = (i64)(v >> 1) ^ -(i64)(v & 1);
+    return 0;
+}
+
+static int t_skip(const u8 *buf, i64 n, i64 *pos, int ctype, int depth);
+
+static int t_skip_struct(const u8 *buf, i64 n, i64 *pos, int depth) {
+    if (depth > 32) return TERR_DEPTH;
+    i64 last = 0;
+    while (1) {
+        if (*pos >= n) return TERR_TRUNC;
+        u8 b = buf[(*pos)++];
+        // the python engine masks ctype BEFORE its STOP comparison, so any
+        // zero-ctype-nibble byte terminates the struct (0x00 consumes
+        // nothing further; nonzero deltas were already folded into fid)
+        if ((b & 0x0F) == 0x00) return 0;  // CT_STOP
+        int ctype = b & 0x0F;
+        int delta = (b >> 4) & 0x0F;
+        if (delta) {
+            last += delta;
+        } else {
+            i64 fid;
+            int rc = t_zigzag(buf, n, pos, &fid);
+            if (rc) return rc;
+            last = fid;
+        }
+        if (ctype != 0x01 && ctype != 0x02) {  // bools carry no payload
+            int rc = t_skip(buf, n, pos, ctype, depth + 1);
+            if (rc) return rc;
+        }
+    }
+}
+
+static int t_skip(const u8 *buf, i64 n, i64 *pos, int ctype, int depth) {
+    if (depth > 32) return TERR_DEPTH;
+    u64 v;
+    int rc;
+    switch (ctype) {
+        case 0x01: case 0x02: return 0;            // bool in field header
+        case 0x03:                                  // byte
+            if (*pos + 1 > n) return TERR_TRUNC;
+            (*pos)++;
+            return 0;
+        case 0x04: case 0x05: case 0x06:            // i16/i32/i64 varints
+            return t_varint(buf, n, pos, &v);
+        case 0x07:                                  // double
+            if (*pos + 8 > n) return TERR_TRUNC;
+            *pos += 8;
+            return 0;
+        case 0x08:                                  // binary
+            rc = t_varint(buf, n, pos, &v);
+            if (rc) return rc;
+            if (v > (u64)T_MAX_CONTAINER) return TERR_CONTAINER;
+            if (*pos + (i64)v > n) return TERR_TRUNC;
+            *pos += (i64)v;
+            return 0;
+        case 0x09: case 0x0A: {                     // list/set
+            if (*pos >= n) return TERR_TRUNC;
+            u8 b = buf[(*pos)++];
+            i64 size = (b >> 4) & 0x0F;
+            int etype = b & 0x0F;
+            if (size == 15) {
+                rc = t_varint(buf, n, pos, &v);
+                if (rc) return rc;
+                if (v > (u64)T_MAX_CONTAINER) return TERR_CONTAINER;
+                size = (i64)v;
+            }
+            if (size > T_MAX_CONTAINER) return TERR_CONTAINER;
+            if (etype == 0x01 || etype == 0x02) {   // bool elems are one byte
+                if (*pos + size > n) return TERR_TRUNC;
+                *pos += size;
+                return 0;
+            }
+            for (i64 i = 0; i < size; i++) {
+                rc = t_skip(buf, n, pos, etype, depth + 1);
+                if (rc) return rc;
+            }
+            return 0;
+        }
+        case 0x0B: {                                // map
+            rc = t_varint(buf, n, pos, &v);
+            if (rc) return rc;
+            if (v > (u64)T_MAX_CONTAINER) return TERR_CONTAINER;
+            if (v) {
+                if (*pos >= n) return TERR_TRUNC;
+                u8 kv = buf[(*pos)++];
+                int kt = (kv >> 4) & 0x0F, vt = kv & 0x0F;
+                for (u64 i = 0; i < v; i++) {
+                    rc = t_skip(buf, n, pos, kt, depth + 1);
+                    if (rc) return rc;
+                    rc = t_skip(buf, n, pos, vt, depth + 1);
+                    if (rc) return rc;
+                }
+            }
+            return 0;
+        }
+        case 0x0C:                                  // struct
+            // python's skip() checks depth at entry (done above) and walks
+            // inner fields at depth+1 — the walker continues at THIS depth
+            return t_skip_struct(buf, n, pos, depth);
+        default:
+            // unknown wire type (13-15): the python engine's skip() raises
+            return TERR_TRUNC;
+    }
+}
+
+// Parse the sub-struct `fids` maps into out slots: for each field id fid in
+// [1, nf], if fid maps to slot s >= 0 and the wire type matches `want`
+// (varint ints) or is a bool (want < 0), record the value + presence bit.
+// wants[fid-1]: 5/6 = zigzag varint of that wire type, -1 = bool, 0 = skip.
+static int t_sub_struct(const u8 *buf, i64 n, i64 *pos, const int8_t *wants,
+                        const int8_t *slots, int nf, i64 *out, u64 *mask) {
+    i64 last = 0;
+    while (1) {
+        if (*pos >= n) return TERR_TRUNC;
+        u8 b = buf[(*pos)++];
+        if ((b & 0x0F) == 0x00) return 0;  // masked-STOP (python parity)
+        int ctype = b & 0x0F;
+        int delta = (b >> 4) & 0x0F;
+        if (delta) {
+            last += delta;
+        } else {
+            i64 fid;
+            int rc = t_zigzag(buf, n, pos, &fid);
+            if (rc) return rc;
+            last = fid;
+        }
+        int want = (last >= 1 && last <= nf) ? wants[last - 1] : 0;
+        int slot = (last >= 1 && last <= nf) ? slots[last - 1] : -1;
+        if (want == -1 && (ctype == 0x01 || ctype == 0x02)) {
+            out[slot] = (ctype == 0x01);
+            *mask |= (u64)1 << slot;
+        } else if (want > 0 && ctype == want) {
+            i64 v;
+            int rc = t_zigzag(buf, n, pos, &v);
+            if (rc) return rc;
+            out[slot] = v;
+            *mask |= (u64)1 << slot;
+        } else if (ctype != 0x01 && ctype != 0x02) {
+            int rc = t_skip(buf, n, pos, ctype, 1);
+            if (rc) return rc;
+        }
+    }
+}
+
+// Slot layout (out i64[20]):
+//   0 type  1 uncompressed_page_size  2 compressed_page_size  3 crc
+//   4 dph.num_values  5 dph.encoding  6 dph.def_level_enc  7 dph.rep_level_enc
+//   8 dict.num_values  9 dict.encoding  10 dict.is_sorted
+//   11 v2.num_values  12 v2.num_nulls  13 v2.num_rows  14 v2.encoding
+//   15 v2.def_levels_byte_length  16 v2.rep_levels_byte_length
+//   17 v2.is_compressed
+//   18 presence mask (bits 0-17 as above; bits 59/60/61/62 =
+//      index/dph/dict/v2 sub-struct present)  19 end position
+// Returns 0 or a TERR_* code.
+i64 tpq_page_header(const u8 *buf, i64 n, i64 pos, i64 *out) {
+    u64 mask = 0;
+    for (int i = 0; i < 18; i++) out[i] = 0;
+    static const int8_t dph_w[5] = {5, 5, 5, 5, 0};
+    static const int8_t dph_s[5] = {4, 5, 6, 7, -1};
+    static const int8_t dict_w[3] = {5, 5, -1};
+    static const int8_t dict_s[3] = {8, 9, 10};
+    static const int8_t v2_w[8] = {5, 5, 5, 5, 5, 5, -1, 0};
+    static const int8_t v2_s[8] = {11, 12, 13, 14, 15, 16, 17, -1};
+    i64 last = 0;
+    while (1) {
+        if (pos >= n) return TERR_TRUNC;
+        u8 b = buf[pos++];
+        if ((b & 0x0F) == 0x00) break;  // masked-STOP (python parity)
+        int ctype = b & 0x0F;
+        int delta = (b >> 4) & 0x0F;
+        if (delta) {
+            last += delta;
+        } else {
+            i64 fid;
+            int rc = t_zigzag(buf, n, &pos, &fid);
+            if (rc) return rc;
+            last = fid;
+        }
+        int rc = 0;
+        if (last >= 1 && last <= 4 && ctype == 0x05) {
+            i64 v;
+            rc = t_zigzag(buf, n, &pos, &v);
+            if (!rc) {
+                out[last - 1] = v;
+                mask |= (u64)1 << (last - 1);
+            }
+        } else if (last == 5 && ctype == 0x0C) {
+            // last occurrence wins (python setattr replaces the object)
+            for (int i = 4; i <= 7; i++) { out[i] = 0; mask &= ~((u64)1 << i); }
+            rc = t_sub_struct(buf, n, &pos, dph_w, dph_s, 5, out, &mask);
+            if (!rc) mask |= (u64)1 << 60;
+        } else if (last == 6 && ctype == 0x0C) {
+            // IndexPageHeader is an empty struct: walk it, record presence
+            rc = t_skip_struct(buf, n, &pos, 0);
+            if (!rc) mask |= (u64)1 << 59;
+        } else if (last == 7 && ctype == 0x0C) {
+            for (int i = 8; i <= 10; i++) { out[i] = 0; mask &= ~((u64)1 << i); }
+            rc = t_sub_struct(buf, n, &pos, dict_w, dict_s, 3, out, &mask);
+            if (!rc) mask |= (u64)1 << 61;
+        } else if (last == 8 && ctype == 0x0C) {
+            for (int i = 11; i <= 17; i++) { out[i] = 0; mask &= ~((u64)1 << i); }
+            rc = t_sub_struct(buf, n, &pos, v2_w, v2_s, 8, out, &mask);
+            if (!rc) mask |= (u64)1 << 62;
+        } else if (ctype != 0x01 && ctype != 0x02) {
+            rc = t_skip(buf, n, &pos, ctype, 0);
+        }
+        if (rc) return rc;
+    }
+    out[18] = (i64)mask;
+    out[19] = pos;
+    return 0;
+}
+
 // DELTA_BYTE_ARRAY prefix stitching (type_bytearray.go:189-292 semantics):
 // value i = previous value's first prefix_lens[i] bytes + suffix i.  The
 // chain is inherently sequential (SURVEY.md §7.4.4) — this runs it at memcpy
