@@ -332,16 +332,13 @@ def page_header(buf: bytes, pos: int = 0):
     reader consumes them); everything else the readers touch is populated,
     including sub-struct presence (a missing DataPageHeader stays None).
     """
-    import numpy as np
-
     lib = load()
     if lib is None:
         return None
-    out = np.zeros(20, dtype=np.int64)
-    rc = lib.tpq_page_header(
-        buf, len(buf), pos,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
-    )
+    # stack-local ctypes array: per-page numpy allocation + data_as cast
+    # would eat a few percent of the win this parser exists for
+    out = (ctypes.c_longlong * 20)()
+    rc = lib.tpq_page_header(buf, len(buf), pos, out)
     if rc < 0:
         return int(rc)
     from ..format import (

@@ -355,7 +355,7 @@ static int t_skip(const u8 *buf, i64 n, i64 *pos, int ctype, int depth);
 
 static int t_skip_struct(const u8 *buf, i64 n, i64 *pos, int depth) {
     if (depth > 32) return TERR_DEPTH;
-    i64 last = 0;
+    u64 last = 0;  // wraps like a machine int; python's unbounded ids only miss lookups
     while (1) {
         if (*pos >= n) return TERR_TRUNC;
         u8 b = buf[(*pos)++];
@@ -366,12 +366,12 @@ static int t_skip_struct(const u8 *buf, i64 n, i64 *pos, int depth) {
         int ctype = b & 0x0F;
         int delta = (b >> 4) & 0x0F;
         if (delta) {
-            last += delta;
+            last += (u64)delta;
         } else {
             i64 fid;
             int rc = t_zigzag(buf, n, pos, &fid);
             if (rc) return rc;
-            last = fid;
+            last = (u64)fid;
         }
         if (ctype != 0x01 && ctype != 0x02) {  // bools carry no payload
             int rc = t_skip(buf, n, pos, ctype, depth + 1);
@@ -459,7 +459,7 @@ static int t_skip(const u8 *buf, i64 n, i64 *pos, int ctype, int depth) {
 // wants[fid-1]: 5/6 = zigzag varint of that wire type, -1 = bool, 0 = skip.
 static int t_sub_struct(const u8 *buf, i64 n, i64 *pos, const int8_t *wants,
                         const int8_t *slots, int nf, i64 *out, u64 *mask) {
-    i64 last = 0;
+    u64 last = 0;  // wrap-safe; range tests below bound all uses
     while (1) {
         if (*pos >= n) return TERR_TRUNC;
         u8 b = buf[(*pos)++];
@@ -467,15 +467,15 @@ static int t_sub_struct(const u8 *buf, i64 n, i64 *pos, const int8_t *wants,
         int ctype = b & 0x0F;
         int delta = (b >> 4) & 0x0F;
         if (delta) {
-            last += delta;
+            last += (u64)delta;
         } else {
             i64 fid;
             int rc = t_zigzag(buf, n, pos, &fid);
             if (rc) return rc;
-            last = fid;
+            last = (u64)fid;
         }
-        int want = (last >= 1 && last <= nf) ? wants[last - 1] : 0;
-        int slot = (last >= 1 && last <= nf) ? slots[last - 1] : -1;
+        int want = (last >= 1 && last <= (u64)nf) ? wants[last - 1] : 0;
+        int slot = (last >= 1 && last <= (u64)nf) ? slots[last - 1] : -1;
         if (want == -1 && (ctype == 0x01 || ctype == 0x02)) {
             out[slot] = (ctype == 0x01);
             *mask |= (u64)1 << slot;
@@ -511,7 +511,7 @@ i64 tpq_page_header(const u8 *buf, i64 n, i64 pos, i64 *out) {
     static const int8_t dict_s[3] = {8, 9, 10};
     static const int8_t v2_w[8] = {5, 5, 5, 5, 5, 5, -1, 0};
     static const int8_t v2_s[8] = {11, 12, 13, 14, 15, 16, 17, -1};
-    i64 last = 0;
+    u64 last = 0;  // wrap-safe field-id accumulator (see t_sub_struct)
     while (1) {
         if (pos >= n) return TERR_TRUNC;
         u8 b = buf[pos++];
