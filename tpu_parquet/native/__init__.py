@@ -103,12 +103,16 @@ def load():
                 c_ll,
             ]
             lib.tpq_hybrid_meta.restype = c_ll
+            # output pointers as c_void_p: the wrapper passes raw addresses
+            # into ONE arena allocation — per-call POINTER() casts on the
+            # hottest wrapper (once per page per stream) cost as much as the
+            # C walk itself
             lib.tpq_hybrid_meta.argtypes = [
                 ctypes.c_char_p, c_ll, c_ll, c_ll, c_ll,
-                p(ctypes.c_longlong), p(ctypes.c_uint8), p(ctypes.c_uint32),
-                p(ctypes.c_longlong), c_ll, p(ctypes.c_longlong),
-                c_ll, p(ctypes.c_uint64),
-                c_ll, ctypes.c_uint64, p(ctypes.c_longlong),
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, c_ll, ctypes.c_void_p,
+                c_ll, ctypes.c_void_p,
+                c_ll, ctypes.c_uint64, ctypes.c_void_p,
             ]
             _lib = lib
         except Exception:
@@ -202,35 +206,44 @@ def hybrid_meta(buf: bytes, n: int, pos: int, width: int, count: int, cap: int,
     lib = load()
     if lib is None:
         return None
-    ends = np.empty(cap, dtype=np.int64)
-    kinds = np.empty(cap, dtype=np.uint8)
-    vals = np.empty(cap, dtype=np.uint32)
-    starts = np.empty(cap, dtype=np.int64)
-    consumed = np.zeros(1, dtype=np.int64)
-    max_out = np.zeros(1, dtype=np.uint64)
-    eq_out = np.zeros(1, dtype=np.int64)
-    pll = ctypes.POINTER(ctypes.c_longlong)
+    # ONE arena for every output (header scalars + 4 run tables), addressed
+    # by raw pointer arithmetic: the previous 7 allocations + 7 POINTER()
+    # casts cost ~as much as the C walk on run-light pages, and this wrapper
+    # runs once per page per stream.  Layout (8-aligned: np.empty data is
+    # 16-aligned, all offsets multiples of 8 until the u32/u8 tails):
+    #   [consumed i64 | max u64 | eq i64 | ends i64*cap | starts i64*cap
+    #    | vals u32*cap | kinds u8*cap]
+    o_ends, o_starts = 24, 24 + 8 * cap
+    o_vals, o_kinds = 24 + 16 * cap, 24 + 20 * cap
+    arena = np.empty(24 + 21 * cap, dtype=np.uint8)
+    arena[:24] = 0  # scalar slots must read 0 when not requested
+    base = arena.ctypes.data
     rc = lib.tpq_hybrid_meta(
         buf, n, pos, width, count,
-        ends.ctypes.data_as(pll),
-        kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-        starts.ctypes.data_as(pll),
-        cap,
-        consumed.ctypes.data_as(pll),
+        base + o_ends, base + o_kinds, base + o_vals, base + o_starts, cap,
+        base,
         1 if want_max else 0,
-        max_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        base + 8,
         0 if eq_target is None else 1,
         0 if eq_target is None else int(eq_target),
-        eq_out.ctypes.data_as(pll),
+        base + 16,
     )
     if rc < 0:
         return int(rc)
     r = int(rc)
-    mx = int(max_out[0]) if want_max else None
-    eq = int(eq_out[0]) if eq_target is not None else None
-    return (r, int(consumed[0]), ends[:r], kinds[:r], vals[:r], starts[:r],
-            mx, eq)
+    head = np.frombuffer(arena, np.int64, 3, 0)
+    # the max slot is u64 in C — an i64 view would return >=2^63 values
+    # (width-64 RLE runs) as negative
+    mx = int(np.frombuffer(arena, np.uint64, 1, 8)[0]) if want_max else None
+    eq = int(head[2]) if eq_target is not None else None
+    return (
+        r, int(head[0]),
+        np.frombuffer(arena, np.int64, r, o_ends),
+        np.frombuffer(arena, np.uint8, r, o_kinds),
+        np.frombuffer(arena, np.uint32, r, o_vals),
+        np.frombuffer(arena, np.int64, r, o_starts),
+        mx, eq,
+    )
 
 
 # meta_parse.cpp error codes → messages (kept aligned with the C enum);
