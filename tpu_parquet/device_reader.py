@@ -229,6 +229,129 @@ def _plain_bytes_pages_jit(buf, lens_base, page_byte_base, page_val_start,
     return offsets, heap
 
 
+@functools.partial(jax.jit, static_argnames=("k", "dtype", "count"))
+def _plain_narrow_jit(buf, base, bias, *, k, dtype, count):
+    """Reconstruct a narrow-transcoded PLAIN INT column.
+
+    The host shipped ``(v - min)`` truncated to ``k`` little-endian bytes per
+    value (see _ChunkAssembler._plan_narrow_ints); this widens and re-biases:
+    ``v = min + zero_extend(bytes)``.  All arithmetic is modular, so the
+    reconstruction is exact for any int range whose *span* fits ``k`` bytes,
+    including negative minima.  ``bias`` is traced (per-chunk data); only
+    (k, dtype, count) key the executable.
+    """
+    raw = jax.lax.dynamic_slice(buf, (base,), (count * k,)).reshape(count, k)
+    lo = jnp.zeros((count,), jnp.uint32)
+    for i in range(min(k, 4)):
+        lo = lo | (raw[:, i].astype(jnp.uint32) << (8 * i))
+    if dtype == "int32":
+        return jax.lax.bitcast_convert_type(
+            bias.astype(jnp.uint32) + lo, jnp.int32
+        )
+    hi = jnp.zeros((count,), jnp.uint32)
+    for i in range(4, k):
+        hi = hi | (raw[:, i].astype(jnp.uint32) << (8 * (i - 4)))
+    u = lo.astype(jnp.uint64) | (hi.astype(jnp.uint64) << 32)
+    return jax.lax.bitcast_convert_type(bias.astype(jnp.uint64) + u, jnp.int64)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_ops", "out_pad", "iters", "dtype", "count", "n_pages"),
+)
+def _snappy_plain_staged_jit(buf, tbase, *, n_ops, out_pad, iters, dtype,
+                             count, n_pages):
+    """Decompress snappy PLAIN pages ON DEVICE and decode their values.
+
+    The host shipped the COMPRESSED page payloads plus tag-walk op tables
+    (native tpq_snappy_plan; see _plan_device_snappy).  Byte movement — the
+    actual decompression — happens here as gathers:
+
+    1. per output byte, find its op (one searchsorted) and compute a source:
+       literal bytes point into the staged compressed stream (>= 0), copy
+       bytes encode their *output-space* source as -(pos)-1 using the
+       periodic form ``dst_start - offset + (i mod offset)``, which maps
+       overlapping (RLE-style) copies straight past their own op;
+    2. resolve copy chains by pointer doubling: ``iters`` rounds of
+       ``S = where(S >= 0, S, S[-S-1])`` — after ceil(log2(depth)) rounds
+       every byte points at a literal (the host computed the exact max chain
+       depth during the tag walk, so ``iters`` is a static bound, no syncs);
+    3. gather each value's bytes through S and bitcast (plain_decode_fixed).
+
+    Output positions past the real total resolve through padded literal ops
+    (src 0) and are never selected by the value gather.  All math is int32 —
+    the planner falls back to host decompression beyond 2 GiB arenas.
+    """
+    o = 0
+    ends = _tslice(buf, tbase, o, n_ops, jnp.int32); o += 4 * n_ops
+    asrc = _tslice(buf, tbase, o, n_ops, jnp.int32); o += 4 * n_ops
+    offs = _tslice(buf, tbase, o, n_ops, jnp.int32); o += 4 * n_ops
+    islit = _tslice(buf, tbase, o, n_ops, jnp.uint8); o += n_ops
+    vbase = _tslice(buf, tbase, o, n_pages, jnp.int32); o += 4 * n_pages
+    vstart = _tslice(buf, tbase, o, n_pages + 1, jnp.int32)
+    j = jnp.arange(out_pad, dtype=jnp.int32)
+    op = jnp.clip(jnp.searchsorted(ends, j, side="right").astype(jnp.int32),
+                  0, n_ops - 1)
+    start = jnp.where(op > 0, ends[jnp.maximum(op - 1, 0)], 0)
+    within = j - start
+    S = jnp.where(
+        islit[op] != 0,
+        asrc[op] + within,
+        -(asrc[op] + within % jnp.maximum(offs[op], 1)) - 1,
+    )
+    for _ in range(iters):
+        t = jnp.clip(-S - 1, 0, out_pad - 1)
+        S = jnp.where(S >= 0, S, S[t])
+    width = 8 if dtype in ("int64", "float64") else 4
+    i = jnp.arange(count, dtype=jnp.int32)
+    p = jnp.clip(
+        jnp.searchsorted(vstart, i, side="right").astype(jnp.int32) - 1,
+        0, n_pages - 1,
+    )
+    vpos = vbase[p] + (i - vstart[p]) * width
+    byte_idx = (vpos[:, None]
+                + jnp.arange(width, dtype=jnp.int32)[None, :]).reshape(-1)
+    src = S[jnp.clip(byte_idx, 0, out_pad - 1)]
+    bts = buf[jnp.clip(src, 0, buf.shape[0] - 1)]
+    return K.plain_decode_fixed(bts, dtype, count)
+
+
+# pointer-doubling round buckets (static arg: executable sharing); 24 covers
+# chains of 2^24 ops — more ops than a 16 MiB page can encode
+_SNAPPY_ITER_BUCKETS = (2, 4, 8, 16, 24)
+# op-table cap: a stream shattered into more ops than this ships decompressed
+# (the table would rival the payload)
+_SNAPPY_MAX_OPS = 1 << 20
+# ratio~1 chunks larger than this take the host-decompress path: the device
+# resolve (searchsorted + doubling gathers over the output space) costs more
+# than host snappy at ~1.4 GB/s once the chunk spans multiple strips
+_SNAPPY_SMALL_OUT = 8 << 20
+
+
+# transcode only when it saves >= 3 bytes/value: below that the extra host
+# pass (min/max + truncating copy) buys too little transfer
+_NARROW_SAVE_BYTES = 3
+# probe the first page's head before scanning the whole chunk: full-range
+# data (8-byte spans) must not pay a full min/max pass just to bail
+_NARROW_PROBE = 65536
+
+
+def _span_bytes(lo: int, hi: int) -> int:
+    """Bytes needed for the unsigned span hi - lo (>= 1)."""
+    return max((int(hi) - int(lo)).bit_length() + 7, 8) // 8
+
+
+def _narrow_max_k(width: int) -> int:
+    """Largest transcoded byte width still worth the host pass.
+
+    Shared by the narrow planner AND _plan_device_snappy's stats-hint
+    routing: the two must agree bit for bit, or a chunk each side expects
+    the other to claim would silently pay host decompression and full-width
+    staging.
+    """
+    return width - (_NARROW_SAVE_BYTES if width == 8 else 2)
+
+
 @functools.partial(jax.jit, static_argnames=("count",))
 def _bool_pages_jit(buf, page_byte_base, page_val_start, *, count):
     """PLAIN booleans across pages: bit position restarts at each page base."""
@@ -631,6 +754,11 @@ class _ChunkAssembler:
         self.dict_ragged: Optional[ByteArrayData] = None
         self.dict_len = 0
         self._deferred = deferred_checks  # (maxima_device_scalar, dict_len, path)
+        # (min, max) int hint from chunk-level Statistics — routes the
+        # device-snappy vs narrow-transcode choice; never trusted for
+        # correctness (see _plan_device_snappy)
+        self.stats_span: "tuple[int, int] | None" = None
+        self.pages_kept_compressed = 0
 
     # -- dictionary ----------------------------------------------------------
 
@@ -659,6 +787,13 @@ class _ChunkAssembler:
             Encoding.RLE_DICTIONARY if e == Encoding.PLAIN_DICTIONARY else e
             for e in encs
         }
+        # lazily-compressed pages are only consumed by the PLAIN fixed-width
+        # route (_plan_device_snappy); every other route gets host bytes
+        if any(p.comp is not None for p in self.pages) and not (
+            encs == {Encoding.PLAIN} and leaf.physical_type in _PTYPE_TO_NAME
+        ):
+            for p in self.pages:
+                p.materialize()
         slots_pad = _bucket_count(slots)
         d_plan = r_plan = None
         if leaf.max_def > 0:
@@ -825,11 +960,228 @@ class _ChunkAssembler:
 
     def _finish_plain_fixed(self, common, stager):
         name = _PTYPE_TO_NAME[self.leaf.physical_type]
+        if any(p.comp is not None for p in self.pages):
+            plan = self._plan_device_snappy(common, stager, name)
+            if plan is not None:
+                return plan
+            for p in self.pages:
+                p.materialize()
+        if name in ("int32", "int64"):
+            plan = self._plan_narrow_ints(common, stager, name)
+            if plan is not None:
+                return plan
         base, defined, count = self._stage_fixed_width(
             stager, np.dtype(name).itemsize
         )
         return lambda buf_dev: DeviceColumnData(
             values=_plain_jit(buf_dev, np.int64(base), dtype=name, count=count),
+            n_values=defined,
+            **common,
+        )
+
+    def _plan_device_snappy(self, common, stager, name: str):
+        """Ship COMPRESSED snappy PLAIN pages; decompress + decode on device.
+
+        Host work per page collapses to the native tag walk (~1 byte touched
+        per ~60 payload bytes) — no decompression, no value copies; the
+        staged transfer carries the compressed stream.  See
+        _snappy_plain_staged_jit for the device side.  Returns None when the
+        chunk should fall back (narrow-int stats hint, 2 GiB i32 ceiling,
+        shattered op tables, native library absent) — the caller then
+        materializes and takes the standard host paths.
+        """
+        from . import native
+
+        width = np.dtype(name).itemsize
+        # stats hint: a narrow int span means host decompress + narrow
+        # transcode ships FEWER bytes than the compressed stream — prefer
+        # it.  The transcode recomputes real min/max, so lying stats cost
+        # only the decompress, never correctness.
+        if name in ("int32", "int64") and self.stats_span is not None:
+            lo, hi = self.stats_span
+            if _span_bytes(lo, hi) <= _narrow_max_k(width):
+                return None
+        total_out = 0
+        n_ops_total = 0
+        plans = []
+        for p in self.pages:
+            if p.comp is not None:
+                payload, _codec, ulen = p.comp
+                if ulen < p.defined * width:
+                    raise ParquetError(
+                        f"PLAIN data truncated: {ulen} < {p.defined * width}"
+                    )
+                r = native.snappy_plan(payload, ulen)
+                if r is None:
+                    return None
+                if isinstance(r, int):
+                    # malformed stream: materialize so the standard codec
+                    # diagnostics raise (same reject set as the planner)
+                    p.materialize()
+                    return None
+                plans.append((p, r, ulen))
+                n_ops_total += len(r[0])
+                total_out += ulen
+            else:
+                nbytes = len(p.raw) - p.value_pos
+                if nbytes < p.defined * width:
+                    raise ParquetError(
+                        f"PLAIN data truncated: {nbytes} < {p.defined * width}"
+                    )
+                plans.append((p, None, nbytes))
+                n_ops_total += 1
+                total_out += nbytes
+        if n_ops_total == 0 or n_ops_total > _SNAPPY_MAX_OPS:
+            return None
+        # worth-it gate (measured on v5e): shipping compressed pays for the
+        # device-side resolve whenever the stream actually compressed; at
+        # ratio ~1 the only win is the skipped host decompress, which beats
+        # the resolve cost on small chunks but loses on multi-strip ones
+        lazy_out = sum(size for _, r, size in plans if r is not None)
+        comp_bytes = sum(len(p.comp[0]) for p, r, _ in plans if r is not None)
+        if (lazy_out > 0 and comp_bytes > (1 - 0.08) * lazy_out
+                and lazy_out > _SNAPPY_SMALL_OUT):
+            return None
+        out_pad = _bucket_bytes(total_out + 8, 8)
+        # staged segments: the COMPRESSED payload for lazy pages (that is the
+        # whole point), the raw value bytes for already-materialized ones
+        segs = [
+            (p.comp[0], 0, len(p.comp[0])) if r is not None
+            else (p.raw, p.value_pos, size)
+            for p, r, size in plans
+        ]
+        if (stager.total + sum(s[2] for s in segs) + 13 * n_ops_total
+                + out_pad > (np.iinfo(np.int32).max >> 1)):
+            return None  # i32 source/table math would overflow
+        bases = stager.add_segments(segs)
+        ends = np.empty(n_ops_total, np.int64)
+        asrc = np.empty(n_ops_total, np.int64)
+        offs = np.zeros(n_ops_total, np.int32)
+        islit = np.empty(n_ops_total, np.uint8)
+        vbase = np.zeros(len(plans), np.int64)
+        vstart = np.zeros(len(plans) + 1, np.int64)
+        at = 0
+        out_base = 0
+        max_depth = 0
+        for i, (p, r, size) in enumerate(plans):
+            vbase[i] = out_base  # value_pos == 0 on every lazy-eligible page
+            vstart[i + 1] = vstart[i] + p.defined
+            if r is None:
+                ends[at] = out_base + size
+                asrc[at] = bases[i]
+                islit[at] = 1
+                at += 1
+            else:
+                dst_end, op_src, is_lit_p, depth = r
+                n = len(dst_end)
+                ends[at : at + n] = dst_end + out_base
+                # literal: absolute staged position of the run's payload;
+                # copy: chunk-out source base  dst_start - offset
+                starts = np.empty(n, np.int64)
+                starts[0] = 0
+                starts[1:] = dst_end[:-1]
+                asrc[at : at + n] = np.where(
+                    is_lit_p != 0, op_src + bases[i],
+                    out_base + starts - op_src,
+                )
+                offs[at : at + n] = np.where(is_lit_p != 0, 1, op_src)
+                islit[at : at + n] = is_lit_p
+                at += n
+                max_depth = max(max_depth, depth)
+            out_base += size
+        iters = next(
+            (b for b in _SNAPPY_ITER_BUCKETS
+             if (1 << b) >= max_depth + 1), _SNAPPY_ITER_BUCKETS[-1]
+        ) if max_depth > 0 else 0
+        n_ops_pad = _bucket(n_ops_total)
+        pages_pad = _bucket(len(plans))
+        ends_t = np.full(n_ops_pad, out_pad, np.int32)
+        ends_t[:n_ops_total] = ends
+        asrc_t = np.zeros(n_ops_pad, np.int32)
+        asrc_t[:n_ops_total] = asrc
+        offs_t = np.ones(n_ops_pad, np.int32)
+        offs_t[:n_ops_total] = offs
+        islit_t = np.ones(n_ops_pad, np.uint8)
+        islit_t[:n_ops_total] = islit
+        vbase_t = np.zeros(pages_pad, np.int32)
+        vbase_t[: len(plans)] = vbase
+        vstart_t = np.full(pages_pad + 1, vstart[-1], np.int32)
+        vstart_t[: len(plans) + 1] = vstart
+        tbase = _pack_tables(
+            stager, [ends_t, asrc_t, offs_t, islit_t, vbase_t, vstart_t]
+        )
+        defined = int(vstart[-1])
+        count = _bucket_count(defined)
+        self.pages_kept_compressed = len([1 for _, r, _ in plans if r])
+        return lambda buf_dev: DeviceColumnData(
+            values=_snappy_plain_staged_jit(
+                buf_dev, np.int64(tbase), n_ops=n_ops_pad, out_pad=out_pad,
+                iters=iters, dtype=name, count=count, n_pages=pages_pad,
+            ),
+            n_values=defined,
+            **common,
+        )
+
+    def _plan_narrow_ints(self, common, stager, name: str):
+        """Narrow transcode for PLAIN INT columns: ship ``v - min`` truncated
+        to the minimal byte width instead of full-width values.
+
+        Real-world int64 columns are overwhelmingly narrow-ranged (ids,
+        dates, quantities — TPC-H l_partkey spans 18 bits, shipped 8 bytes
+        wide by PLAIN), and the tunneled host→device link is the scarce
+        resource the whole reader is engineered around.  The host is already
+        touching these bytes (decompress), so one extra vectorized pass
+        (min/max + truncating copy) buys a (width-k)/width transfer cut; the
+        device widens and re-biases in one fused kernel (_plain_narrow_jit).
+        Returns None (caller takes the plain path) when the span probe shows
+        < _NARROW_SAVE_BYTES savings, so full-range data pays only a 64k-value
+        probe, not a full scan.
+        """
+        from . import native
+
+        width = np.dtype(name).itemsize
+        for p in self.pages:
+            if len(p.raw) - p.value_pos < p.defined * width:
+                raise ParquetError(
+                    f"PLAIN data truncated: {len(p.raw) - p.value_pos} "
+                    f"< {p.defined * width}"
+                )
+        defined = sum(p.defined for p in self.pages)
+        if defined == 0 or not native.available():
+            return None
+
+        # int64 must save >= 3 bytes/value, int32 >= 2 (half the width)
+        max_k = _narrow_max_k(width)
+        probe = next(p for p in self.pages if p.defined)
+        head = native.int_minmax(
+            probe.raw, probe.value_pos, min(probe.defined, _NARROW_PROBE),
+            width,
+        )
+        if _span_bytes(*head) > max_k:
+            return None
+        mms = [native.int_minmax(p.raw, p.value_pos, p.defined, width)
+               for p in self.pages if p.defined]
+        mn = min(m[0] for m in mms)
+        mx = max(m[1] for m in mms)
+        k = _span_bytes(mn, mx)
+        if k > max_k:
+            return None
+        # one truncating pass per page, written straight into a single dense
+        # buffer: (v - min) mod 2^width wraps to a value that fits k bytes by
+        # construction (negative minima included)
+        out = np.empty(defined * k, dtype=np.uint8)
+        at = 0
+        for p in self.pages:
+            native.int_truncate(p.raw, p.value_pos, p.defined, width, mn, k,
+                                out[at:])
+            at += p.defined * k
+        count = _bucket_count(defined)
+        base = stager.add(out)
+        stager.note_read_extent(base, count * k)
+        bias = np.int32(mn) if name == "int32" else np.int64(mn)
+        return lambda buf_dev: DeviceColumnData(
+            values=_plain_narrow_jit(buf_dev, np.int64(base), bias,
+                                     k=k, dtype=name, count=count),
             n_values=defined,
             **common,
         )
@@ -1362,9 +1714,24 @@ class _ChunkAssembler:
 def _collect_chunk(
     buf: bytes, codec: int, total_values: int, leaf: SchemaNode,
     deferred_checks: list, validate_crc: bool = False, alloc=None,
+    statistics=None,
 ) -> Optional[_ChunkAssembler]:
     """Walk a chunk's pages into an assembler (host phase); None if no data."""
+    from .format import CompressionCodec
+
     asm = _ChunkAssembler(leaf, deferred_checks)
+    asm.stats_span = _int_stats_span(statistics, leaf)
+    # fixed-width PLAIN SNAPPY chunks can skip host decompression entirely
+    # (device-side expansion, _plan_device_snappy); parse_data_page applies
+    # the per-page structural conditions (PLAIN encoding, levels outside the
+    # compressed region)
+    lazy = (codec == CompressionCodec.SNAPPY
+            and leaf.physical_type in _PTYPE_TO_NAME
+            and os.environ.get("TPQ_DEVICE_SNAPPY", "1") != "0")
+    if lazy:
+        from . import native
+
+        lazy = native.available()
     for ps in walk_pages(buf, total_values):
         header = ps.header
         pt = header.type
@@ -1380,11 +1747,36 @@ def _collect_chunk(
         if pt in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
             asm.pages.append(
                 parse_data_page(ps, buf, codec, leaf, validate_crc=validate_crc,
-                                alloc=alloc, decode_levels=False)
+                                alloc=alloc, decode_levels=False,
+                                lazy_decompress=lazy)
             )
             continue
         # index/unknown pages: skip
     return asm if asm.pages else None
+
+
+def _int_stats_span(statistics, leaf: SchemaNode) -> "tuple[int, int] | None":
+    """Decode chunk Statistics min/max into an int span hint, if plausible.
+
+    Returns (min, max) for INT32/INT64 leaves whose stats carry well-formed
+    PLAIN-encoded bounds, else None.  Used only to ROUTE between transfer
+    strategies (never for correctness), so malformed or lying stats are
+    simply ignored.
+    """
+    if statistics is None or leaf.physical_type not in (Type.INT32, Type.INT64):
+        return None
+    width = 8 if leaf.physical_type == Type.INT64 else 4
+    dt = "<i8" if width == 8 else "<i4"
+    lo = statistics.min_value if statistics.min_value is not None else statistics.min
+    hi = statistics.max_value if statistics.max_value is not None else statistics.max
+    if (not isinstance(lo, (bytes, bytearray)) or len(lo) != width
+            or not isinstance(hi, (bytes, bytearray)) or len(hi) != width):
+        return None
+    lo_v = int(np.frombuffer(lo, dt)[0])
+    hi_v = int(np.frombuffer(hi, dt)[0])
+    if lo_v > hi_v:
+        return None
+    return lo_v, hi_v
 
 
 @scoped_x64
@@ -1414,6 +1806,7 @@ class ReaderStats:
     row_groups: int = 0
     chunks: int = 0
     pages: int = 0
+    pages_device_expanded: int = 0  # pages shipped compressed (device snappy)
     rows: int = 0
     compressed_bytes: int = 0      # chunk bytes read from the file
     staged_bytes: int = 0          # HBM bytes shipped (row-group buffers)
@@ -1437,7 +1830,9 @@ class ReaderStats:
     def as_dict(self) -> dict:
         return {
             "row_groups": self.row_groups, "chunks": self.chunks,
-            "pages": self.pages, "rows": self.rows,
+            "pages": self.pages,
+            "pages_device_expanded": self.pages_device_expanded,
+            "rows": self.rows,
             "compressed_bytes": self.compressed_bytes,
             "staged_bytes": self.staged_bytes,
             "host_seconds": round(self.host_seconds, 6),
@@ -1551,6 +1946,7 @@ class DeviceFileReader:
             asm = _collect_chunk(
                 buf, md.codec, md.num_values, leaf, self._deferred,
                 validate_crc=self.validate_crc, alloc=self.alloc,
+                statistics=md.statistics,
             )
             if asm is not None:
                 self._stats.pages += len(asm.pages)
@@ -1563,6 +1959,7 @@ class DeviceFileReader:
                 )
                 continue
             plans.append((name, asm.finish(stager)))
+            self._stats.pages_device_expanded += asm.pages_kept_compressed
         # every selected leaf must have a chunk in the row group (host
         # FileReader parity — reader.py read_row_group's missing check)
         seen = set(out) | {name for name, _ in plans}

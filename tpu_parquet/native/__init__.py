@@ -102,6 +102,21 @@ def load():
                 p(ctypes.c_longlong), p(ctypes.c_int32), p(ctypes.c_uint64),
                 c_ll,
             ]
+            lib.tpq_snappy_plan.restype = c_ll
+            lib.tpq_snappy_plan.argtypes = [
+                ctypes.c_char_p, c_ll, c_ll,
+                p(c_ll), p(c_ll), p(ctypes.c_uint8), c_ll,
+                p(c_ll), c_ll, p(c_ll),
+            ]
+            lib.tpq_int_minmax.restype = None
+            lib.tpq_int_minmax.argtypes = [
+                ctypes.c_char_p, c_ll, c_ll, ctypes.c_int, p(c_ll),
+            ]
+            lib.tpq_int_truncate.restype = None
+            lib.tpq_int_truncate.argtypes = [
+                ctypes.c_char_p, c_ll, c_ll, ctypes.c_int, ctypes.c_uint64,
+                ctypes.c_int, ctypes.c_void_p,
+            ]
             lib.tpq_hybrid_meta.restype = c_ll
             # output pointers as c_void_p: the wrapper passes raw addresses
             # into ONE arena allocation — per-call POINTER() casts on the
@@ -334,6 +349,86 @@ def bytearray_lengths(buf: bytes, count: int, pos: int = 0):
     if rc < 0:
         return int(rc)
     return lens, int(rc)
+
+
+def snappy_plan(payload: bytes, expect: int):
+    """Parse a raw snappy stream's TAG STRUCTURE only (no byte movement).
+
+    Returns (dst_end int64[nops], op_src int64[nops], is_lit uint8[nops],
+    max_chain_depth int) where dst_end is each op's cumulative output end,
+    op_src is a literal run's payload offset in the COMPRESSED stream or a
+    copy's back-reference offset, and max_chain_depth bounds the
+    pointer-doubling rounds the device resolver needs
+    (device_reader._plan_device_snappy).  Validates the whole stream with the
+    same reject set as tpq_snappy_decompress.  Returns a negative error code
+    on malformed input, or None when the native library is unavailable.
+    """
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    n = len(payload)
+    full_cap = n // 2 + 2  # provable worst case: every op >= 2 stream bytes
+    # normal streams carry one op per ~60 bytes; start small and retry on
+    # ERR_CAP — allocating (and zeroing the depth tree for) the worst case
+    # up front costs more than the walk itself on multi-MB pages
+    cap = min(full_cap, max(n // 32, 64))
+    pll = ctypes.POINTER(ctypes.c_longlong)
+    while True:
+        cap2 = 1
+        while cap2 < cap:
+            cap2 <<= 1
+        dst_end = np.empty(cap, dtype=np.int64)
+        op_src = np.empty(cap, dtype=np.int64)
+        is_lit = np.empty(cap, dtype=np.uint8)
+        seg = np.zeros(2 * cap2, dtype=np.int64)  # zeroed: depth maxima
+        out = np.zeros(2, dtype=np.int64)
+        rc = lib.tpq_snappy_plan(
+            payload, n, expect,
+            dst_end.ctypes.data_as(pll), op_src.ctypes.data_as(pll),
+            is_lit.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+            seg.ctypes.data_as(pll), cap2, out.ctypes.data_as(pll),
+        )
+        if rc == -10 and cap < full_cap:
+            cap = min(full_cap, cap * 8)
+            continue
+        if rc < 0:
+            return int(rc)
+        r = int(rc)
+        return dst_end[:r], op_src[:r], is_lit[:r], int(out[1])
+
+
+def int_minmax(buf: bytes, pos: int, n: int, width: int):
+    """Min/max of ``n`` little-endian signed ``width``-byte ints at buf+pos.
+
+    Returns (min, max) as python ints, or None when the native library is
+    unavailable (caller falls back to numpy)."""
+    import numpy as np
+
+    lib = load()
+    if lib is None or n <= 0:
+        return None
+    out = np.empty(2, dtype=np.int64)
+    lib.tpq_int_minmax(
+        buf, pos, n, width,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+    )
+    return int(out[0]), int(out[1])
+
+
+def int_truncate(buf: bytes, pos: int, n: int, width: int, bias: int, k: int,
+                 dst) -> bool:
+    """Write ``(v - bias) mod 2**(8*width)`` truncated to k bytes per value
+    into ``dst`` (uint8 numpy array, >= n*k bytes).  Returns False when the
+    native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return False
+    lib.tpq_int_truncate(buf, pos, n, width,
+                         ctypes.c_uint64(bias % (1 << 64)), k,
+                         dst.ctypes.data)
+    return True
 
 
 def page_header(buf: bytes, pos: int = 0):

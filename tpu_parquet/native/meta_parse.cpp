@@ -584,4 +584,197 @@ i64 tpq_delta_ba_stitch(const i64 *prefix_lens, const i64 *suf_off,
     return 0;
 }
 
+// Narrow-int transcode support (device_reader._plan_narrow_ints): the host
+// link is the scarce resource, so PLAIN INT columns whose value span fits in
+// k < width bytes ship as (v - min) truncated to k little-endian bytes.
+// These two passes replace a 4-temp numpy pipeline (min, max, subtract,
+// strided copy) with two streaming loops gcc auto-vectorizes; unaligned
+// sources are handled with memcpy loads (pages start at arbitrary offsets).
+
+// min/max of n little-endian signed width-byte ints at buf+pos; width 4 or 8.
+// Writes out[0]=min, out[1]=max.  n==0 leaves out untouched (caller guards).
+void tpq_int_minmax(const u8 *buf, i64 pos, i64 n, int width, i64 *out) {
+    const u8 *src = buf + pos;
+    if (n <= 0) return;
+    if (width == 8) {
+        i64 mn = INT64_MAX, mx = INT64_MIN;
+        for (i64 i = 0; i < n; i++) {
+            i64 v;
+            __builtin_memcpy(&v, src + i * 8, 8);
+            if (v < mn) mn = v;
+            if (v > mx) mx = v;
+        }
+        out[0] = mn;
+        out[1] = mx;
+    } else {
+        int32_t mn = INT32_MAX, mx = INT32_MIN;
+        for (i64 i = 0; i < n; i++) {
+            int32_t v;
+            __builtin_memcpy(&v, src + i * 4, 4);
+            if (v < mn) mn = v;
+            if (v > mx) mx = v;
+        }
+        out[0] = mn;
+        out[1] = mx;
+    }
+}
+
+// Write (v - bias) mod 2^(8*width) truncated to its k low bytes, for each of
+// n width-byte values at buf+pos, densely into dst (n*k bytes).  The caller
+// guarantees the span fits k bytes, so truncation is lossless.
+void tpq_int_truncate(const u8 *buf, i64 pos, i64 n, int width, u64 bias,
+                      int k, u8 *dst) {
+    const u8 *src = buf + pos;
+    for (i64 i = 0; i < n; i++) {
+        u64 v = 0;
+        __builtin_memcpy(&v, src + i * width, width);
+        u64 d = v - bias;
+        __builtin_memcpy(dst + i * k, &d, k);  // little-endian low bytes
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Device-side snappy expansion: the host parses ONLY the tag structure of a
+// raw snappy stream into op tables; the actual byte movement (literal
+// stitching + back-reference resolution) runs on the TPU as gathers
+// (device_reader._plan_device_snappy).  This walk touches ~1 tag byte per
+// ~60 payload bytes, so eligible pages skip host decompression entirely and
+// ship compressed.
+//
+// Per op i (in stream order, output-contiguous):
+//   dst_end[i]  cumulative output end of op i (within this stream)
+//   src[i]      literal: byte offset of the run's payload in the COMPRESSED
+//               stream; copy: the back-reference offset
+//   is_lit[i]   1 literal / 0 copy
+// Copy semantics for the device: output byte dst_start+j of a copy op reads
+// output position dst_start - offset + (j mod offset) — the mod form makes
+// overlapping (RLE-style) copies jump straight past the op, so every chain
+// hop crosses an op boundary and pointer-doubling converges in
+// log2(max_chain_depth) rounds.  The exact max depth is computed here with
+// an incremental segment-tree max over op slots.
+//
+// Returns n_ops >= 0, or a negative TERR-style code on malformed input
+// (same reject set as tpq_snappy_decompress).  out[0] = uncompressed size,
+// out[1] = max chain depth.  cap is the op-table capacity; -10 = cap
+// exceeded (callers size cap = n/2+2, the provable worst case, so -10 is
+// unreachable from that sizing).
+
+static inline i64 seg_query(const i64 *tree, i64 cap2, i64 lo, i64 hi) {
+    // max over [lo, hi) of the segment tree (iterative, 0-based leaves)
+    i64 best = 0;
+    for (lo += cap2, hi += cap2; lo < hi; lo >>= 1, hi >>= 1) {
+        if (lo & 1) { if (tree[lo] > best) best = tree[lo]; lo++; }
+        if (hi & 1) { hi--; if (tree[hi] > best) best = tree[hi]; }
+    }
+    return best;
+}
+
+static inline void seg_update(i64 *tree, i64 cap2, i64 i, i64 v) {
+    i += cap2;
+    tree[i] = v;
+    for (i >>= 1; i >= 1; i >>= 1) {
+        i64 m = tree[2 * i] > tree[2 * i + 1] ? tree[2 * i] : tree[2 * i + 1];
+        if (tree[i] == m) break;
+        tree[i] = m;
+    }
+}
+
+i64 tpq_snappy_plan(const u8 *src, i64 n, i64 expect,
+                    i64 *dst_end, i64 *op_src, u8 *is_lit, i64 cap,
+                    i64 *seg_tree, i64 cap2, i64 *out) {
+    i64 pos = 0;
+    // uncompressed-length uvarint
+    u64 ulen = 0;
+    int shift = 0;
+    while (1) {
+        if (pos >= n) return -2;
+        u8 b = src[pos++];
+        if (shift == 28 && (b & 0xf0)) return -2;
+        ulen |= (u64)(b & 0x7f) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+        if (shift > 28) return -2;
+    }
+    if ((i64)ulen != expect) return -3;
+    i64 outp = 0, nops = 0, maxdepth = 0;
+    while (pos < n) {
+        u8 tag = src[pos++];
+        u32 kind = tag & 3;
+        i64 len, offset = 0;
+        if (kind == 0) {  // literal
+            len = tag >> 2;
+            if (len >= 60) {
+                i64 extra = len - 59;
+                if (pos + extra > n) return -4;
+                len = 0;
+                for (i64 i = 0; i < extra; i++)
+                    len |= (i64)src[pos + i] << (8 * i);
+                pos += extra;
+            }
+            len += 1;
+            if (pos + len > n || outp + len > (i64)ulen) return -5;
+            if (nops >= cap) return -10;
+            dst_end[nops] = outp + len;
+            op_src[nops] = pos;
+            is_lit[nops] = 1;
+            seg_update(seg_tree, cap2, nops, 0);
+            nops++;
+            pos += len;
+            outp += len;
+        } else {
+            if (kind == 1) {
+                if (pos >= n) return -6;
+                len = ((tag >> 2) & 7) + 4;
+                offset = ((i64)(tag >> 5) << 8) | src[pos];
+                pos += 1;
+            } else if (kind == 2) {
+                if (pos + 2 > n) return -6;
+                len = (tag >> 2) + 1;
+                offset = (i64)src[pos] | ((i64)src[pos + 1] << 8);
+                pos += 2;
+            } else {
+                if (pos + 4 > n) return -6;
+                len = (tag >> 2) + 1;
+                offset = (i64)src[pos] | ((i64)src[pos + 1] << 8) |
+                         ((i64)src[pos + 2] << 16) | ((i64)src[pos + 3] << 24);
+                pos += 4;
+            }
+            if (offset == 0 || offset > outp) return -7;
+            if (outp + len > (i64)ulen) return -8;
+            if (nops >= cap) return -10;
+            // chain depth: 1 + max depth of ops covering the source range
+            // [outp-offset, min(outp, outp-offset+len)) — the mod form never
+            // reads at/after outp
+            i64 s = outp - offset;
+            i64 e = s + len < outp ? s + len : outp;
+            // ops covering [s, e): first op with dst_end > s .. first with
+            // dst_end >= e (inclusive) — binary search over dst_end[0..nops)
+            i64 lo = 0, hi = nops;
+            while (lo < hi) {
+                i64 mid = (lo + hi) >> 1;
+                if (dst_end[mid] > s) hi = mid; else lo = mid + 1;
+            }
+            i64 j1 = lo;
+            lo = 0; hi = nops;
+            while (lo < hi) {
+                i64 mid = (lo + hi) >> 1;
+                if (dst_end[mid] >= e) hi = mid; else lo = mid + 1;
+            }
+            i64 j2 = lo < nops ? lo + 1 : nops;
+            i64 d = 1 + seg_query(seg_tree, cap2, j1, j2);
+            if (d > maxdepth) maxdepth = d;
+            dst_end[nops] = outp + len;
+            op_src[nops] = offset;
+            is_lit[nops] = 0;
+            seg_update(seg_tree, cap2, nops, d);
+            nops++;
+            outp += len;
+        }
+    }
+    if (outp != (i64)ulen) return -9;
+    out[0] = outp;
+    out[1] = maxdepth;
+    return nops;
+}
+
 }  // extern "C"
