@@ -115,22 +115,37 @@ class DeviceDictColumn(DeviceColumnData):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("values_per_mini", "count", "bits", "max_width", "total",
-                     "n_pages", "m_max"),
+    static_argnames=("values_per_mini", "mb", "count", "bits", "max_width",
+                     "total", "n_pages", "m_max"),
 )
-def _delta_pages_staged_jit(buf, tbase, *, values_per_mini, count, bits,
+def _delta_pages_staged_jit(buf, tbase, *, values_per_mini, mb, count, bits,
                             max_width, total, n_pages, m_max):
-    """_delta_pages_jit with every metadata table read from the staged
-    buffer at ``tbase`` (layout: firsts i64[P] | starts i64[P,M] |
-    widths i32[P,M] | mins u64[P,M] | page_starts i64[P+1]) — one transfer
-    per row group instead of five per delta chunk."""
+    """_delta_pages_jit with COMPACT metadata tables read from the staged
+    buffer at ``tbase``.
+
+    The format carries one min-delta varint and one payload position per
+    BLOCK (``mb`` miniblocks), and miniblock payloads are contiguous within
+    a block — so the tables ship per-block starts/mins plus one width BYTE
+    per mini (layout: firsts i64[P] | block_starts i32[P,B] | widths u8[P,M]
+    | block_mins u64[P,B] | page_starts i64[P+1], B = M/mb), ~4 bytes per
+    mini instead of the 20 of the round-3 per-mini tables — the tables were
+    rivaling the payload bytes on 32-value-mini streams.  Per-mini starts
+    and mins expand here in-graph (a within-block exclusive cumsum of the
+    widths and a repeat)."""
     P, M = n_pages, m_max
+    B = M // mb
     o = 0
     firsts = _tslice(buf, tbase, o, P, jnp.int64); o += P * 8
-    starts = _tslice(buf, tbase, o, P * M, jnp.int64).reshape(P, M); o += P * M * 8
-    widths = _tslice(buf, tbase, o, P * M, jnp.int32).reshape(P, M); o += P * M * 4
-    mins = _tslice(buf, tbase, o, P * M, jnp.uint64).reshape(P, M); o += P * M * 8
+    bstarts = _tslice(buf, tbase, o, P * B, jnp.int32).reshape(P, B); o += P * B * 4
+    widths_u8 = _tslice(buf, tbase, o, P * M, jnp.uint8).reshape(P, M); o += P * M
+    bmins = _tslice(buf, tbase, o, P * B, jnp.uint64).reshape(P, B); o += P * B * 8
     page_starts = _tslice(buf, tbase, o, P + 1, jnp.int64)
+    widths = widths_u8.astype(jnp.int32)
+    bpm = (widths * (values_per_mini // 8)).reshape(P, B, mb)
+    excl = jnp.cumsum(bpm, axis=-1) - bpm  # within-block byte offsets
+    starts = ((bstarts.astype(jnp.int64)[:, :, None] + excl)
+              .reshape(P, M)) * 8  # bit starts (minis are byte-aligned)
+    mins = jnp.repeat(bmins, mb, axis=1)
     return _delta_pages_jit(
         buf, firsts, starts, widths, mins, page_starts,
         values_per_mini=values_per_mini, count=count, bits=bits,
@@ -334,6 +349,18 @@ _NARROW_SAVE_BYTES = 3
 # probe the first page's head before scanning the whole chunk: full-range
 # data (8-byte spans) must not pay a full min/max pass just to bail
 _NARROW_PROBE = 65536
+
+
+def _check_plain_sizes(pages, width: int) -> None:
+    """Reject PLAIN pages whose value stream is shorter than defined*width
+    (shared by every fixed-width staging/transcode/expansion planner)."""
+    for p in pages:
+        nbytes = (p.comp[2] if p.comp is not None
+                  else len(p.raw) - p.value_pos)
+        if nbytes < p.defined * width:
+            raise ParquetError(
+                f"PLAIN data truncated: {nbytes} < {p.defined * width}"
+            )
 
 
 def _span_bytes(lo: int, hi: int) -> int:
@@ -945,12 +972,7 @@ class _ChunkAssembler:
         one executable is shared across chunks.
         """
         defined = sum(p.defined for p in self.pages)
-        for p in self.pages:
-            if len(p.raw) - p.value_pos < p.defined * width:
-                raise ParquetError(
-                    f"PLAIN data truncated: {len(p.raw) - p.value_pos} "
-                    f"< {p.defined * width}"
-                )
+        _check_plain_sizes(self.pages, width)
         segs = [(p.raw, p.value_pos, p.defined * width) for p in self.pages]
         base = (int(stager.add_segments(segs)[0]) if segs
                 else stager._reserve(0, None))
@@ -1001,16 +1023,13 @@ class _ChunkAssembler:
             lo, hi = self.stats_span
             if _span_bytes(lo, hi) <= _narrow_max_k(width):
                 return None
+        _check_plain_sizes(self.pages, width)
         total_out = 0
         n_ops_total = 0
         plans = []
         for p in self.pages:
             if p.comp is not None:
                 payload, _codec, ulen = p.comp
-                if ulen < p.defined * width:
-                    raise ParquetError(
-                        f"PLAIN data truncated: {ulen} < {p.defined * width}"
-                    )
                 r = native.snappy_plan(payload, ulen)
                 if r is None:
                     return None
@@ -1024,10 +1043,6 @@ class _ChunkAssembler:
                 total_out += ulen
             else:
                 nbytes = len(p.raw) - p.value_pos
-                if nbytes < p.defined * width:
-                    raise ParquetError(
-                        f"PLAIN data truncated: {nbytes} < {p.defined * width}"
-                    )
                 plans.append((p, None, nbytes))
                 n_ops_total += 1
                 total_out += nbytes
@@ -1140,12 +1155,7 @@ class _ChunkAssembler:
         from . import native
 
         width = np.dtype(name).itemsize
-        for p in self.pages:
-            if len(p.raw) - p.value_pos < p.defined * width:
-                raise ParquetError(
-                    f"PLAIN data truncated: {len(p.raw) - p.value_pos} "
-                    f"< {p.defined * width}"
-                )
+        _check_plain_sizes(self.pages, width)
         defined = sum(p.defined for p in self.pages)
         if defined == 0 or not native.available():
             return None
@@ -1520,21 +1530,49 @@ class _ChunkAssembler:
             # spec-legal but rare: block geometry differs across pages;
             # page-at-a-time fallback rather than a per-page-geometry kernel
             return self._finish_host(common)
+        # minis-per-block from the stream's own header varints (the walker's
+        # return contract carries only values_per_mini); geometry is constant
+        # per stream and already validated by the walk
+        from .kernels.delta import _read_uvarint
+
+        mbs = set()
+        for p in self.pages:
+            bsz, p2 = _read_uvarint(p.raw, p.value_pos)
+            mpb, _ = _read_uvarint(p.raw, p2)
+            mbs.add(mpb)
+        if len(mbs) != 1:
+            return self._finish_host(common)
+        mb = mbs.pop()
+        if any((m.mini_bit_starts & 7).any() for m in metas):
+            # miniblocks are byte-aligned by construction; anything else
+            # means a walker change this compact path no longer matches
+            return self._finish_host(common)
+        if (stager.total + sum(len(p.raw) - p.value_pos for p in self.pages)
+                > np.iinfo(np.int32).max):
+            # block byte starts are staged as i32 (checked before any stager
+            # mutation so the fallback leaves no dead bytes)
+            return self._finish_host(common)
         bases = self._value_segments(stager)
-        # every static shape bucketed; real geometry rides the traced tables
+        # every static shape bucketed; real geometry rides the traced tables.
+        # Tables are COMPACT (see _delta_pages_staged_jit): per-BLOCK byte
+        # starts + mins, one width byte per mini.
         n_pages = _bucket(len(metas))
         count = _bucket_count(max(m.count for m in metas))
         m_max = _bucket(max(m.mini_bit_starts.shape[0] for m in metas))
-        starts = np.zeros((n_pages, m_max), dtype=np.int64)
-        widths = np.zeros((n_pages, m_max), dtype=np.int32)
-        mins = np.zeros((n_pages, m_max), dtype=np.uint64)
+        m_max = -(-m_max // mb) * mb  # multiple of mb for the block reshape
+        n_blocks = m_max // mb
+        bstarts = np.zeros((n_pages, n_blocks), dtype=np.int32)
+        widths = np.zeros((n_pages, m_max), dtype=np.uint8)
+        bmins = np.zeros((n_pages, n_blocks), dtype=np.uint64)
         firsts = np.zeros(n_pages, dtype=np.int64)
         for i, (m, base) in enumerate(zip(metas, bases)):
             kk = m.mini_bit_starts.shape[0]
-            starts[i, :kk] = m.mini_bit_starts + base * 8
-            starts[i, kk:] = starts[i, kk - 1] if kk else 0
+            kb = -(-kk // mb)
+            bs = (m.mini_bit_starts[::mb] >> 3) + base
+            bstarts[i, :kb] = bs
+            bstarts[i, kb:] = bs[-1] if kb else 0
             widths[i, :kk] = m.mini_widths
-            mins[i, :kk] = m.mini_min_delta
+            bmins[i, :kb] = m.mini_min_delta[::mb]
             firsts[i] = m.first_value
         total_real = sum(p.defined for p in self.pages)
         page_starts = np.full(n_pages + 1, total_real, dtype=np.int64)
@@ -1543,12 +1581,12 @@ class _ChunkAssembler:
                   out=page_starts[1 : len(metas) + 1])
         max_width = max(1, int(widths.max(initial=0)))
         max_width = min((max_width + 7) // 8 * 8, 64)  # byte-rounded: 8 shapes
-        tbase = _pack_tables(stager, [firsts, starts, widths, mins,
+        tbase = _pack_tables(stager, [firsts, bstarts, widths, bmins,
                                       page_starts])
         return lambda buf_dev: DeviceColumnData(
             values=_delta_pages_staged_jit(
                 buf_dev, np.int64(tbase),
-                values_per_mini=metas[0].values_per_mini, count=count,
+                values_per_mini=metas[0].values_per_mini, mb=mb, count=count,
                 bits=bits, max_width=max_width,
                 total=_bucket_count(total_real),
                 n_pages=n_pages, m_max=m_max,
@@ -1606,12 +1644,7 @@ class _ChunkAssembler:
 
         # --- plain suffix: contiguous bitcast when segments are exact -------
         plain_total = sum(p.defined for p in plain_pages)
-        for p in plain_pages:
-            if len(p.raw) - p.value_pos < p.defined * itemsize:
-                raise ParquetError(
-                    f"PLAIN data truncated: {len(p.raw) - p.value_pos} "
-                    f"< {p.defined * itemsize}"
-                )
+        _check_plain_sizes(plain_pages, itemsize)
         contiguous = True
         for p, base, nxt in zip(plain_pages, bases[n_dict:],
                                 list(bases[n_dict + 1 :]) + [None]):
@@ -2008,19 +2041,7 @@ class DeviceFileReader:
     @scoped_x64
     def finalize(self) -> None:
         """Run deferred validity checks (one device sync for all chunks)."""
-        if not self._deferred:
-            return
-        # ONE device_get round trip for every deferred scalar: the tunneled
-        # backend charges ~100ms per device->host transfer regardless of size,
-        # so per-scalar np.asarray syncs would dominate the whole decode
-        host_max = np.asarray(_stack_jit([m for m, _, _ in self._deferred]))
-        for mx, (_, dict_len, path) in zip(host_max, self._deferred):
-            if int(mx) >= dict_len:
-                raise ParquetError(
-                    f"dictionary index {int(mx)} out of range ({dict_len}) "
-                    f"in column {path}"
-                )
-        self._deferred = []
+        _finalize_many([self])
 
     def iter_batches(self, batch_size: int, columns=None):
         """Yield fixed-size device batches {column: jax.Array[batch_size, ...]}.
@@ -2160,6 +2181,28 @@ class DeviceFileReader:
                 yield out
 
 
+def _finalize_many(readers) -> None:
+    """Run every reader's deferred validity checks with ONE device sync.
+
+    The tunneled backend charges ~100ms per device->host transfer regardless
+    of size — and worse, a D2H sync of computed results mid-pipeline stalls
+    the async queue behind it.  Stacking every deferred scalar across all
+    readers costs one round trip total, and callers place it after the last
+    dispatch so nothing downstream is poisoned."""
+    deferred = [d for r in readers for d in r._deferred]
+    if not deferred:
+        return
+    host_max = np.asarray(_stack_jit([m for m, _, _ in deferred]))
+    for mx, (_, dict_len, path) in zip(host_max, deferred):
+        if int(mx) >= dict_len:
+            raise ParquetError(
+                f"dictionary index {int(mx)} out of range ({dict_len}) "
+                f"in column {path}"
+            )
+    for r in readers:
+        r._deferred = []
+
+
 def _timed_stage(reader: DeviceFileReader, stager: _RowGroupStager):
     """Stage on the worker, attributing wall time to the owning reader's
     stats (the worker and dispatching threads both touch device_seconds;
@@ -2174,7 +2217,8 @@ def _timed_stage(reader: DeviceFileReader, stager: _RowGroupStager):
 
 
 def _scan_pipeline(work, ex, finalize_each: bool = False,
-                   close_finished: bool = False):
+                   close_finished: bool = False,
+                   defer_finalize: bool = False):
     """The one-deep prepare/stage/dispatch pipeline shared by
     ``DeviceFileReader.iter_row_groups`` (one reader) and :func:`scan_files`
     (many).  ``work`` yields ``(reader, path, row_group_index)``; this yields
@@ -2196,7 +2240,11 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
                 pprep, pfut.result() if pfut else None
             )
             if finalize_each or pr is not r:
-                pr.finalize()
+                if not defer_finalize:
+                    # a mid-pipeline finalize is a D2H sync that stalls the
+                    # async queue; multi-file scans defer it to one combined
+                    # end-of-scan check (_finalize_many)
+                    pr.finalize()
                 if close_finished and pr is not r:
                     pr.close()
         prev = (r, path, prepared, fut)
@@ -2205,7 +2253,8 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
         yield pp, pr._dispatch_row_group(
             pprep, pfut.result() if pfut else None
         )
-        pr.finalize()
+        if not defer_finalize:
+            pr.finalize()
 
 
 def scan_files(paths, columns=None, validate_crc: bool = False,
@@ -2222,13 +2271,15 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
 
     Yields one ``{column: DeviceColumnData}`` dict per row group (in file
     order); ``with_path=True`` yields ``(path, cols)`` pairs.  Deferred
-    dictionary range checks run per file AFTER its last group is yielded
-    (iter_row_groups' yield-then-raise ordering); eager per-chunk errors
-    raise from the pipelined prepare and may preempt the preceding group's
-    yield by one (the pipeline's depth), exactly as within one file.
-    Finished files close at the boundary (open descriptors stay bounded for
-    arbitrarily many shards), and every reader is closed on exit even on
-    error.
+    dictionary range checks run ONCE, after the last file's last group is
+    yielded (a per-file-boundary check would be a mid-pipeline D2H sync
+    that stalls the async queue — measured ~50ms per boundary); eager
+    per-chunk errors raise from the pipelined prepare and may preempt the
+    preceding group's yield by one (the pipeline's depth), exactly as
+    within one file.  Finished files close at the boundary (open
+    descriptors stay bounded for arbitrarily many shards — the deferred
+    scalars are device arrays, not file state), and every reader is closed
+    on exit even on error.
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -2247,8 +2298,18 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
 
     try:
         with ThreadPoolExecutor(1) as ex:
-            for pp, out in _scan_pipeline(work(), ex, close_finished=True):
+            for pp, out in _scan_pipeline(work(), ex, close_finished=True,
+                                          defer_finalize=True):
                 yield (pp, out) if with_path else out
+        _finalize_many(readers)
     finally:
-        for r in readers:
-            r.close()
+        try:
+            # idempotent re-check: covers consumers that abandon the scan
+            # early (break/islice) — their consumed-but-unchecked files
+            # still validate when the generator closes.  (A GC-time close
+            # swallows exceptions by Python semantics; consumers that break
+            # early and care should close the generator explicitly.)
+            _finalize_many(readers)
+        finally:
+            for r in readers:
+                r.close()
