@@ -265,3 +265,63 @@ def test_datetime_time_field(tmp_path):
     with Reader(p, obj_type=Sched) as r:
         got = r.scan_all()[0]
     assert got.at.replace(tzinfo=UTC) == s.at
+
+
+def test_int96_and_timestamp_string_unix_parity(tmp_path):
+    """floor/writer.go:249-258 + 317-340 parity: INT96 fields accept ints
+    (magnitude-based unix-time heuristic: s/ms/us/ns) and strings
+    (best-effort parse); TIMESTAMP logical columns accept strings too."""
+    schema = parse_schema_definition(
+        "message m { required int96 ts; "
+        "required int64 lt (TIMESTAMP(MILLIS, true)); }"
+    )
+    dt = datetime.datetime(2021, 1, 1, 12, 0, 0, tzinfo=UTC)
+    unix_s = int(dt.timestamp())
+    rows = [
+        {"ts": unix_s, "lt": "2021-01-01T12:00:00+00:00"},        # int seconds
+        {"ts": unix_s * 1000, "lt": "2021-01-01 12:00:00+00:00"},  # int millis
+        {"ts": unix_s * 1_000_000, "lt": dt},                      # int micros
+        {"ts": str(unix_s), "lt": dt},                             # digit string
+        {"ts": "2021-01-01T12:00:00Z", "lt": dt},                  # ISO string
+    ]
+    p = str(tmp_path / "ts.parquet")
+    w = Writer(p, schema)
+    for r in rows:
+        w.write(r)
+    w.close()
+    r = Reader(p)
+    out = list(r)
+    r.close()
+    assert len(out) == 5
+    for row in out:
+        assert row["ts"] == dt, row
+        assert row["lt"] == dt, row
+
+
+def test_int96_implausible_unix_int_rejected(tmp_path):
+    schema = parse_schema_definition("message m { required int96 ts; }")
+    p = str(tmp_path / "bad.parquet")
+    w = Writer(p, schema)
+    with pytest.raises(MarshalError):
+        w.write({"ts": 10**20})  # more digits than unix nanos of now
+    w.close()
+
+
+def test_all_null_byte_array_chunk_statistics(tmp_path):
+    """Advisor finding: a fully-null BYTE_ARRAY chunk with write_statistics
+    must produce null_count-only stats, not crash in the min/max pass."""
+    schema = parse_schema_definition(
+        "message m { optional binary s (STRING); }"
+    )
+    p = str(tmp_path / "nulls.parquet")
+    w = Writer(p, schema)
+    for _ in range(10):
+        w.write({"s": None})
+    w.close()
+    import tpu_parquet as tpq
+
+    meta = tpq.read_file_metadata(p)
+    st = meta.row_groups[0].columns[0].meta_data.statistics
+    assert st is not None and st.null_count == 10
+    assert st.min_value is None and st.max_value is None
+    assert pq.read_table(p)["s"].to_pylist() == [None] * 10

@@ -44,6 +44,7 @@ _NATIVE_THRIFT_ERRORS = {
     -41: "varint too long",
     -42: "thrift container exceeds sanity cap",
     -43: "thrift nesting too deep",
+    -44: "cannot skip unknown thrift ctype",
 }
 
 

@@ -85,7 +85,9 @@ def _h_json(s: str):
 
 @_handler("timestamp")
 def _h_ts(s: str):
-    return datetime.datetime.fromisoformat(s.strip().replace("Z", "+00:00"))
+    from ..floor.time import parse_iso_datetime
+
+    return parse_iso_datetime(s)
 
 
 @_handler("date")

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import datetime
 import decimal
+import functools
 import uuid as uuid_mod
 from typing import Any, Callable, Optional
 
@@ -18,7 +19,7 @@ from ..footer import ParquetError
 from ..format import ConvertedType, Type
 from ..int96 import datetime_to_int96, int96_to_datetime
 from ..schema.core import SchemaNode
-from .time import Time
+from .time import Time, parse_iso_datetime
 
 _EPOCH_DATE = datetime.date(1970, 1, 1)
 _UTC = datetime.timezone.utc
@@ -103,16 +104,66 @@ def _decimal_scale(leaf) -> int:
 # python → physical (write side)
 # ---------------------------------------------------------------------------
 
+def _parse_time_string(v: str) -> datetime.datetime:
+    """Best-effort string → datetime (floor/writer.go:256 dateparse.ParseAny
+    parity, scoped to ISO-8601 and unix-time digit strings)."""
+    s = v.strip()
+    try:
+        body = s[1:] if s.startswith("-") else s
+        if body.isdigit():
+            return _unix_heuristic_dt(int(s))
+        dt = parse_iso_datetime(s)
+    except (ValueError, MarshalError) as e:
+        raise MarshalError(f"cannot parse {v!r} as a timestamp") from e
+    return dt if dt.tzinfo else dt.replace(tzinfo=_UTC)
+
+
+@functools.lru_cache(maxsize=1)
+def _unix_digit_refs() -> tuple:
+    """Digit counts of 'now' per unit, cached per process (the counts next
+    change in 2033 — per-value now() calls would dominate bulk writes)."""
+    now_s = int(datetime.datetime.now(tz=_UTC).timestamp())
+    return tuple(
+        (ns_per_tick, len(str(now_s * mult)))
+        for ns_per_tick, mult in (
+            (1_000_000_000, 1), (1_000_000, 1_000),
+            (1_000, 1_000_000), (1, 1_000_000_000),
+        )
+    )
+
+
+def _unix_heuristic_dt(i: int) -> datetime.datetime:
+    """Digit-count unix-time interpretation — seconds, then millis, micros,
+    nanos.  Exact decodeUnixTime parity (floor/writer.go:317-340): the
+    reference compares DIGIT COUNTS against now's per-unit digit counts
+    ('since 99% of the time these are timestamps and are <= now this is a
+    fairly safe bet' — its words), not magnitudes."""
+    digits = len(str(abs(i))) if i else 1
+    for ns_per_tick, ref_digits in _unix_digit_refs():
+        if digits <= ref_digits:
+            return _EPOCH_DT + datetime.timedelta(
+                microseconds=i * ns_per_tick // 1_000
+            )
+    raise MarshalError(f"INT96 value {i} is not a plausible unix time")
+
+
 def to_physical(leaf: SchemaNode, v: Any) -> Any:
     if v is None:
         return None
     t = leaf.physical_type
 
     unit = _ts_unit_ns(leaf)
+    if unit is not None and isinstance(v, str):
+        v = _parse_time_string(v)
     if unit is not None and isinstance(v, datetime.datetime):
         return _datetime_to_epoch_ns(v) // unit
-    if t == Type.INT96 and isinstance(v, datetime.datetime):
-        return datetime_to_int96(v)
+    if t == Type.INT96:
+        if isinstance(v, str):
+            v = _parse_time_string(v)
+        elif isinstance(v, int):
+            v = _unix_heuristic_dt(v)
+        if isinstance(v, datetime.datetime):
+            return datetime_to_int96(v)
     if _is_date(leaf) and isinstance(v, datetime.date) and not isinstance(
         v, datetime.datetime
     ):

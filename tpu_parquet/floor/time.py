@@ -11,6 +11,12 @@ import datetime
 from dataclasses import dataclass
 
 
+def parse_iso_datetime(s: str) -> datetime.datetime:
+    """ISO-8601 string → datetime, accepting a trailing 'Z' (shared by the
+    floor marshaller and csv2parquet — one timestamp-string parser)."""
+    return datetime.datetime.fromisoformat(s.strip().replace("Z", "+00:00"))
+
+
 @dataclass(frozen=True, order=True)
 class Time:
     nanoseconds: int  # since midnight

@@ -318,6 +318,7 @@ enum {
     TERR_VARLONG = -41,    // varint too long / exceeds 64 bits
     TERR_CONTAINER = -42,  // container exceeds sanity cap
     TERR_DEPTH = -43,      // nesting too deep
+    TERR_CTYPE = -44,      // unknown thrift wire type (13-15)
 };
 
 static const i64 T_MAX_CONTAINER = (i64)1 << 24;
@@ -449,7 +450,8 @@ static int t_skip(const u8 *buf, i64 n, i64 *pos, int ctype, int depth) {
             return t_skip_struct(buf, n, pos, depth);
         default:
             // unknown wire type (13-15): the python engine's skip() raises
-            return TERR_TRUNC;
+            // "cannot skip unknown thrift ctype" — distinct code, same reject
+            return TERR_CTYPE;
     }
 }
 
