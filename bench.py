@@ -90,16 +90,20 @@ def _writer(path, schema, **kw):
     return FileWriter(path, schema, **kw)
 
 
-def _strings_col(rng, n, pool):
+def _pool_col(idx, pool):
+    """ColumnData of pool[idx] (shared by the read generators + write bench)."""
     import numpy as np
     from tpu_parquet.column import ByteArrayData, ColumnData
 
-    idx = rng.integers(0, len(pool), n)
     lens = np.array([len(pool[i]) for i in range(len(pool))])[idx]
-    offs = np.zeros(n + 1, dtype=np.int64)
+    offs = np.zeros(len(idx) + 1, dtype=np.int64)
     np.cumsum(lens, out=offs[1:])
     heap = np.frombuffer(b"".join(pool[i] for i in idx), dtype=np.uint8).copy()
     return ColumnData(values=ByteArrayData(offsets=offs, heap=heap))
+
+
+def _strings_col(rng, n, pool):
+    return _pool_col(rng.integers(0, len(pool), n), pool)
 
 
 def gen_plain_int64(path, rows):
@@ -413,6 +417,88 @@ CONFIGS = {
 }
 
 
+def bench_writes(rows=2_000_000, reps=2):
+    """Writer throughput (host encode; the reference ships write benchmarks,
+    floor/writer_test.go:606-647, but records no numbers).  Data is built
+    in memory first so the timing covers ONLY the write; pyarrow writes the
+    identical data as the independent denominator."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from tpu_parquet.format import (
+        ConvertedType, FieldRepetitionType as FRT, LogicalType, StringType,
+        Type,
+    )
+    from tpu_parquet.schema.core import (
+        ColumnParameters, build_schema, data_column,
+    )
+
+    rng = np.random.default_rng(7)
+    S = lambda: ColumnParameters(
+        logical_type=LogicalType(STRING=StringType()),
+        converted_type=ConvertedType.UTF8)
+
+    def strings(pool):
+        idx = rng.integers(0, len(pool), rows)
+        return _pool_col(idx, pool), pa.array([pool[i].decode() for i in idx])
+
+    pool = [f"supplier_name_{i:04d}".encode() for i in range(1000)]
+    scol, sarr = strings(pool)
+    ints = rng.integers(-(1 << 62), 1 << 62, rows)
+    li_np = {
+        "l_orderkey": np.cumsum(rng.integers(1, 5, rows)).astype(np.int64),
+        "l_partkey": rng.integers(1, 200_000, rows),
+        "l_quantity": rng.integers(1, 51, rows),
+        "l_extendedprice": rng.uniform(900, 105_000, rows),
+    }
+    mcol, marr = strings([b"AIR", b"FOB", b"MAIL", b"RAIL", b"SHIP"])
+    cases = {
+        "write_plain_int64": (
+            build_schema([data_column("v", Type.INT64, FRT.REQUIRED)]),
+            {"v": ints}, dict(use_dictionary=False),
+            pa.table({"v": ints}), dict(use_dictionary=False),
+        ),
+        "write_dict_strings": (
+            build_schema([data_column("s", Type.BYTE_ARRAY, FRT.REQUIRED,
+                                      S())]),
+            {"s": scol}, dict(use_dictionary=True),
+            pa.table({"s": sarr}), {},
+        ),
+        "write_lineitem5": (
+            build_schema(
+                [data_column(k, Type.DOUBLE if v.dtype == np.float64
+                             else Type.INT64, FRT.REQUIRED)
+                 for k, v in li_np.items()]
+                + [data_column("l_shipmode", Type.BYTE_ARRAY, FRT.REQUIRED,
+                               S())]),
+            {**li_np, "l_shipmode": mcol}, dict(use_dictionary=True),
+            pa.table({**li_np, "l_shipmode": marr}), {},
+        ),
+    }
+    out = {}
+    for name, (schema, data, kw, patab, pakw) in cases.items():
+        best = pa_best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            with _writer(f"/tmp/tpq_wbench_{name}.parquet", schema,
+                         **kw) as w:
+                w.write_columns(data)
+            best = min(best, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            pq.write_table(patab, f"/tmp/tpq_wbench_{name}_pa.parquet",
+                           compression="snappy", **pakw)
+            pa_best = min(pa_best, time.perf_counter() - t0)
+        out[name] = {
+            "rows": rows,
+            "write_rows_per_sec": round(rows / best, 1),
+            "pyarrow_write_rows_per_sec": round(rows / pa_best, 1),
+            "write_vs_pyarrow": round(pa_best / best, 3),
+        }
+        log(f"{name}: {rows / best / 1e6:.1f} M rows/s "
+            f"({pa_best / best:.2f}x pyarrow write)")
+    return out
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache: the decode executables are keyed by
     chunk geometry, so re-running the bench on the same files (or the driver
@@ -566,8 +652,10 @@ def main():
         # rest — BENCH_r04 weather log shows the link swinging 150→1500 MB/s
         # within one run, so every config's min deserves a second window
         order = sorted(dev_times, key=lambda n: n != "lineitem16")
+        window_complete = True
         for name in order:
             if over_budget():
+                window_complete = False
                 break
             dev_t, path, rows, key = dev_times[name]
             try:
@@ -576,7 +664,7 @@ def main():
             except Exception as e:  # noqa: BLE001
                 log(f"{name} resample FAILED: {e!r}")
                 continue
-            meta["resample_windows"] = rs + 1
+            meta[f"w{rs + 1}_sampled"] = meta.get(f"w{rs + 1}_sampled", 0) + 1
             if t < dev_t:
                 dev_times[name] = (t, path, rows, key)
                 r = results[name]
@@ -587,6 +675,8 @@ def main():
                     f"{name}.w{rs + 1}")
                 log(f"{name} improved in window {rs + 1}: "
                     f"{r['device_rows_per_sec'] / 1e6:.1f} M rows/s")
+        if window_complete:
+            meta["resample_windows"] = rs + 1
 
     # ------------------------------------------------------------------
     # Phase B: baselines (host decode, pyarrow, host decode + upload).
@@ -631,6 +721,13 @@ def main():
             f"({r['device_mb_per_sec']:.0f} MB/s)"
             + (f", {vs:.1f}x host" if vs is not None else "")
             + (f", {pipe:.1f}x host+upload pipeline" if pipe is not None else ""))
+
+    # Writer throughput (host encode; ~10s).  Skip with BENCH_WRITES=0.
+    if os.environ.get("BENCH_WRITES", "1") != "0" and not over_budget():
+        try:
+            results["writes"] = bench_writes()
+        except Exception as e:  # noqa: BLE001
+            log(f"write bench FAILED: {e!r}")
 
     # Pallas vs XLA bit-unpack microbench (the L1 primitive).
     # Cheap (~5s); skip with BENCH_PALLAS=0.

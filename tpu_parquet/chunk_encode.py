@@ -115,12 +115,24 @@ def _unique_rows(rows: np.ndarray):
 def _unique_bytes(values: ByteArrayData):
     """Vectorized first-appearance uniquing of a ragged byte column.
 
-    Values are grouped by length; each group's bytes gather into a fixed
-    (m, L) u8 matrix that _unique_rows dedups at C speed — no per-value
-    Python loop (the dict-of-bytes walk cost ~40% of writer time on string
-    columns).  Distinct ids are then renumbered by global first appearance,
-    matching the sequential walk's output exactly.
+    Native path: one O(n) open-addressing hash pass (tpq_dict_build_bytes)
+    at memory speed.  Fallback: values grouped by length; each group's bytes
+    gather into a fixed (m, L) u8 matrix that _unique_rows dedups at C speed
+    — no per-value Python loop.  Distinct ids are renumbered by global first
+    appearance; both paths produce identical output.
     """
+    from . import native
+
+    res = native.dict_build(
+        len(values), MAX_DICT_SIZE,
+        offsets=np.ascontiguousarray(values.offsets, dtype=np.int64),
+        heap=np.ascontiguousarray(values.heap),
+    )
+    if res is not None:
+        if isinstance(res, int):
+            return None  # distinct count exceeded MAX_DICT_SIZE
+        firsts, inverse = res
+        return values.take(firsts), inverse.astype(np.int64)
     off = np.asarray(values.offsets)
     heap = np.asarray(values.heap)
     n = len(values)
@@ -163,6 +175,21 @@ def _unique_with_indices(values, ptype: Type):
     arr = np.asarray(values)
     if ptype == Type.INT96:
         return None  # no dictionary for int96 (reference parity)
+    from . import native
+
+    if len(arr) and arr.ndim == 1 and arr.dtype.kind in "iuf":
+        # native O(n) hash pass; distinct bit patterns are distinct values
+        # (same memcmp semantics as the unique-on-int-views fallback).
+        # Object/other dtypes would memcmp POINTERS, so they keep np.unique.
+        res = native.dict_build(
+            len(arr), MAX_DICT_SIZE,
+            data=np.ascontiguousarray(arr), width=arr.dtype.itemsize,
+        )
+        if res is not None:
+            if isinstance(res, int):
+                return None
+            firsts, inverse = res
+            return arr[firsts], inverse.astype(np.int64)
     view = arr.view(np.int32) if arr.dtype == np.float32 else (
         arr.view(np.int64) if arr.dtype == np.float64 else arr
     )
@@ -358,11 +385,15 @@ class ChunkEncoder:
         if self.write_statistics:
             # chunk stats == fold of per-page stats (min of mins, summed
             # nulls), so compute them ONCE over the chunk's defined values —
-            # per-page passes were the writer's hottest path after uniquing
+            # per-page passes were the writer's hottest path after uniquing.
+            # Dict chunks compute min/max over the DICTIONARY (identical by
+            # definition, and the lexicographic pass over n values was the
+            # single hottest writer cost on low-cardinality string columns)
             n_slots = (len(cd.def_levels) if cd.def_levels is not None
                        else len(cd.values))
+            stat_values = dict_pair[0] if use_dict else cd.values
             chunk_stats = compute_statistics(
-                cd.values, ptype, null_count=n_slots - len(cd.values),
+                stat_values, ptype, null_count=n_slots - len(cd.values),
             )
 
         sink.write(bytes(out))

@@ -160,7 +160,7 @@ def to_physical(leaf: SchemaNode, v: Any) -> Any:
     if t == Type.INT96:
         if isinstance(v, str):
             v = _parse_time_string(v)
-        elif isinstance(v, int):
+        elif isinstance(v, int) and not isinstance(v, bool):
             v = _unix_heuristic_dt(v)
         if isinstance(v, datetime.datetime):
             return datetime_to_int96(v)

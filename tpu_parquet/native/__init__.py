@@ -108,6 +108,16 @@ def load():
                 p(c_ll), p(c_ll), p(ctypes.c_uint8), c_ll,
                 p(c_ll), c_ll, p(c_ll),
             ]
+            lib.tpq_dict_build_bytes.restype = c_ll
+            lib.tpq_dict_build_bytes.argtypes = [
+                p(c_ll), ctypes.c_char_p, c_ll, c_ll,
+                p(ctypes.c_int32), c_ll, p(ctypes.c_uint32), p(c_ll),
+            ]
+            lib.tpq_dict_build_fixed.restype = c_ll
+            lib.tpq_dict_build_fixed.argtypes = [
+                ctypes.c_char_p, c_ll, c_ll, c_ll,
+                p(ctypes.c_int32), c_ll, p(ctypes.c_uint32), p(c_ll),
+            ]
             lib.tpq_int_minmax.restype = None
             lib.tpq_int_minmax.argtypes = [
                 ctypes.c_char_p, c_ll, c_ll, ctypes.c_int, p(c_ll),
@@ -397,6 +407,46 @@ def snappy_plan(payload: bytes, expect: int):
             return int(rc)
         r = int(rc)
         return dst_end[:r], op_src[:r], is_lit[:r], int(out[1])
+
+
+def dict_build(n: int, max_dict: int, *, offsets=None, heap=None,
+               data=None, width: int = 0):
+    """First-appearance dictionary build (writer side) — ragged when
+    ``offsets``/``heap`` given, fixed-width rows when ``data``/``width``.
+
+    Returns (firsts int64[k], inverse uint32[n]) in first-appearance order,
+    -50 when the distinct count exceeds ``max_dict`` (caller falls back to
+    plain), or None when the native library is unavailable."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    nslots = 16
+    while nslots < 2 * n:
+        nslots <<= 1
+    slots = np.full(nslots, -1, dtype=np.int32)
+    inverse = np.empty(n, dtype=np.uint32)
+    firsts = np.empty(max_dict, dtype=np.int64)
+    pll = ctypes.POINTER(ctypes.c_longlong)
+    pi32 = ctypes.POINTER(ctypes.c_int32)
+    pu32 = ctypes.POINTER(ctypes.c_uint32)
+    if offsets is not None:
+        rc = lib.tpq_dict_build_bytes(
+            offsets.ctypes.data_as(pll),
+            heap.ctypes.data_as(ctypes.c_char_p), n, max_dict,
+            slots.ctypes.data_as(pi32), nslots,
+            inverse.ctypes.data_as(pu32), firsts.ctypes.data_as(pll),
+        )
+    else:
+        rc = lib.tpq_dict_build_fixed(
+            data.ctypes.data_as(ctypes.c_char_p), n, width, max_dict,
+            slots.ctypes.data_as(pi32), nslots,
+            inverse.ctypes.data_as(pu32), firsts.ctypes.data_as(pll),
+        )
+    if rc < 0:
+        return int(rc)
+    return firsts[: int(rc)], inverse
 
 
 def int_minmax(buf: bytes, pos: int, n: int, width: int):
