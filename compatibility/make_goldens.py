@@ -1,0 +1,109 @@
+"""Generate the checked-in interop golden files (tests/golden/).
+
+The reference's ground-truth interop leg is parquet-mr via Docker
+(compatibility/run_tests.bash in the Go repo) — unrunnable in this image (no
+Java, no network).  The substitute, executed in CI on every run
+(tests/test_golden.py):
+
+  one golden file per {codec} x {data page v1, v2} x {CRC off, on} cell,
+  byte-written by THIS repo's writer from deterministic data, checked into
+  the tree.  The test asserts
+    (a) regenerating the cell reproduces the checked-in bytes EXACTLY for
+        the fully-in-repo codecs (UNCOMPRESSED, SNAPPY) — an encoding-level
+        assertion that catches any unintended format drift, and
+    (b) pyarrow (Apache Arrow C++, the independent implementation) reads
+        every golden value-exact, and
+    (c) this repo re-reads pyarrow's REWRITE of the same table value-exact
+        (both the host and the device reader).
+
+Run this script only to regenerate the goldens after a DELIBERATE format
+change, then commit the diff: `python compatibility/make_goldens.py`.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+from tpu_parquet.column import ByteArrayData, ColumnData
+from tpu_parquet.format import (
+    CompressionCodec, ConvertedType, FieldRepetitionType as FRT, LogicalType,
+    StringType, Type,
+)
+from tpu_parquet.schema.core import (
+    ColumnParameters, build_schema, data_column, list_column,
+)
+from tpu_parquet.writer import FileWriter
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "..", "tests", "golden")
+CODECS = {
+    "uncompressed": CompressionCodec.UNCOMPRESSED,
+    "snappy": CompressionCodec.SNAPPY,
+    "gzip": CompressionCodec.GZIP,
+    "zstd": CompressionCodec.ZSTD,
+}
+ROWS = 500
+
+
+def golden_schema():
+    S = ColumnParameters(logical_type=LogicalType(STRING=StringType()),
+                         converted_type=ConvertedType.UTF8)
+    return build_schema([
+        data_column("id", Type.INT64, FRT.REQUIRED),
+        data_column("x", Type.INT32, FRT.OPTIONAL),
+        data_column("score", Type.DOUBLE, FRT.OPTIONAL),
+        data_column("flag", Type.BOOLEAN, FRT.REQUIRED),
+        data_column("name", Type.BYTE_ARRAY, FRT.OPTIONAL, S),
+        list_column("tags", data_column("element", Type.INT64, FRT.OPTIONAL)),
+    ])
+
+
+def golden_rows():
+    """Deterministic mixed rows: nulls, empty lists, null elements, dict-able
+    strings, negative ints — every shape the readers must round-trip."""
+    rng = np.random.default_rng(20260730)
+    rows = []
+    for i in range(ROWS):
+        rows.append({
+            "id": int(i * 3 - 500),
+            "x": None if i % 7 == 0 else int(i % 97),
+            "score": None if i % 11 == 0 else float(rng.standard_normal()),
+            "flag": i % 2 == 0,
+            "name": None if i % 5 == 0 else f"name-{i % 37}".encode(),
+            "tags": (None if i % 13 == 0 else []
+                     if i % 6 == 0 else
+                     [int(j) if j % 3 else None for j in range(i % 5)]),
+        })
+    return rows
+
+
+def cell_name(codec: str, version: int, crc: bool) -> str:
+    return f"golden_{codec}_v{version}{'_crc' if crc else ''}.parquet"
+
+
+def write_cell(path, codec_name, version, crc):
+    with FileWriter(
+        path, golden_schema(), codec=CODECS[codec_name],
+        data_page_version=version, write_crc=crc, page_size=4096,
+        row_group_size=8 << 10, created_by="tpu_parquet-golden",
+    ) as w:
+        w.write_rows(golden_rows())
+
+
+def main():
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    for codec in CODECS:
+        for version in (1, 2):
+            for crc in (False, True):
+                name = cell_name(codec, version, crc)
+                path = os.path.join(GOLDEN_DIR, name)
+                write_cell(path, codec, version, crc)
+                print(f"{name}: {os.path.getsize(path)} bytes")
+
+
+if __name__ == "__main__":
+    main()
