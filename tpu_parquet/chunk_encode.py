@@ -421,6 +421,25 @@ class ChunkEncoder:
         body = rle.encode(idx.astype(np.uint64), width)
         return bytes([width]) + body
 
+    def _page_statistics(self, cd: ColumnData, lo, hi, vlo, vhi):
+        """Per-page Statistics for fixed-width numeric pages (data_store.go:
+        159-179 parity — the reference carries stats in every data page).
+        Ragged/boolean/INT96 pages skip them: the per-page lexicographic
+        pass was the writer's hottest path before stats moved chunk-level,
+        and page pruning keys on numeric sort order anyway."""
+        if not self.write_statistics:
+            return None
+        if self.leaf.physical_type not in (Type.INT32, Type.INT64,
+                                           Type.FLOAT, Type.DOUBLE):
+            return None
+        vals = cd.values[vlo:vhi]
+        if len(vals) == 0:
+            return None
+        return compute_statistics(
+            np.asarray(vals), self.leaf.physical_type,
+            null_count=(hi - lo) - (vhi - vlo),
+        )
+
     def _write_data_page(
         self, cd: ColumnData, lo, hi, vlo, vhi, payload: bytes, encoding
     ) -> tuple[bytes, int, int]:
@@ -458,6 +477,7 @@ class ChunkEncoder:
                     definition_levels_byte_length=len(def_bytes),
                     repetition_levels_byte_length=len(rep_bytes),
                     is_compressed=True,
+                    statistics=self._page_statistics(cd, lo, hi, vlo, vhi),
                 ),
             )
             body = rep_bytes + def_bytes + comp
@@ -487,6 +507,7 @@ class ChunkEncoder:
                 encoding=int(encoding),
                 definition_level_encoding=int(Encoding.RLE),
                 repetition_level_encoding=int(Encoding.RLE),
+                statistics=self._page_statistics(cd, lo, hi, vlo, vhi),
             ),
         )
         if self.write_crc:

@@ -786,6 +786,7 @@ class _ChunkAssembler:
         # correctness (see _plan_device_snappy)
         self.stats_span: "tuple[int, int] | None" = None
         self.pages_kept_compressed = 0
+        self.pages_pruned = 0  # page-level predicate pushdown skips
 
     # -- dictionary ----------------------------------------------------------
 
@@ -1747,13 +1748,17 @@ class _ChunkAssembler:
 def _collect_chunk(
     buf: bytes, codec: int, total_values: int, leaf: SchemaNode,
     deferred_checks: list, validate_crc: bool = False, alloc=None,
-    statistics=None,
+    statistics=None, skip_pages=None,
 ) -> Optional[_ChunkAssembler]:
-    """Walk a chunk's pages into an assembler (host phase); None if no data."""
+    """Walk a chunk's pages into an assembler (host phase); None if no data.
+
+    ``skip_pages``: data-page ordinals pruned by page-level predicate
+    pushdown — their payloads are never decompressed, parsed, or staged."""
     from .format import CompressionCodec
 
     asm = _ChunkAssembler(leaf, deferred_checks)
     asm.stats_span = _int_stats_span(statistics, leaf)
+    data_ordinal = 0
     # fixed-width PLAIN SNAPPY chunks can skip host decompression entirely
     # (device-side expansion, _plan_device_snappy); parse_data_page applies
     # the per-page structural conditions (PLAIN encoding, levels outside the
@@ -1778,14 +1783,21 @@ def _collect_chunk(
             asm.set_dictionary(raw, dh.encoding, dh.num_values or 0)
             continue
         if pt in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+            if skip_pages and data_ordinal in skip_pages:
+                asm.pages_pruned += 1
+                data_ordinal += 1
+                continue
             asm.pages.append(
                 parse_data_page(ps, buf, codec, leaf, validate_crc=validate_crc,
                                 alloc=alloc, decode_levels=False,
                                 lazy_decompress=lazy)
             )
+            data_ordinal += 1
             continue
         # index/unknown pages: skip
-    return asm if asm.pages else None
+    # returned even with zero pages: a fully-pruned chunk still carries its
+    # pages_pruned count (callers emit a placeholder column for it)
+    return asm
 
 
 def _int_stats_span(statistics, leaf: SchemaNode) -> "tuple[int, int] | None":
@@ -1820,7 +1832,7 @@ def decode_chunk_batched(
     """Decode one chunk with per-chunk fused dispatch (no blocking syncs)."""
     asm = _collect_chunk(buf, codec, total_values, leaf, deferred_checks,
                          validate_crc)
-    if asm is None:
+    if asm is None or not asm.pages:
         return DeviceColumnData(
             values=jnp.asarray(np.zeros(0, dtype=np.int64)),
             max_def=leaf.max_def, max_rep=leaf.max_rep, num_leaf_slots=0,
@@ -1840,6 +1852,7 @@ class ReaderStats:
     chunks: int = 0
     pages: int = 0
     pages_device_expanded: int = 0  # pages shipped compressed (device snappy)
+    pages_pruned: int = 0           # pages skipped by page-level pushdown
     rows: int = 0
     compressed_bytes: int = 0      # chunk bytes read from the file
     staged_bytes: int = 0          # HBM bytes shipped (row-group buffers)
@@ -1865,6 +1878,7 @@ class ReaderStats:
             "row_groups": self.row_groups, "chunks": self.chunks,
             "pages": self.pages,
             "pages_device_expanded": self.pages_device_expanded,
+            "pages_pruned": self.pages_pruned,
             "rows": self.rows,
             "compressed_bytes": self.compressed_bytes,
             "staged_bytes": self.staged_bytes,
@@ -1884,6 +1898,16 @@ class DeviceFileReader:
     groups as the work unit, nothing blocks until ``finalize()`` (called by
     ``read_row_group``; pass ``finalize=False`` to pipeline several row groups
     and call it once).
+
+    With ``row_filter`` set, pruning is two-level: row groups whose chunk
+    stats prove no match are skipped whole (prune_row_groups), and within
+    surviving FLAT row groups, page-header Statistics drop maximal
+    provably-false row runs aligned to whole-page boundaries of every
+    selected column (prune_pages — skipped pages are never decompressed,
+    staged, or decoded; ReaderStats.pages_pruned counts them).  Yielded rows
+    are always a SUPERSET of matching rows, identical across columns;
+    columns with differing page grids share no interior edges, in which
+    case the pruner soundly declines rather than misalign.
 
     Zero-decode-work policy: a PLAIN fixed-width chunk has no device compute
     — decoding it here is a pure host→HBM transfer, so against a host decode
@@ -1934,6 +1958,97 @@ class DeviceFileReader:
     def num_row_groups(self) -> int:
         return self._host.num_row_groups
 
+    def _plan_page_pruning(self, rg, leaves):
+        """Page-level predicate pushdown (beyond the reference, which writes
+        page Statistics but never reads them): within a surviving row group,
+        maximal row runs the predicate provably cannot match — aligned to
+        whole-page boundaries of EVERY selected column — are dropped by
+        skipping those pages outright (no decompression, no staging, no
+        decode).  Returns ({column_path: set(data-page ordinals to skip)},
+        rows_dropped), or (None, 0) when ineligible (no filter, repeated
+        columns, a filter column absent/repeated).
+
+        Output contract (same lattice as group pruning): yielded rows are a
+        SUPERSET of matching rows — callers re-filter exactly; whole-page
+        alignment keeps every column's yielded rows identical.
+        """
+        pred = self._host.row_filter
+        if pred is None:
+            return None, 0, {}
+        from .predicate import prune_pages
+
+        all_leaves = {".".join(l.path): l for l in self.schema.leaves}
+        if any(l.max_rep > 0 for l in leaves.values()):
+            return None, 0, {}
+        fcols = set(pred.columns())
+        for name in fcols:
+            leaf = all_leaves.get(name)
+            if leaf is None or leaf.max_rep > 0:
+                return None, 0, {}
+        by_path = {}
+        for chunk in rg.columns or []:
+            md = chunk.meta_data
+            if md is not None and md.path_in_schema:
+                by_path[".".join(md.path_in_schema)] = chunk
+        if not fcols <= set(by_path):
+            return None, 0, {}
+        f = self._host._f
+        filter_pages = {}
+        boundaries = {}
+        # selected chunks' bytes, handed to the decode loop — the planner
+        # already paid the IO; re-reading would double it
+        bufs: dict = {}
+        walk = set(fcols) | {".".join(p) for p in leaves}
+        for name in walk:
+            chunk = by_path.get(name)
+            if chunk is None:
+                return None, 0, bufs  # selected column missing: decode raises
+            leaf = all_leaves[name]
+            md, offset = validate_chunk_meta(chunk, leaf)
+            f.seek(offset)
+            buf = f.read(md.total_compressed_size)
+            if tuple(name.split(".")) in leaves:
+                bufs[tuple(name.split("."))] = buf
+            ends, stats = [], []
+            total = 0
+            for ps in walk_pages(buf, md.num_values):
+                h = ps.header
+                if h.type == PageType.DATA_PAGE and h.data_page_header:
+                    total += h.data_page_header.num_values or 0
+                    st = h.data_page_header.statistics
+                elif (h.type == PageType.DATA_PAGE_V2
+                      and h.data_page_header_v2):
+                    total += h.data_page_header_v2.num_values or 0
+                    st = h.data_page_header_v2.statistics
+                else:
+                    continue
+                ends.append(total)
+                stats.append(st)
+            boundaries[name] = ends
+            if name in fcols:
+                filter_pages[name] = (ends, stats, md.type)
+        num_rows = rg.num_rows or 0
+        sel_bounds = {n: boundaries[n]
+                      for n in {".".join(p) for p in leaves}}
+        runs = prune_pages(filter_pages, sel_bounds, num_rows, pred,
+                           all_leaves)
+        if not runs:
+            return None, 0, bufs
+        skip = {}
+        for path in leaves:
+            name = ".".join(path)
+            ends = boundaries[name]
+            drop = set()
+            start = 0
+            for i, e in enumerate(ends):
+                if any(a <= start and e <= b for a, b in runs):
+                    drop.add(i)
+                start = e
+            if drop:
+                skip[path] = drop
+        rows_dropped = sum(b - a for a, b in runs)
+        return skip, rows_dropped, bufs
+
     @scoped_x64
     def _prepare_row_group(self, index: int, executor=None):
         """Host phase: decompress + parse every chunk of the row group,
@@ -1958,6 +2073,8 @@ class DeviceFileReader:
         out: dict[str, DeviceColumnData] = {}
         f = self._host._f
         self.alloc.reset()
+        skip_pages, rows_dropped, planned_bufs = self._plan_page_pruning(
+            rg, leaves)
         stager = _RowGroupStager(executor)
         plans: list[tuple[str, object]] = []
         for chunk in rg.columns or []:
@@ -1969,8 +2086,10 @@ class DeviceFileReader:
             if leaf is None:
                 continue
             md, offset = validate_chunk_meta(chunk, leaf)
-            f.seek(offset)
-            buf = f.read(md.total_compressed_size)
+            buf = planned_bufs.get(path)
+            if buf is None:
+                f.seek(offset)
+                buf = f.read(md.total_compressed_size)
             if len(buf) != md.total_compressed_size:
                 raise ParquetError("chunk truncated")
             self._stats.chunks += 1
@@ -1980,11 +2099,16 @@ class DeviceFileReader:
                 buf, md.codec, md.num_values, leaf, self._deferred,
                 validate_crc=self.validate_crc, alloc=self.alloc,
                 statistics=md.statistics,
+                skip_pages=(skip_pages or {}).get(path),
             )
             if asm is not None:
                 self._stats.pages += len(asm.pages)
+                self._stats.pages_pruned += asm.pages_pruned
             name = ".".join(path)
-            if asm is None:
+            if asm is None or not asm.pages:
+                # empty chunk OR fully pruned: placeholder column (still
+                # count the pruned pages — a fully-pruned chunk is the
+                # pushdown's best case, not a zero)
                 out[name] = DeviceColumnData(
                     values=jnp.asarray(np.zeros(0, dtype=np.int64)),
                     max_def=leaf.max_def, max_rep=leaf.max_rep,
@@ -2002,7 +2126,7 @@ class DeviceFileReader:
                 f"row group {index} missing columns {sorted(missing)}"
             )
         self._stats.row_groups += 1
-        self._stats.rows += rg.num_rows or 0
+        self._stats.rows += (rg.num_rows or 0) - rows_dropped
         self._stats.staged_bytes += stager.total
         now = _time.perf_counter()
         self._stats.host_seconds += now - t0

@@ -253,8 +253,8 @@ def fuzz_device_reader(data: bytes) -> None:
 def fuzz_page_header(data: bytes) -> None:
     """Native vs python PageHeader parse parity (the C parser replicates
     thrift.py's compact-protocol semantics byte for byte — same
-    accept/reject set, same consumed length, same extracted fields; page
-    Statistics are the one documented difference and are excluded)."""
+    accept/reject set, same consumed length, same extracted fields,
+    INCLUDING each data page header's Statistics sub-struct)."""
     from . import native
     from .format import PageHeader
     from .thrift import ThriftError, read_struct
@@ -277,10 +277,6 @@ def fuzz_page_header(data: bytes) -> None:
     c, c_end = res
     if c_end != py_end:
         raise AssertionError(f"consumed mismatch: {c_end} != {py_end}")
-    if py.data_page_header is not None:
-        py.data_page_header.statistics = None  # documented difference
-    if py.data_page_header_v2 is not None:
-        py.data_page_header_v2.statistics = None
     if c != py:
         raise AssertionError(f"field mismatch: {c!r} != {py!r}")
 

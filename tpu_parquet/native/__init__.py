@@ -486,28 +486,43 @@ def page_header(buf: bytes, pos: int = 0):
 
     Returns (PageHeader, end_pos), a negative error code (int — TERR_*
     values, same accept/reject set as the Python engine), or None when the
-    native library is unavailable.  Page-level Statistics are skipped (no
-    reader consumes them); everything else the readers touch is populated,
-    including sub-struct presence (a missing DataPageHeader stays None).
+    native library is unavailable.  Everything the format defines is
+    populated, including each data page header's Statistics (min/max bytes,
+    null/distinct counts — consumed by page-level predicate pruning).
     """
     lib = load()
     if lib is None:
         return None
     # stack-local ctypes array: per-page numpy allocation + data_as cast
     # would eat a few percent of the win this parser exists for
-    out = (ctypes.c_longlong * 20)()
+    out = (ctypes.c_longlong * 40)()
     rc = lib.tpq_page_header(buf, len(buf), pos, out)
     if rc < 0:
         return int(rc)
     from ..format import (
         DataPageHeader, DataPageHeaderV2, DictionaryPageHeader,
-        IndexPageHeader, PageHeader,
+        IndexPageHeader, PageHeader, Statistics,
     )
 
     mask = int(out[18])
 
     def g(i):
         return int(out[i]) if mask >> i & 1 else None
+
+    def stats(base, struct_bit):
+        if not (mask >> struct_bit & 1):
+            return None
+        st = Statistics(null_count=g(base), distinct_count=g(base + 1))
+
+        def b(slot):
+            if not (mask >> slot & 1):
+                return None
+            p, ln = int(out[slot]), int(out[slot + 1])
+            return buf[p : p + ln]
+
+        st.max, st.min = b(base + 2), b(base + 4)
+        st.max_value, st.min_value = b(base + 6), b(base + 8)
+        return st
 
     h = PageHeader(
         type=g(0), uncompressed_page_size=g(1),
@@ -517,6 +532,7 @@ def page_header(buf: bytes, pos: int = 0):
         h.data_page_header = DataPageHeader(
             num_values=g(4), encoding=g(5),
             definition_level_encoding=g(6), repetition_level_encoding=g(7),
+            statistics=stats(20, 58),
         )
     if mask >> 59 & 1:
         h.index_page_header = IndexPageHeader()
@@ -530,6 +546,7 @@ def page_header(buf: bytes, pos: int = 0):
             num_values=g(11), num_nulls=g(12), num_rows=g(13),
             encoding=g(14), definition_levels_byte_length=g(15),
             repetition_levels_byte_length=g(16),
+            statistics=stats(30, 57),
         )
         if mask >> 17 & 1:
             v2.is_compressed = bool(out[17])

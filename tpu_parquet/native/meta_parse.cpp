@@ -455,12 +455,76 @@ static int t_skip(const u8 *buf, i64 n, i64 *pos, int ctype, int depth) {
     }
 }
 
+// Statistics sub-struct (format field ids: 1 max binary, 2 min binary,
+// 3 null_count i64, 4 distinct_count i64, 5 max_value binary, 6 min_value
+// binary).  Binary values are recorded as (pos, len) into the source buffer.
+// Slot bank at `base` (base+0 null_count, +1 distinct_count, +2/+3 max,
+// +4/+5 min, +6/+7 max_value, +8/+9 min_value); presence bits are the value
+// slots' indices, and `struct_bit` marks the sub-struct itself.  v1 and v2
+// data page headers get SEPARATE banks (20/bit 58 and 30/bit 57): both may
+// appear in one PageHeader and each python object carries its own stats.
+static int t_stats(const u8 *buf, i64 n, i64 *pos, i64 *out, u64 *mask,
+                   int base, int struct_bit) {
+    for (int i = base; i < base + 10; i++) {
+        out[i] = 0;
+        *mask &= ~((u64)1 << i);
+    }
+    u64 last = 0;
+    while (1) {
+        if (*pos >= n) return TERR_TRUNC;
+        u8 b = buf[(*pos)++];
+        if ((b & 0x0F) == 0x00) break;  // masked-STOP (python parity)
+        int ctype = b & 0x0F;
+        int delta = (b >> 4) & 0x0F;
+        if (delta) {
+            last += (u64)delta;
+        } else {
+            i64 fid;
+            int rc = t_zigzag(buf, n, pos, &fid);
+            if (rc) return rc;
+            last = (u64)fid;
+        }
+        int rc = 0;
+        if ((last == 3 || last == 4) && ctype == 0x06) {
+            i64 v;
+            rc = t_zigzag(buf, n, pos, &v);
+            if (!rc) {
+                int slot = base + (last == 3 ? 0 : 1);
+                out[slot] = v;
+                *mask |= (u64)1 << slot;
+            }
+        } else if ((last == 1 || last == 2 || last == 5 || last == 6)
+                   && ctype == 0x08) {
+            u64 blen;
+            rc = t_varint(buf, n, pos, &blen);
+            if (!rc) {
+                if (blen > (u64)T_MAX_CONTAINER) return TERR_CONTAINER;
+                if (*pos + (i64)blen > n) return TERR_TRUNC;
+                int slot = base + (last == 1 ? 2 : last == 2 ? 4
+                                   : last == 5 ? 6 : 8);
+                out[slot] = *pos;
+                out[slot + 1] = (i64)blen;
+                *mask |= (u64)1 << slot;
+                *pos += (i64)blen;
+            }
+        } else if (ctype != 0x01 && ctype != 0x02) {
+            rc = t_skip(buf, n, pos, ctype, 2);
+        }
+        if (rc) return rc;
+    }
+    *mask |= (u64)1 << struct_bit;
+    return 0;
+}
+
 // Parse the sub-struct `fids` maps into out slots: for each field id fid in
 // [1, nf], if fid maps to slot s >= 0 and the wire type matches `want`
 // (varint ints) or is a bool (want < 0), record the value + presence bit.
 // wants[fid-1]: 5/6 = zigzag varint of that wire type, -1 = bool, 0 = skip.
+// `stats_fid` != 0 routes that struct-typed field into t_stats (the
+// Statistics carried by DataPageHeader field 5 / DataPageHeaderV2 field 8).
 static int t_sub_struct(const u8 *buf, i64 n, i64 *pos, const int8_t *wants,
-                        const int8_t *slots, int nf, i64 *out, u64 *mask) {
+                        const int8_t *slots, int nf, i64 *out, u64 *mask,
+                        int stats_fid, int stats_base, int stats_bit) {
     u64 last = 0;  // wrap-safe; range tests below bound all uses
     while (1) {
         if (*pos >= n) return TERR_TRUNC;
@@ -478,7 +542,10 @@ static int t_sub_struct(const u8 *buf, i64 n, i64 *pos, const int8_t *wants,
         }
         int want = (last >= 1 && last <= (u64)nf) ? wants[last - 1] : 0;
         int slot = (last >= 1 && last <= (u64)nf) ? slots[last - 1] : -1;
-        if (want == -1 && (ctype == 0x01 || ctype == 0x02)) {
+        if (stats_fid && last == (u64)stats_fid && ctype == 0x0C) {
+            int rc = t_stats(buf, n, pos, out, mask, stats_base, stats_bit);
+            if (rc) return rc;
+        } else if (want == -1 && (ctype == 0x01 || ctype == 0x02)) {
             out[slot] = (ctype == 0x01);
             *mask |= (u64)1 << slot;
         } else if (want > 0 && ctype == want) {
@@ -494,19 +561,22 @@ static int t_sub_struct(const u8 *buf, i64 n, i64 *pos, const int8_t *wants,
     }
 }
 
-// Slot layout (out i64[20]):
+// Slot layout (out i64[40]):
 //   0 type  1 uncompressed_page_size  2 compressed_page_size  3 crc
 //   4 dph.num_values  5 dph.encoding  6 dph.def_level_enc  7 dph.rep_level_enc
 //   8 dict.num_values  9 dict.encoding  10 dict.is_sorted
 //   11 v2.num_values  12 v2.num_nulls  13 v2.num_rows  14 v2.encoding
 //   15 v2.def_levels_byte_length  16 v2.rep_levels_byte_length
 //   17 v2.is_compressed
-//   18 presence mask (bits 0-17 as above; bits 59/60/61/62 =
-//      index/dph/dict/v2 sub-struct present)  19 end position
+//   18 presence mask (bits 0-17/20-39 as slot indices; bits 59/60/61/62 =
+//      index/dph/dict/v2 sub-struct present; 58/57 = dph/v2 Statistics
+//      present)  19 end position
+//   20-29 dph.statistics bank, 30-39 v2.statistics bank (see t_stats)
 // Returns 0 or a TERR_* code.
 i64 tpq_page_header(const u8 *buf, i64 n, i64 pos, i64 *out) {
     u64 mask = 0;
     for (int i = 0; i < 18; i++) out[i] = 0;
+    for (int i = 20; i < 40; i++) out[i] = 0;
     static const int8_t dph_w[5] = {5, 5, 5, 5, 0};
     static const int8_t dph_s[5] = {4, 5, 6, 7, -1};
     static const int8_t dict_w[3] = {5, 5, -1};
@@ -537,9 +607,13 @@ i64 tpq_page_header(const u8 *buf, i64 n, i64 pos, i64 *out) {
                 mask |= (u64)1 << (last - 1);
             }
         } else if (last == 5 && ctype == 0x0C) {
-            // last occurrence wins (python setattr replaces the object)
+            // last occurrence wins (python setattr replaces the object) —
+            // including the sub-struct's statistics bank
             for (int i = 4; i <= 7; i++) { out[i] = 0; mask &= ~((u64)1 << i); }
-            rc = t_sub_struct(buf, n, &pos, dph_w, dph_s, 5, out, &mask);
+            for (int i = 20; i <= 29; i++) { out[i] = 0; mask &= ~((u64)1 << i); }
+            mask &= ~((u64)1 << 58);
+            rc = t_sub_struct(buf, n, &pos, dph_w, dph_s, 5, out, &mask,
+                              5, 20, 58);
             if (!rc) mask |= (u64)1 << 60;
         } else if (last == 6 && ctype == 0x0C) {
             // IndexPageHeader is an empty struct: walk it, record presence
@@ -547,11 +621,15 @@ i64 tpq_page_header(const u8 *buf, i64 n, i64 pos, i64 *out) {
             if (!rc) mask |= (u64)1 << 59;
         } else if (last == 7 && ctype == 0x0C) {
             for (int i = 8; i <= 10; i++) { out[i] = 0; mask &= ~((u64)1 << i); }
-            rc = t_sub_struct(buf, n, &pos, dict_w, dict_s, 3, out, &mask);
+            rc = t_sub_struct(buf, n, &pos, dict_w, dict_s, 3, out, &mask,
+                              0, 0, 0);
             if (!rc) mask |= (u64)1 << 61;
         } else if (last == 8 && ctype == 0x0C) {
             for (int i = 11; i <= 17; i++) { out[i] = 0; mask &= ~((u64)1 << i); }
-            rc = t_sub_struct(buf, n, &pos, v2_w, v2_s, 8, out, &mask);
+            for (int i = 30; i <= 39; i++) { out[i] = 0; mask &= ~((u64)1 << i); }
+            mask &= ~((u64)1 << 57);
+            rc = t_sub_struct(buf, n, &pos, v2_w, v2_s, 8, out, &mask,
+                              8, 30, 57);
             if (!rc) mask |= (u64)1 << 62;
         } else if (ctype != 0x01 && ctype != 0x02) {
             rc = t_skip(buf, n, &pos, ctype, 0);

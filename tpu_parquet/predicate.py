@@ -23,6 +23,7 @@ a NULL value satisfies no comparison, so ``~(col > v)`` is NOT ``col <= v``
 
 from __future__ import annotations
 
+import bisect
 import struct
 from dataclasses import dataclass
 from typing import Optional
@@ -92,19 +93,24 @@ def _decode_bound(raw: Optional[bytes], ptype: int, elem,
     return None
 
 
-def chunk_stats_range(md, elem):
-    """(min, max, null_count, num_values, ptype) from one chunk's metadata;
-    None bounds where statistics are absent/undecodable."""
-    st = md.statistics
+def stats_range(st, ptype, elem, num_values):
+    """(min, max, null_count, num_values, ptype) from one Statistics object
+    (chunk- or page-level); None bounds where absent/undecodable."""
     if st is None:
-        return None, None, None, md.num_values, md.type
+        return None, None, None, num_values, ptype
     if st.min_value is not None or st.max_value is not None:
         mn_raw, mx_raw, deprecated = st.min_value, st.max_value, False
     else:
         mn_raw, mx_raw, deprecated = st.min, st.max, True
-    mn = _decode_bound(mn_raw, md.type, elem, deprecated)
-    mx = _decode_bound(mx_raw, md.type, elem, deprecated)
-    return mn, mx, st.null_count, md.num_values, md.type
+    mn = _decode_bound(mn_raw, ptype, elem, deprecated)
+    mx = _decode_bound(mx_raw, ptype, elem, deprecated)
+    return mn, mx, st.null_count, num_values, ptype
+
+
+def chunk_stats_range(md, elem):
+    """(min, max, null_count, num_values, ptype) from one chunk's metadata;
+    None bounds where statistics are absent/undecodable."""
+    return stats_range(md.statistics, md.type, elem, md.num_values)
 
 
 @dataclass(frozen=True)
@@ -369,6 +375,76 @@ def parse_filter(text: str) -> Predicate:
         )
 
     return walk(tree.body)
+
+
+def prune_pages(filter_pages, all_boundaries, num_rows, predicate,
+                leaves) -> "list[tuple[int, int]]":
+    """Whole-page-aligned droppable row runs within one (flat) row group.
+
+    ``filter_pages``: {column: (ends, stats_list, ptype)} — per data page of
+    each FILTER column, the cumulative row end and the page-header
+    Statistics (None where absent).  ``all_boundaries``: {column: ends} for
+    EVERY selected column.  Returns maximal row runs [a, b) where the
+    predicate provably matches no row, SHRUNK so that a and b are page
+    boundaries of every selected column — dropping such a run means every
+    column drops only whole pages, so decoded columns stay row-aligned with
+    no sub-page surgery (the page analog of prune_row_groups' lattice;
+    beyond the reference, which carries page stats but never reads them).
+
+    Soundness mirrors prune_row_groups: absent/undecodable stats are
+    no-evidence, repeated columns never arrive here (callers gate on
+    max_rep == 0).
+    """
+    # elementary breakpoints: every filter column's page edges
+    bps = {0, num_rows}
+    for ends, _, _ in filter_pages.values():
+        bps.update(int(e) for e in ends)
+    bps = sorted(b for b in bps if 0 <= b <= num_rows)
+    dropped = []
+    for a, b in zip(bps[:-1], bps[1:]):
+        if a >= b:
+            continue
+
+        def stats_of(name, _a=a):
+            fp = filter_pages.get(name)
+            if fp is None:
+                return None
+            ends, stats_list, ptype = fp
+            # the page containing row _a (elementary: one page per column)
+            i = bisect.bisect_right(ends, _a)
+            if i >= len(stats_list):
+                return None
+            start = int(ends[i - 1]) if i else 0
+            return stats_range(stats_list[i], ptype, leaves[name].element,
+                               int(ends[i]) - start)
+
+        if not predicate._bounds(stats_of).can:
+            if dropped and dropped[-1][1] == a:
+                dropped[-1] = (dropped[-1][0], b)
+            else:
+                dropped.append((a, b))
+    # shrink each run to whole-page edges of EVERY selected column — to a
+    # FIXED POINT: rounding to one column's edges can land between another's
+    # (lo only rises, hi only falls, so this terminates)
+    out = []
+    for a, b in dropped:
+        lo, hi = a, b
+        changed = True
+        while changed and lo < hi:
+            changed = False
+            for ends in all_boundaries.values():
+                edges = [0] + [int(e) for e in ends]
+                i = bisect.bisect_left(edges, lo)
+                lo2 = edges[i] if i < len(edges) else num_rows
+                j = bisect.bisect_right(edges, hi) - 1
+                hi2 = edges[j] if j >= 0 else 0
+                nlo, nhi = max(lo, lo2), min(hi, hi2)
+                if (nlo, nhi) != (lo, hi):
+                    lo, hi = nlo, nhi
+                    changed = True
+        if lo < hi:
+            out.append((lo, hi))
+    return out
 
 
 def prune_row_groups(metadata, schema, predicate: Predicate) -> list[bool]:
