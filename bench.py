@@ -34,11 +34,12 @@ Sampling protocol (disclosed here and in README):
   the standard noise-rejection estimator on a shared link; the rep-count
   asymmetry exists because baselines are 5-10x slower per rep and the driver
   budget is finite.  Both counts are recorded in the output JSON.
-- the headline config's device reps are sampled in TWO windows — once at the
-  start of the run and again after every other config — because the tunneled
-  TPU link shows transient multi-minute congestion windows (BENCH_r03
-  recorded ~145 MB/s where clean air gives ~1.4 GB/s); a single burst of
-  back-to-back reps samples only one weather window.
+- EVERY config's device reps are sampled in up to 1 + BENCH_RESAMPLE
+  time-separated windows (default 3 total) — because the tunneled TPU link
+  shows transient multi-minute congestion (own probes have recorded
+  93 MB/s and 1.5 GB/s within one run); a single burst of back-to-back
+  reps samples only one weather window.  Resample windows stop early at
+  60% of the time budget so the baselines (phase B) always fit.
 - link bandwidth is probed (one 64 MB transfer) before and after phase A and
   recorded in the JSON, so a depressed headline is attributable from the
   artifact itself.
@@ -46,7 +47,7 @@ Sampling protocol (disclosed here and in README):
 Env knobs: BENCH_SCALE (default 1.0), BENCH_DEVICE_REPS (default 4),
 BENCH_BASELINE_REPS (default: one below device reps, capped at 3),
 BENCH_CONFIGS (comma list, default "4,2,3,1,5" — headline banked first),
-BENCH_RESAMPLE (default 1 — extra headline windows).
+BENCH_RESAMPLE (default 2 — extra sampling windows over all configs).
 """
 
 import json
@@ -67,7 +68,10 @@ REPS = int(os.environ.get("BENCH_DEVICE_REPS", "4"))
 # (the asymmetry is disclosed in the module docstring and the output JSON)
 BASELINE_REPS = int(os.environ.get("BENCH_BASELINE_REPS",
                                    str(max(min(REPS - 1, 3), 1))))
-RESAMPLE = int(os.environ.get("BENCH_RESAMPLE", "1"))
+# two extra windows by default: BENCH_r04 logs show the link swinging
+# 136->1500 MB/s across minutes; the window loop is budget-guarded, so a
+# slow run simply takes fewer windows
+RESAMPLE = int(os.environ.get("BENCH_RESAMPLE", "2"))
 WHICH = os.environ.get("BENCH_CONFIGS", "4,2,3,1,5").split(",")
 # soft wall-clock budget: finish the current config, then emit JSON with
 # whatever was measured (the driver must ALWAYS get its one line)
@@ -631,18 +635,25 @@ def main():
             headline = results[name]
 
     # ------------------------------------------------------------------
-    # Phase A': extra headline sampling windows.  Transient congestion on
-    # the tunneled link lasts minutes (BENCH_r03: ~145 MB/s for the whole
-    # headline burst where clean air gives ~1.4 GB/s); re-sampling the
-    # headline's device reps after the other configs gives min-of-reps a
-    # second weather window.  Same metric, same estimator — just sampled
-    # at two points in the run.
+    # Phase A': extra sampling windows over every config.  Transient
+    # congestion on the tunneled link lasts minutes (own probes have
+    # recorded 93 MB/s and 1.5 GB/s within one run); re-sampling each
+    # config's device reps later in the run gives min-of-reps more
+    # weather windows.  Same metric, same estimator — sampled at several
+    # points in time.  Windows stop at 60% of the budget: the phase-B
+    # baselines (the vs_baseline denominator the driver records) must
+    # always fit.
     # ------------------------------------------------------------------
     resample_reps = max(REPS - 2, 2)
     meta["resample_windows"] = 0
     meta["resample_reps"] = resample_reps
+
+    def windows_over_budget():
+        return (bool(results)
+                and time.perf_counter() - _T_START > 0.6 * TIME_BUDGET)
+
     for rs in range(RESAMPLE):
-        if not dev_times or over_budget():
+        if not dev_times or windows_over_budget():
             break
         try:  # probe failure must not forfeit the sampling window itself
             meta[f"link_mb_per_sec_w{rs + 1}"] = probe_link()
@@ -654,7 +665,7 @@ def main():
         order = sorted(dev_times, key=lambda n: n != "lineitem16")
         window_complete = True
         for name in order:
-            if over_budget():
+            if windows_over_budget():
                 window_complete = False
                 break
             dev_t, path, rows, key = dev_times[name]
