@@ -504,21 +504,14 @@ def bench_writes(rows=2_000_000, reps=2):
 
 
 def _enable_compile_cache():
-    """Persistent XLA compilation cache: the decode executables are keyed by
-    chunk geometry, so re-running the bench on the same files (or the driver
-    re-running it after this process primed the cache) skips the remote
-    compile round trips that otherwise dominate first-run wall clock."""
+    """Persistent XLA compilation cache (one implementation: the library's —
+    device_reader._enable_compile_cache defers to an app-configured dir /
+    JAX_COMPILATION_CACHE_DIR and defaults to a per-user path)."""
     import jax
+    from tpu_parquet.device_reader import _enable_compile_cache as lib_enable
 
-    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                               "/tmp/tpq_jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        log(f"compilation cache: {cache_dir}")
-    except Exception as e:  # noqa: BLE001 — cache is an optimization only
-        log(f"compilation cache unavailable: {e!r}")
+    lib_enable()
+    log(f"compilation cache: {jax.config.jax_compilation_cache_dir}")
 
 
 def _pallas_microbench(width=13, n=8_000_000):

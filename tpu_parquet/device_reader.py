@@ -572,6 +572,44 @@ class _RowGroupStager:
         return _concat_jit(parts)
 
 
+_CACHE_ENABLED = False
+
+
+def _enable_compile_cache() -> None:
+    """Enable jax's persistent compilation cache on first reader use.
+
+    The decode executables are keyed by bucketed chunk geometry; on the
+    tunneled backend each remote compile costs 10-30 s, and a fresh process
+    re-opening the same file pays them all again (~180 s measured on the
+    5M-row lineitem shapes).  With the persistent cache, re-opens are
+    near-free across processes (measured 107 s → 5 s).
+
+    Defers to the host application: a cache dir already configured (by the
+    embedding program or via JAX_COMPILATION_CACHE_DIR, which jax reads
+    itself) is left untouched.  The default path is per-user (world-shared
+    /tmp paths are a collision/poisoning hazard on multi-user hosts).
+    TPQ_COMPILE_CACHE=0 disables; any other value overrides the directory.
+    """
+    global _CACHE_ENABLED
+    if _CACHE_ENABLED:
+        return
+    _CACHE_ENABLED = True
+    env = os.environ.get("TPQ_COMPILE_CACHE", "")
+    if env == "0":
+        return
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            return  # application (or JAX_COMPILATION_CACHE_DIR) already chose
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            env or f"/tmp/tpq_jax_cache_{os.getuid()}",
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001 — the cache is an optimization only
+        pass
+
+
 def _pallas_interpret_mode():
     """Whether hybrid decode routes through the Pallas unpack kernel.
 
@@ -1355,11 +1393,14 @@ class _ChunkAssembler:
         range (dict_len >= 2^width), NO encodable index can be out of range,
         so the exact-max request is skipped — that upgrade turns the
         O(runs) header walk into an O(values) scan, the single hottest host
-        cost on dictionary-heavy files (~30% of lineitem16's host phase).
-        A deferred device-side max is NOT an alternative: any device→host
-        sync of computed results poisons the tunnel's async throughput
-        (measured 10x+ end-to-end regression), which is why the range check
-        must resolve host-side.
+        cost on dictionary-heavy files (~4 s of a 100-row-group 22 s scan).
+        A deferred device-side max is NOT an alternative even with the
+        round-4 single end-of-scan sync: round 4 measured the per-chunk
+        `_max_jit` executions themselves (dependent on pending expansion
+        outputs) at ~190 ms each on the tunneled backend — 0.46 s vs 9.76 s
+        for the 5M-row lineitem scan, same process, same weather.
+        TPQ_DEFER_DICT_CHECK=1 opts into the deferred path anyway (for
+        backends without the per-execution latency).
         """
         stream = p.raw[p.value_pos :]
         if len(stream) < 1:
@@ -1368,8 +1409,9 @@ class _ChunkAssembler:
         if width > 32:
             raise ParquetError(f"dictionary index width {width} invalid")
         covered = width < 31 and self.dict_len >= (1 << width)
+        defer = os.environ.get("TPQ_DEFER_DICT_CHECK", "") == "1"
         meta = parse_hybrid_meta(stream, width, p.defined, pos=1,
-                                 compute_max=not covered)
+                                 compute_max=not covered and not defer)
         if p.defined == 0:
             pass  # no indices: nothing to fold into the max
         elif covered:
@@ -1926,6 +1968,8 @@ class DeviceFileReader:
                  profile_dir: "str | None" = None, max_memory: int = 0,
                  row_filter=None):
         from .reader import FileReader
+
+        _enable_compile_cache()
 
         self._host = FileReader(source, columns=columns,
                                 validate_crc=validate_crc,
