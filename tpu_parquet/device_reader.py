@@ -600,9 +600,11 @@ def _enable_compile_cache() -> None:
     try:
         if jax.config.jax_compilation_cache_dir:
             return  # application (or JAX_COMPILATION_CACHE_DIR) already chose
+        # per-backend dir: CPU AOT entries compiled by one process flavor
+        # can trip machine-feature mismatches when another loads them
         jax.config.update(
             "jax_compilation_cache_dir",
-            env or f"/tmp/tpq_jax_cache_{os.getuid()}",
+            env or f"/tmp/tpq_jax_cache_{os.getuid()}_{jax.default_backend()}",
         )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
