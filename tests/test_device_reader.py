@@ -895,3 +895,92 @@ def test_device_snappy_kill_switch(tmp_path, monkeypatch):
     with DeviceFileReader(p) as r:
         (rg,) = list(r.iter_row_groups())
         assert np.array_equal(rg["v"].to_host(), vals)
+
+
+def test_device_snappy_deep_copy_chain(tmp_path, monkeypatch):
+    """A constant DOUBLE column produces an RLE-style snappy stream whose
+    copy chain is thousands of ops deep — the pointer-doubling resolver
+    must converge within its static iteration bound and stay bit-exact.
+    (Floats never take the narrow-int transcode, so this routes through
+    _plan_device_snappy by construction.)"""
+    import tpu_parquet.device_reader as DR
+
+    monkeypatch.delenv("TPQ_DEVICE_SNAPPY", raising=False)
+    n = 300000
+    vals = np.full(n, 1.2345678e5)  # constant: maximal back-reference chains
+    schema = build_schema([data_column("d", Type.DOUBLE, FRT.REQUIRED)])
+    p = str(tmp_path / "deep.parquet")
+    with FileWriter(p, schema, use_dictionary=False,
+                    codec=CompressionCodec.SNAPPY, page_size=1 << 20) as w:
+        w.write_columns({"d": vals})
+    used = []
+    orig = DR._ChunkAssembler._plan_device_snappy
+
+    def spy(self, common, stager, name):
+        r = orig(self, common, stager, name)
+        used.append(r is not None)
+        return r
+
+    monkeypatch.setattr(DR._ChunkAssembler, "_plan_device_snappy", spy)
+    with DeviceFileReader(p) as r:
+        out = np.concatenate(
+            [np.asarray(rg["d"].to_host()) for rg in r.iter_row_groups()]
+        )
+        st = r.stats()
+    assert np.array_equal(out.view(np.uint8), vals.view(np.uint8))
+    from tpu_parquet import native
+
+    if native.available():
+        assert all(used) and used, used
+        assert st.pages_device_expanded > 0
+
+
+def test_snappy_plan_four_byte_offset_copy():
+    """Hand-crafted stream with a kind-3 (4-byte little-endian offset) copy
+    — a tag our own compressor never emits — must plan identically to the
+    native decompressor's output (the device resolver consumes exactly this
+    plan; the host-resolver differential pins its semantics)."""
+    from tpu_parquet import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    # uncompressed: 70000 literal bytes then 100 bytes copied from offset 65540
+    lit = bytes(range(256)) * 274  # 70144 bytes
+    lit = lit[:70000]
+    out_len = 70100
+    stream = bytearray()
+    # uvarint length header
+    v = out_len
+    while v >= 0x80:
+        stream.append((v & 0x7F) | 0x80)
+        v >>= 7
+    stream.append(v)
+    # literal (len-1 >= 60 -> 62<<2 with 3 extra length bytes)
+    ln = len(lit) - 1
+    stream.append(62 << 2)
+    stream += bytes([ln & 0xFF, (ln >> 8) & 0xFF, (ln >> 16) & 0xFF])
+    stream += lit
+    # kind-3 copy: len 100 (split: 64 + 36), offset 65540 (> 2^16)
+    for clen in (64, 36):
+        stream.append(((clen - 1) << 2) | 3)
+        off = 65540
+        stream += bytes([off & 0xFF, (off >> 8) & 0xFF,
+                         (off >> 16) & 0xFF, (off >> 24) & 0xFF])
+    data = bytes(stream)
+    want = native.snappy_decompress(data, out_len)
+    r = native.snappy_plan(data, out_len)
+    assert not isinstance(r, int) and r is not None
+    dst_end, op_src, is_lit, depth = r
+    # execute the plan on host (mirror of the device resolver's semantics)
+    out = np.zeros(out_len, np.uint8)
+    comp = np.frombuffer(data, np.uint8)
+    start = 0
+    for e, s, lt in zip(dst_end, op_src, is_lit):
+        if lt:
+            out[start:e] = comp[s : s + (e - start)]
+        else:
+            for i in range(e - start):
+                out[start + i] = out[start - s + (i % s)]
+        start = e
+    assert bytes(out) == bytes(want)
+    assert depth >= 1
