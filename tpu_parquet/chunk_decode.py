@@ -182,7 +182,7 @@ class ChunkDecoder:
                 )
             if len(raw) < 1:
                 raise ParquetError("dictionary page data truncated (missing width)")
-            width = raw[0]
+            width = int(raw[0])
             if width > 32:
                 raise ParquetError(f"dictionary index width {width} invalid")
             idx = rle.decode(raw[1:], width, count).astype(np.int64)

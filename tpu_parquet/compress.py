@@ -171,11 +171,16 @@ class SnappyCompressor(BlockCompressor):
             return _native.snappy_compress(bytes(block))
         return _py_snappy_compress(bytes(block))
 
-    def decompress_block(self, block: bytes, uncompressed_size: int) -> bytes:
+    def decompress_block(self, block: bytes, uncompressed_size: int):
+        # returns bytes OR a uint8 numpy array (bytes-like, zero-copy native
+        # path) — consumers must compare/concatenate by content, not type
         try:
             if _native.available():
+                # no bytes() copy: the native wrapper takes any contiguous
+                # buffer, and returns a uint8 array (not bytes) so the
+                # output isn't copied either
                 return _native.snappy_decompress(
-                    bytes(block), max_size=max(uncompressed_size, 0)
+                    block, max_size=max(uncompressed_size, 0)
                 )
             return _py_snappy_decompress(
                 bytes(block), max_size=max(uncompressed_size, 0)
@@ -264,8 +269,12 @@ def compress_block(block: bytes, codec: int) -> bytes:
     return get_codec(codec).compress_block(block)
 
 
-def decompress_block(block: bytes, codec: int, uncompressed_size: int) -> bytes:
+def decompress_block(block: bytes, codec: int, uncompressed_size: int):
     """Decompress and validate the size declared in the page header.
+
+    Returns a bytes-LIKE buffer: ``bytes`` from most codecs, a uint8 numpy
+    array from the zero-copy native snappy path.  All in-tree consumers
+    slice/view via the buffer protocol.
 
     Mirrors newBlockReader (compress.go:131-152): a mismatch between the header's
     uncompressed_page_size and actual output is corruption, not a warning.

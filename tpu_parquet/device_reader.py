@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from dataclasses import dataclass
 from typing import Optional
 
@@ -771,15 +772,17 @@ def _plan_hybrid_pallas(stager: _RowGroupStager, pages_info, width: int,
     # into real groups)
     stager.note_read_extent(bp_base, gpad * width)
 
-    def run(buf_dev):
-        vals = unpack_bp_groups(buf_dev, bp_base, width, gpad,
+    def fn(buf_dev, bp_base_d, tbase_d, total_d):
+        vals = unpack_bp_groups(buf_dev, bp_base_d, width, gpad,
                                 interpret=interpret)
         return _hybrid_combine_staged_jit(
-            vals, buf_dev, np.int64(tbase), np.int32(total),
-            count=count_pad, rp=rp,
+            vals, buf_dev, tbase_d, total_d, count=count_pad, rp=rp,
         )
 
-    return run
+    return _Plan(
+        ("lvlp", width, gpad, rp, count_pad, bool(interpret)), fn,
+        (np.int32(bp_base), np.int64(tbase), np.int32(total)), None,
+    )
 
 
 def _merge_run_tables(ends_l, rle_l, vals_l, starts_l, fill_end,
@@ -808,6 +811,182 @@ def _merge_run_tables(ends_l, rle_l, vals_l, starts_l, fill_end,
     if rwidths is not None:
         return ends, is_rle, rvals, starts, rwidths
     return ends, is_rle, rvals, starts
+
+
+class _Plan:
+    """A planned device computation: ``fn(buf_dev, *dyn) -> pytree``.
+
+    The fused row-group dispatch (``_run_plans``) traces every chunk's plan
+    into ONE jitted call per row group, so all per-chunk dynamic arguments
+    ride a single batched transfer and the tunneled backend pays ONE
+    dispatch per row group instead of one per chunk (the per-call
+    scalar-argument `device_put`s were 4.9 s of a 27 s warm 100M-row rep).
+
+    Contract — the correctness of the executable cache rests on it:
+
+    - ``key`` must capture EVERY static the traced body closes over.  The
+      fused executable for a row group is cached by the tuple of plan keys;
+      a later row group with an equal key tuple reuses the FIRST row
+      group's traced closures, so any per-row-group value not in ``dyn``
+      and not in ``key`` silently decodes with stale state.
+    - ``dyn`` carries all per-row-group values (numpy scalars/arrays).
+      Shape changes are safe (jit respecializes); value changes through
+      closures are not.
+    - ``build(res)`` runs host-side with the jit outputs and the CURRENT
+      row group's metadata (it is never cached).
+    - ``fn=None`` marks a pass-through plan whose result was already
+      materialized at prepare time (`_finish_host`); ``build(None)``
+      returns it.
+    """
+
+    __slots__ = ("key", "fn", "dyn", "build")
+
+    def __init__(self, key, fn, dyn, build):
+        self.key = key
+        self.fn = fn
+        self.dyn = tuple(dyn)
+        self.build = build
+
+
+_FUSED_CACHE: dict = {}
+_FUSED_LOCK = threading.Lock()
+# NOTE: whole-row-group fusion (one jit over every chunk's plan) was built
+# and measured first: any per-row-group static flip (a narrow-transcode k,
+# a snappy iter bucket) changes the FUSED signature and recompiles the
+# entire 16-column graph — minutes per signature on the tunneled backend.
+# Per-plan executables keep the round-4 cache granularity; the per-call
+# transfer cost is killed by _memo_dev instead.
+_FUSE_RG = os.environ.get("TPQ_FUSE_RG", "") == "1"
+
+_DEV_MEMO: dict = {}
+_DEV_MEMO_MAX_ARRAY = 4096  # bytes; tables above this ride the staged buffer
+
+
+def _memo_dev(x):
+    """Device-resident memo for small dynamic plan arguments.
+
+    The staged-buffer layout of a uniform file is identical across row
+    groups, so per-chunk scalar args (byte bases, table offsets, value
+    counts) repeat with the SAME VALUES every row group.  Shipping each
+    distinct value once and handing jit an already-committed device array
+    makes later row groups' dispatches transfer-free — the per-call scalar
+    `device_put`s were 4.9 s of a 27 s warm 100M-row rep on the tunneled
+    backend (BENCH_SCALE20.md)."""
+    if isinstance(x, np.generic):
+        key = ("s", x.dtype.str, x.item())
+    elif isinstance(x, np.ndarray):
+        if x.ndim == 0:
+            key = ("s", x.dtype.str, x.item())
+        elif x.nbytes <= _DEV_MEMO_MAX_ARRAY:
+            key = ("a", x.dtype.str, x.shape, x.tobytes())
+        else:
+            return x
+    else:
+        return x
+    hit = _DEV_MEMO.get(key)
+    if hit is None:
+        if len(_DEV_MEMO) > 8192:
+            _DEV_MEMO.clear()
+        hit = jax.device_put(x)
+        _DEV_MEMO[key] = hit
+    return hit
+
+
+def _single_for(key, fn):
+    """Per-plan jitted runner, cached by the plan's static key (so the
+    executable set has exactly the round-4 granularity: one per
+    (kernel-family, bucket) combination, never per row group)."""
+    with _FUSED_LOCK:
+        hit = _FUSED_CACHE.get(key)
+        if hit is None:
+            hit = jax.jit(fn)
+            _FUSED_CACHE[key] = hit
+        return hit
+
+
+def _fused_for(key, fns, arities):
+    """The jitted all-plans runner for a row-group signature (cached)."""
+    with _FUSED_LOCK:
+        hit = _FUSED_CACHE.get(key)
+        if hit is not None:
+            return hit
+
+        def run_all(buf, dyn):
+            outs, i = [], 0
+            for fn, k in zip(fns, arities):
+                outs.append(fn(buf, *dyn[i : i + k]))
+                i += k
+            return tuple(outs)
+
+        jitted = jax.jit(run_all)
+        _FUSED_CACHE[key] = jitted
+        return jitted
+
+
+def _run_plans(plans, buf_dev):
+    """Execute ``[(name, _Plan)]`` against the staged buffer: pass-throughs
+    directly, everything else through per-plan cached jits with
+    device-memoized arguments (or one fused call under TPQ_FUSE_RG=1)."""
+    out = {}
+    traced = []
+    for name, p in plans:
+        if p.fn is None:
+            out[name] = p.build(None)
+        else:
+            traced.append((name, p))
+    if not traced:
+        return out
+    if _FUSE_RG:
+        key = tuple(p.key for _, p in traced)
+        fused = _fused_for(
+            key,
+            tuple(p.fn for _, p in traced),
+            tuple(len(p.dyn) for _, p in traced),
+        )
+        dyn = tuple(_memo_dev(x) for _, p in traced for x in p.dyn)
+        results = fused(buf_dev, dyn)
+        for (name, p), res in zip(traced, results):
+            out[name] = p.build(res)
+        return out
+    for name, p in traced:
+        jfn = _single_for(p.key, p.fn)
+        out[name] = p.build(jfn(buf_dev, *(_memo_dev(x) for x in p.dyn)))
+    return out
+
+
+def _compose_column(value_plan: "_Plan", d_plan, r_plan) -> "_Plan":
+    """Fuse a chunk's value plan with its def/rep level plans into one
+    _Plan producing the finished DeviceColumnData."""
+    if value_plan.fn is None and d_plan is None and r_plan is None:
+        return value_plan
+    nv = len(value_plan.dyn)
+    nd = len(d_plan.dyn) if d_plan is not None else 0
+    v_fn, d_fn = value_plan.fn, d_plan.fn if d_plan is not None else None
+    r_fn = r_plan.fn if r_plan is not None else None
+    key = ("col", value_plan.key,
+           d_plan.key if d_plan is not None else None,
+           r_plan.key if r_plan is not None else None)
+
+    def fn(buf, *dyn):
+        vres = v_fn(buf, *dyn[:nv]) if v_fn is not None else None
+        dres = d_fn(buf, *dyn[nv : nv + nd]) if d_fn is not None else None
+        rres = r_fn(buf, *dyn[nv + nd :]) if r_fn is not None else None
+        return (vres, dres, rres)
+
+    dyn = (value_plan.dyn
+           + (d_plan.dyn if d_plan is not None else ())
+           + (r_plan.dyn if r_plan is not None else ()))
+
+    def build(res):
+        vres, dres, rres = res
+        col = value_plan.build(vres)
+        if d_plan is not None:
+            col.def_levels = dres
+        if r_plan is not None:
+            col.rep_levels = rres
+        return col
+
+    return _Plan(key, fn, dyn, build)
 
 
 class _ChunkAssembler:
@@ -916,23 +1095,13 @@ class _ChunkAssembler:
             # boolean RLE: host decode per page, stage per chunk
             value_fn = self._finish_host(common)
 
-        # every closure has captured what it needs; dropping the parsed pages
+        # every plan has captured what it needs; dropping the parsed pages
         # here releases all raw decompressed page bytes before dispatch (the
         # iter_row_groups pipeline otherwise pins a whole extra row group)
         self.pages = []
-
-        @scoped_x64
-        def run(buf_dev) -> DeviceColumnData:
-            col = value_fn(buf_dev)
-            # level arrays expand on device from the staged RLE streams at
-            # the bucketed slot count (tail zeros past num_leaf_slots)
-            if d_plan is not None:
-                col.def_levels = d_plan(buf_dev)
-            if r_plan is not None:
-                col.rep_levels = r_plan(buf_dev)
-            return col
-
-        return run
+        # level arrays expand on device from the staged RLE streams at the
+        # bucketed slot count (tail zeros past num_leaf_slots)
+        return _compose_column(value_fn, d_plan, r_plan)
 
     def _plan_levels(self, stager: _RowGroupStager, streams, width: int,
                      slots: int, slots_pad: int, metas=None):
@@ -987,11 +1156,13 @@ class _ChunkAssembler:
         ends, is_rle, rvals, starts = _merge_run_tables(
             ends_l, rle_l, vals_l, starts_l, fill_end=slots
         )
-        return lambda buf_dev: _hybrid_jit(
-            buf_dev, jnp.asarray(ends), jnp.asarray(is_rle),
-            jnp.asarray(rvals), jnp.asarray(starts), np.int64(slots),
-            width=width, count=slots_pad,
-        )
+
+        def fn(buf_dev, ends_d, isr_d, rvals_d, starts_d, slots_d):
+            return _hybrid_jit(buf_dev, ends_d, isr_d, rvals_d, starts_d,
+                               slots_d, width=width, count=slots_pad)
+
+        return _Plan(("lvlx", width, slots_pad), fn,
+                     (ends, is_rle, rvals, starts, np.int64(slots)), None)
 
     def _value_segments(self, stager: _RowGroupStager) -> np.ndarray:
         """Register all pages' value streams back-to-back; returns byte bases
@@ -1036,10 +1207,12 @@ class _ChunkAssembler:
         base, defined, count = self._stage_fixed_width(
             stager, np.dtype(name).itemsize
         )
-        return lambda buf_dev: DeviceColumnData(
-            values=_plain_jit(buf_dev, np.int64(base), dtype=name, count=count),
-            n_values=defined,
-            **common,
+        return _Plan(
+            ("plain", name, count),
+            lambda buf, base_d: _plain_jit(buf, base_d, dtype=name,
+                                           count=count),
+            (np.int64(base),),
+            lambda v: DeviceColumnData(values=v, n_values=defined, **common),
         )
 
     def _plan_device_snappy(self, common, stager, name: str):
@@ -1169,13 +1342,14 @@ class _ChunkAssembler:
         defined = int(vstart[-1])
         count = _bucket_count(defined)
         self.pages_kept_compressed = len([1 for _, r, _ in plans if r])
-        return lambda buf_dev: DeviceColumnData(
-            values=_snappy_plain_staged_jit(
-                buf_dev, np.int64(tbase), n_ops=n_ops_pad, out_pad=out_pad,
+        return _Plan(
+            ("snappy", n_ops_pad, out_pad, iters, name, count, pages_pad),
+            lambda buf, tbase_d: _snappy_plain_staged_jit(
+                buf, tbase_d, n_ops=n_ops_pad, out_pad=out_pad,
                 iters=iters, dtype=name, count=count, n_pages=pages_pad,
             ),
-            n_values=defined,
-            **common,
+            (np.int64(tbase),),
+            lambda v: DeviceColumnData(values=v, n_values=defined, **common),
         )
 
     def _plan_narrow_ints(self, common, stager, name: str):
@@ -1230,11 +1404,12 @@ class _ChunkAssembler:
         base = stager.add(out)
         stager.note_read_extent(base, count * k)
         bias = np.int32(mn) if name == "int32" else np.int64(mn)
-        return lambda buf_dev: DeviceColumnData(
-            values=_plain_narrow_jit(buf_dev, np.int64(base), bias,
-                                     k=k, dtype=name, count=count),
-            n_values=defined,
-            **common,
+        return _Plan(
+            ("narrow", k, name, count),
+            lambda buf, base_d, bias_d: _plain_narrow_jit(
+                buf, base_d, bias_d, k=k, dtype=name, count=count),
+            (np.int64(base), bias),
+            lambda v: DeviceColumnData(values=v, n_values=defined, **common),
         )
 
     def _finish_plain_rows(self, common, stager, k: int, flba: bool = False):
@@ -1243,19 +1418,21 @@ class _ChunkAssembler:
         (offsets, heap) ragged form (matching the host decoder)."""
         base, defined, count = self._stage_fixed_width(stager, k)
 
-        def run(buf_dev):
+        def fn(buf, base_d):
+            if flba:
+                return _plain_flba_jit(buf, base_d, k=k, count=count)
+            return _plain_rows_jit(buf, base_d, k=k, count=count)
+
+        def build(res):
             col = DeviceColumnData(n_values=defined, **common)
             if flba:
-                col.offsets, col.heap = _plain_flba_jit(
-                    buf_dev, np.int64(base), k=k, count=count
-                )
+                col.offsets, col.heap = res
             else:
-                col.values = _plain_rows_jit(
-                    buf_dev, np.int64(base), k=k, count=count
-                )
+                col.values = res
             return col
 
-        return run
+        return _Plan(("rows", k, bool(flba), count), fn, (np.int64(base),),
+                     build)
 
     def _finish_plain_bool(self, common, stager):
         defined = sum(p.defined for p in self.pages)
@@ -1275,13 +1452,13 @@ class _ChunkAssembler:
         for i, p in enumerate(self.pages):
             starts[i] = acc
             acc += p.defined
-        return lambda buf_dev: DeviceColumnData(
-            values=_bool_pages_jit(
-                buf_dev, jnp.asarray(byte_base), jnp.asarray(starts),
-                count=_bucket_count(defined),
-            ),
-            n_values=defined,
-            **common,
+        count = _bucket_count(defined)
+        return _Plan(
+            ("bool", count, n_pages),
+            lambda buf, bb_d, st_d: _bool_pages_jit(buf, bb_d, st_d,
+                                                    count=count),
+            (byte_base, starts),
+            lambda v: DeviceColumnData(values=v, n_values=defined, **common),
         )
 
     def _finish_plain_bytes(self, common, stager):
@@ -1326,15 +1503,19 @@ class _ChunkAssembler:
                   out=pvs[1 : len(self.pages) + 1])
         tbase = _pack_tables(stager, [page_base, pvs])
 
-        def run(buf_dev):
-            offsets, heap = _plain_bytes_staged_jit(
-                buf_dev, np.int64(lens_base), np.int64(tbase),
-                count_pad=count_pad, heap_pad=heap_pad, n_pages=n_pages,
-            )
+        def build(res):
+            offsets, heap = res
             return DeviceColumnData(offsets=offsets, heap=heap, n_values=n,
                                     **common)
 
-        return run
+        return _Plan(
+            ("bytes", count_pad, heap_pad, n_pages),
+            lambda buf, lb_d, tb_d: _plain_bytes_staged_jit(
+                buf, lb_d, tb_d, count_pad=count_pad, heap_pad=heap_pad,
+                n_pages=n_pages),
+            (np.int64(lens_base), np.int64(tbase)),
+            build,
+        )
 
     def _finish_plain_bytes_host(self, common, stager):
         """PLAIN BYTE_ARRAY chunk: native host walk per page, merged offsets,
@@ -1369,20 +1550,20 @@ class _ChunkAssembler:
         n_off = _bucket_count(n + 1)
         stager.note_read_extent(off_base, n_off * 8)
 
-        def run(buf_dev):
+        def fn(buf, off_d, heap_d):
+            # bucketed offset count (tail garbage past n+1, sliced by
+            # to_host); bucketed heap slice (zero padding past offsets[-1],
+            # trimmed on host) keeps executables shared
+            return (_plain_jit(buf, off_d, dtype="int64", count=n_off),
+                    _dynslice_jit(buf, heap_d, size=heap_room))
+
+        def build(res):
             col = DeviceColumnData(n_values=n, **common)
-            # bucketed offset count (tail garbage past n+1, sliced by to_host)
-            col.offsets = _plain_jit(
-                buf_dev, np.int64(off_base), dtype="int64", count=n_off
-            )
-            # bucketed slice: heap may carry zero padding past offsets[-1]
-            # (trimmed on host by to_host); keeps executables shared
-            col.heap = _dynslice_jit(
-                buf_dev, np.int64(heap_base), size=heap_room
-            )
+            col.offsets, col.heap = res
             return col
 
-        return run
+        return _Plan(("bytesh", n_off, heap_room), fn,
+                     (np.int64(off_base), np.int64(heap_base)), build)
 
     def _parse_dict_index_page(self, p, host_max):
         """Parse one RLE_DICTIONARY page's index stream; folds the host-side
@@ -1396,22 +1577,23 @@ class _ChunkAssembler:
         so the exact-max request is skipped — that upgrade turns the
         O(runs) header walk into an O(values) scan, the single hottest host
         cost on dictionary-heavy files (~4 s of a 100-row-group 22 s scan).
-        A deferred device-side max is NOT an alternative even with the
-        round-4 single end-of-scan sync: round 4 measured the per-chunk
-        `_max_jit` executions themselves (dependent on pending expansion
-        outputs) at ~190 ms each on the tunneled backend — 0.46 s vs 9.76 s
-        for the 5M-row lineitem scan, same process, same weather.
-        TPQ_DEFER_DICT_CHECK=1 opts into the deferred path anyway (for
-        backends without the per-execution latency).
+        For the uncovered case the max now DEFAULTS to the device: since the
+        _Plan refactor the ``jnp.max`` rides INSIDE the chunk's one fused
+        executable (zero extra dispatches — round 4's opt-in deferral paid
+        ~190 ms per separate `_max_jit` execution on the tunneled backend,
+        which is why it lost 20× then), and all deferred maxima sync once
+        at finalize via one stacked fetch (_finalize_many).
+        TPQ_DEFER_DICT_CHECK=0 forces the native O(values) host scan back
+        on (for corrupt-input diagnosis at the exact page).
         """
         stream = p.raw[p.value_pos :]
         if len(stream) < 1:
             raise ParquetError("dictionary page data truncated (missing width)")
-        width = stream[0]
+        width = int(stream[0])
         if width > 32:
             raise ParquetError(f"dictionary index width {width} invalid")
         covered = width < 31 and self.dict_len >= (1 << width)
-        defer = os.environ.get("TPQ_DEFER_DICT_CHECK", "") == "1"
+        defer = os.environ.get("TPQ_DEFER_DICT_CHECK", "1") != "0"
         meta = parse_hybrid_meta(stream, width, p.defined, pos=1,
                                  compute_max=not covered and not defer)
         if p.defined == 0:
@@ -1485,9 +1667,38 @@ class _ChunkAssembler:
             )
         self._check_dict_range(prefix, host_max)
         dict_u8 = self.dict_u8
-        dict_base = dict_kp = dict_itemsize = None
-        roff_base = rheap_base = roff_n = rheap_room = None
-        if dict_u8 is not None:
+        has_u8 = dict_u8 is not None
+        cp = _bucket_count(prefix)
+        dyn: list = []
+        if plan is not None:
+            idx_key, idx_fn, idx_arity = plan.key, plan.fn, len(plan.dyn)
+            dyn.extend(plan.dyn)
+        elif uniform:
+            idx_key = ("hyb", width, cp)
+            idx_arity = 5
+
+            def idx_fn(buf, e, r, v, s, nv):
+                return _hybrid_jit(buf, e, r, v, s, nv, width=width, count=cp)
+
+            dyn.extend((ends, is_rle, rvals, starts, np.int64(prefix)))
+        else:
+            # per-page index widths differ (dictionary grew page to page):
+            # same fused expansion with per-run widths
+            mw = min(max(8, (max(page_widths) + 7) // 8 * 8), 32)
+            idx_key = ("hybvw", mw, cp)
+            idx_arity = 6
+
+            def idx_fn(buf, e, r, v, s, w, nv):
+                return _hybrid_vw_jit(buf, e, r, v, s, w, nv, max_width=mw,
+                                      count=cp)
+
+            dyn.extend((ends, is_rle, rvals, starts, rwidths,
+                        np.int64(prefix)))
+        # no native walk: deferred on-device range check (max rides the
+        # fused call's outputs, one sync at finalize); bucketing tail lanes
+        # are zeroed by n_valid, so the max reflects only real indices
+        need_max = bool(prefix) and host_max is None
+        if has_u8:
             # dictionary bytes ride the row-group buffer (no extra transfer);
             # the row count is bucketed so the slice/gather executables are
             # shared across chunks with different dict sizes
@@ -1498,7 +1709,9 @@ class _ChunkAssembler:
             # never a neighboring chunk's staged bytes
             dict_base = stager.add(np.ascontiguousarray(dict_u8),
                                    reserve=dict_kp * dict_itemsize)
-        elif self.dict_ragged is not None:
+            dyn.append(np.int64(dict_base))
+            dkey = ("du8", dict_kp, dict_itemsize)
+        else:
             # ragged (string) dictionaries ride the buffer too — two
             # jnp.asarray transfers per chunk otherwise dominate dict-heavy
             # scans at many-row-group scale (~2.5 ms per transfer)
@@ -1509,54 +1722,46 @@ class _ChunkAssembler:
             rheap = np.ascontiguousarray(self.dict_ragged.heap)
             rheap_room = _bucket_bytes(max(rheap.nbytes, 1), 64)
             rheap_base = stager.add(rheap, reserve=rheap_room)
+            dyn.extend((np.int64(roff_base), np.int64(rheap_base)))
+            dkey = ("drag", roff_n, rheap_room)
 
-        def run(buf_dev):
-            if plan is not None:
-                idx = plan(buf_dev)
-            elif uniform:
-                idx = _hybrid_jit(
-                    buf_dev, jnp.asarray(ends), jnp.asarray(is_rle),
-                    jnp.asarray(rvals), jnp.asarray(starts), np.int64(prefix),
-                    width=width, count=_bucket_count(prefix),
-                )
+        def fn(buf, *d):
+            idx = idx_fn(buf, *d[:idx_arity])
+            outs = {"idx": idx}
+            if has_u8:
+                outs["du8"] = _dict_rows_jit(buf, d[idx_arity], k=dict_kp,
+                                             itemsize=dict_itemsize)
             else:
-                # per-page index widths differ (dictionary grew page to
-                # page): same fused expansion with per-run widths
-                idx = _hybrid_vw_jit(
-                    buf_dev, jnp.asarray(ends), jnp.asarray(is_rle),
-                    jnp.asarray(rvals), jnp.asarray(starts),
-                    jnp.asarray(rwidths), np.int64(prefix),
-                    max_width=min(max(8, (max(page_widths) + 7) // 8 * 8), 32),
-                    count=_bucket_count(prefix),
-                )
-            if prefix and host_max is None:
-                # no native walk: fall back to the deferred on-device range
-                # check (one extra executable + one sync at finalize);
-                # bucketing tail lanes are zeroed by n_valid, so the max
-                # still reflects only real indices
-                self._deferred.append(
-                    (_max_jit(idx), self.dict_len, ".".join(self.leaf.path))
-                )
-            col = DeviceDictColumn(indices=idx, n_values=prefix, **common)
-            if dict_u8 is not None:
-                col.dict_u8 = _dict_rows_jit(
-                    buf_dev, np.int64(dict_base), k=dict_kp,
-                    itemsize=dict_itemsize,
-                )
-                col.dict_dtype = self.dict_dtype
+                # device slices of the staged ragged dictionary (padding
+                # past the real offsets is garbage consumers never index:
+                # every valid dict index is < dict_len)
+                outs["doff"] = _plain_jit(buf, d[idx_arity], dtype="int64",
+                                          count=roff_n)
+                outs["dheap"] = _dynslice_jit(buf, d[idx_arity + 1],
+                                              size=rheap_room)
+            if need_max:
+                outs["max"] = _max_jit(idx)
+            return outs
+
+        deferred = self._deferred
+        dict_len = self.dict_len
+        path_name = ".".join(self.leaf.path)
+        dict_dtype = self.dict_dtype
+
+        def build(res):
+            col = DeviceDictColumn(indices=res["idx"], n_values=prefix,
+                                   **common)
+            if has_u8:
+                col.dict_u8 = res["du8"]
+                col.dict_dtype = dict_dtype
             else:
-                # device slices of the staged ragged dictionary (padding past
-                # the real offsets is garbage consumers never index: every
-                # valid dict index is < dict_len)
-                col.dict_offsets = _plain_jit(
-                    buf_dev, np.int64(roff_base), dtype="int64", count=roff_n
-                )
-                col.dict_heap = _dynslice_jit(
-                    buf_dev, np.int64(rheap_base), size=rheap_room
-                )
+                col.dict_offsets = res["doff"]
+                col.dict_heap = res["dheap"]
+            if need_max:
+                deferred.append((res["max"], dict_len, path_name))
             return col
 
-        return run
+        return _Plan(("dict", idx_key, dkey, need_max), fn, tuple(dyn), build)
 
     def _finish_delta(self, common, stager):
         ptype = self.leaf.physical_type
@@ -1628,16 +1833,18 @@ class _ChunkAssembler:
         max_width = min((max_width + 7) // 8 * 8, 64)  # byte-rounded: 8 shapes
         tbase = _pack_tables(stager, [firsts, bstarts, widths, bmins,
                                       page_starts])
-        return lambda buf_dev: DeviceColumnData(
-            values=_delta_pages_staged_jit(
-                buf_dev, np.int64(tbase),
-                values_per_mini=metas[0].values_per_mini, mb=mb, count=count,
-                bits=bits, max_width=max_width,
-                total=_bucket_count(total_real),
-                n_pages=n_pages, m_max=m_max,
-            ),
-            n_values=total_real,
-            **common,
+        vpm = metas[0].values_per_mini
+        total_b = _bucket_count(total_real)
+        return _Plan(
+            ("delta", vpm, mb, count, bits, max_width, total_b, n_pages,
+             m_max),
+            lambda buf, tb_d: _delta_pages_staged_jit(
+                buf, tb_d, values_per_mini=vpm, mb=mb, count=count,
+                bits=bits, max_width=max_width, total=total_b,
+                n_pages=n_pages, m_max=m_max),
+            (np.int64(tbase),),
+            lambda v: DeviceColumnData(values=v, n_values=total_real,
+                                       **common),
         )
 
     def _finish_mixed_dict_plain(self, common, stager):
@@ -1713,38 +1920,65 @@ class _ChunkAssembler:
         dict_len = self.dict_len
         path_name = ".".join(self.leaf.path)
 
-        def run(buf_dev):
+        # dynamic layout: per live dict call (ends, is_rle, values, starts,
+        # i64 count) · dict rows array · per plain call i64 base — statics
+        # (widths, counts, contiguity) all ride the key
+        live_calls = [c for c in dict_calls if c[5]]
+        wc = tuple((w, c) for _, _, _, _, w, c in live_calls)
+        need_max = bool(prefix) and host_max is None
+        plain_desc = (("contig", plain_total) if plain_calls is None
+                      else tuple(c for _, c in plain_calls))
+        dyn: list = []
+        for e, r, v, s, _w, c in live_calls:
+            dyn.extend((e, r, v, s, np.int64(c)))
+        if prefix:
+            dyn.append(np.ascontiguousarray(dict_u8))
+        if plain_total:
+            if plain_calls is None:
+                dyn.append(np.int64(plain_base))
+            else:
+                dyn.extend(np.int64(b) for b, _ in plain_calls)
+
+        def fn(buf, *d):
             parts = []
+            outs = {}
+            j = 0
             if prefix:
-                idx_parts = [
-                    _hybrid_jit(
-                        buf_dev, jnp.asarray(e), jnp.asarray(r),
-                        jnp.asarray(v), jnp.asarray(s), np.int64(c),
-                        width=w, count=c,
-                    )
-                    for e, r, v, s, w, c in dict_calls if c
-                ]
+                idx_parts = []
+                for w, c in wc:
+                    e, r, v, s, nv = d[j : j + 5]
+                    j += 5
+                    idx_parts.append(
+                        _hybrid_jit(buf, e, r, v, s, nv, width=w, count=c))
                 idx = (idx_parts[0] if len(idx_parts) == 1
                        else _concat_jit(idx_parts))
-                if host_max is None:
-                    deferred.append((_max_jit(idx), dict_len, path_name))
-                parts.append(
-                    _dict_gather_bytes_jit(jnp.asarray(dict_u8), idx,
-                                           dtype=dict_dtype)
-                )
+                if need_max:
+                    outs["max"] = _max_jit(idx)
+                parts.append(_dict_gather_bytes_jit(d[j], idx,
+                                                    dtype=dict_dtype))
+                j += 1
             if plain_total:
                 if plain_calls is None:
-                    parts.append(_plain_jit(buf_dev, np.int64(plain_base),
-                                            dtype=name, count=plain_total))
+                    parts.append(_plain_jit(buf, d[j], dtype=name,
+                                            count=plain_total))
                 else:
-                    parts.extend(
-                        _plain_jit(buf_dev, np.int64(b), dtype=name, count=c)
-                        for b, c in plain_calls
-                    )
-            vals = parts[0] if len(parts) == 1 else _concat_jit(parts)
-            return DeviceColumnData(values=vals, **common)
+                    for _, c in plain_calls:
+                        parts.append(_plain_jit(buf, d[j], dtype=name,
+                                                count=c))
+                        j += 1
+            outs["vals"] = parts[0] if len(parts) == 1 else _concat_jit(parts)
+            return outs
 
-        return run
+        def build(res):
+            if need_max:
+                deferred.append((res["max"], dict_len, path_name))
+            return DeviceColumnData(values=res["vals"], **common)
+
+        return _Plan(
+            ("mixed", name, dict_dtype, wc, bool(prefix), plain_desc,
+             need_max),
+            fn, tuple(dyn), build,
+        )
 
     def _finish_host(self, common):
         """Host decode per page (byte arrays, INT96, BSS, boolean RLE, mixed);
@@ -1785,7 +2019,8 @@ class _ChunkAssembler:
             )
         else:
             out.values = jnp.asarray(np.zeros(0, dtype=np.int64))
-        return lambda buf_dev: out
+        # transfers already happened above: pass-through plan
+        return _Plan(None, None, (), lambda _res: out)
 
 
 @scoped_x64
@@ -1873,7 +2108,14 @@ def decode_chunk_batched(
     buf: bytes, codec: int, total_values: int, leaf: SchemaNode,
     deferred_checks: list, validate_crc: bool = False,
 ) -> DeviceColumnData:
-    """Decode one chunk with per-chunk fused dispatch (no blocking syncs)."""
+    """Decode one chunk with per-chunk fused dispatch (no blocking syncs).
+
+    Dictionary-index range checks land in ``deferred_checks`` as
+    (device_max, dict_len, column) tuples — the caller MUST drain them
+    (``DeviceFileReader.finalize`` / ``_finalize_many`` semantics) or the
+    clamped on-device gather silently tolerates corrupt indices.  Callers
+    that decode a single chunk and cannot batch the sync should pass a list
+    and check it immediately."""
     asm = _collect_chunk(buf, codec, total_values, leaf, deferred_checks,
                          validate_crc)
     if asm is None or not asm.pages:
@@ -1882,8 +2124,8 @@ def decode_chunk_batched(
             max_def=leaf.max_def, max_rep=leaf.max_rep, num_leaf_slots=0,
         )
     stager = _RowGroupStager()
-    run = asm.finish(stager)
-    return run(stager.stage())
+    plan = asm.finish(stager)
+    return _run_plans([("c", plan)], stager.stage())["c"]
 
 
 @dataclass
@@ -2188,8 +2430,7 @@ class DeviceFileReader:
         if plans:
             if buf_dev is None:
                 buf_dev = stager.stage()
-            for name, run in plans:
-                out[name] = run(buf_dev)
+            out.update(_run_plans(plans, buf_dev))
         now = _time.perf_counter()
         with self._stats_lock:
             self._stats.device_seconds += now - t0

@@ -876,7 +876,7 @@ class DeviceChunkDecoder:
                 raise ParquetError("dictionary-encoded page but no dictionary page seen")
             if avail < 1:
                 raise ParquetError("dictionary page data truncated (missing width)")
-            width = raw[pos]
+            width = int(raw[pos])
             if width > 32:
                 raise ParquetError(f"dictionary index width {width} invalid")
             meta = parse_hybrid_meta(raw, width, count, pos=pos + 1,

@@ -87,7 +87,8 @@ def decode_byte_array(buf: bytes, count: int) -> ByteArrayData:
     if count > 0:
         from .. import native
 
-        res = native.bytearray_walk(bytes(buf), count)
+        # no bytes() copy: the native wrapper takes any contiguous buffer
+        res = native.bytearray_walk(buf, count)
         if isinstance(res, tuple):
             offsets, heap = res
             return ByteArrayData(offsets=offsets, heap=heap)

@@ -51,7 +51,7 @@ def _read_uvarint(buf: bytes, pos: int) -> tuple[int, int]:
     while True:
         if pos >= n:
             raise RLEError("truncated run header varint")
-        b = buf[pos]
+        b = int(buf[pos])  # int(): numpy uint8 would wrap under << shift
         pos += 1
         result |= (b & 0x7F) << shift
         if not b & 0x80:

@@ -145,13 +145,32 @@ def load():
     return _lib
 
 
-def snappy_decompress(data: bytes, max_size: int = -1) -> bytes:
+def _buf_arg(buf):
+    """ctypes argument for a read-only byte buffer: ``bytes`` passes through
+    (fast path, no conversion); any other contiguous buffer-protocol object
+    (numpy views of decompressed pages, memoryviews of mmap'd chunks) passes
+    as a raw pointer with ZERO copies.  The caller's reference keeps the
+    memory alive for the duration of the call."""
+    if type(buf) is bytes:
+        return buf
+    import numpy as np
+
+    a = np.frombuffer(buf, np.uint8)
+    return ctypes.c_char_p(a.ctypes.data)
+
+
+def snappy_decompress(data, max_size: int = -1):
+    """Raw-snappy decompress; returns a uint8 numpy array (NOT bytes — the
+    extra ``tobytes`` copy was ~1 s of a 100M-row scan's host phase; every
+    downstream consumer slices/views, so the buffer-protocol array is a
+    drop-in)."""
     import numpy as np
 
     lib = load()
     if lib is None:
         raise RuntimeError("native library unavailable")
-    n = lib.tpq_snappy_uncompressed_length(data, len(data))
+    dptr = _buf_arg(data)
+    n = lib.tpq_snappy_uncompressed_length(dptr, len(data))
     if n < 0:
         raise ValueError("malformed snappy data: bad length header")
     if 0 <= max_size < n:
@@ -164,11 +183,11 @@ def snappy_decompress(data: bytes, max_size: int = -1) -> bytes:
     # overwrites every byte on success; failures discard the buffer)
     out = np.empty(n, dtype=np.uint8)
     rc = lib.tpq_snappy_decompress(
-        data, len(data), out.ctypes.data_as(ctypes.c_char_p), n
+        dptr, len(data), out.ctypes.data_as(ctypes.c_char_p), n
     )
     if rc != 0:
         raise ValueError(f"malformed snappy data (error {rc})")
-    return out.tobytes()
+    return out
 
 
 def snappy_compress(data: bytes) -> bytes:
@@ -203,7 +222,7 @@ def delta_meta(buf: bytes, pos: int, cap: int):
     mins = np.empty(cap, dtype=np.uint64)
     pll = ctypes.POINTER(ctypes.c_longlong)
     rc = lib.tpq_delta_meta(
-        buf, len(buf), pos,
+        _buf_arg(buf), len(buf), pos,
         header.ctypes.data_as(pll),
         starts.ctypes.data_as(pll),
         widths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
@@ -244,7 +263,7 @@ def hybrid_meta(buf: bytes, n: int, pos: int, width: int, count: int, cap: int,
     arena[:24] = 0  # scalar slots must read 0 when not requested
     base = arena.ctypes.data
     rc = lib.tpq_hybrid_meta(
-        buf, n, pos, width, count,
+        _buf_arg(buf), n, pos, width, count,
         base + o_ends, base + o_kinds, base + o_vals, base + o_starts, cap,
         base,
         1 if want_max else 0,
@@ -328,7 +347,7 @@ def bytearray_walk(buf: bytes, count: int):
     # (found by fuzz_plain — the tighter bound corrupted the heap allocation)
     heap = np.empty(n, dtype=np.uint8)
     rc = lib.tpq_bytearray_walk(
-        buf, n, count,
+        _buf_arg(buf), n, count,
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
         heap.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
     )
@@ -353,7 +372,7 @@ def bytearray_lengths(buf: bytes, count: int, pos: int = 0):
         return None
     lens = np.empty(count, dtype=np.uint32)
     rc = lib.tpq_bytearray_lengths(
-        buf, len(buf), pos, count,
+        _buf_arg(buf), len(buf), pos, count,
         lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
     )
     if rc < 0:
@@ -395,7 +414,7 @@ def snappy_plan(payload: bytes, expect: int):
         seg = np.zeros(2 * cap2, dtype=np.int64)  # zeroed: depth maxima
         out = np.zeros(2, dtype=np.int64)
         rc = lib.tpq_snappy_plan(
-            payload, n, expect,
+            _buf_arg(payload), n, expect,
             dst_end.ctypes.data_as(pll), op_src.ctypes.data_as(pll),
             is_lit.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
             seg.ctypes.data_as(pll), cap2, out.ctypes.data_as(pll),
@@ -461,7 +480,7 @@ def int_minmax(buf: bytes, pos: int, n: int, width: int):
         return None
     out = np.empty(2, dtype=np.int64)
     lib.tpq_int_minmax(
-        buf, pos, n, width,
+        _buf_arg(buf), pos, n, width,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
     )
     return int(out[0]), int(out[1])
@@ -475,7 +494,7 @@ def int_truncate(buf: bytes, pos: int, n: int, width: int, bias: int, k: int,
     lib = load()
     if lib is None:
         return False
-    lib.tpq_int_truncate(buf, pos, n, width,
+    lib.tpq_int_truncate(_buf_arg(buf), pos, n, width,
                          ctypes.c_uint64(bias % (1 << 64)), k,
                          dst.ctypes.data)
     return True
@@ -496,7 +515,7 @@ def page_header(buf: bytes, pos: int = 0):
     # stack-local ctypes array: per-page numpy allocation + data_as cast
     # would eat a few percent of the win this parser exists for
     out = (ctypes.c_longlong * 40)()
-    rc = lib.tpq_page_header(buf, len(buf), pos, out)
+    rc = lib.tpq_page_header(_buf_arg(buf), len(buf), pos, out)
     if rc < 0:
         return int(rc)
     from ..format import (
