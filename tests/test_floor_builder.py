@@ -1,0 +1,117 @@
+"""Builder-style marshalling round-trips (floor/interfaces/marshaller.go
+MarshalObject/List/Map shapes, incl. the Athena bag special case at
+marshaller.go:100-109, and unmarshaller.go typed access + ErrFieldNotPresent)."""
+
+import pytest
+
+from tpu_parquet.floor.builder import FieldNotPresent, RowBuilder, RowView
+from tpu_parquet.footer import ParquetError
+from tpu_parquet.reader import FileReader
+from tpu_parquet.schema.dsl import parse_schema_definition
+from tpu_parquet.writer import FileWriter
+
+SCHEMA = """message test {
+  required int64 id;
+  optional binary name (STRING);
+  required group who {
+    required binary first (STRING);
+    optional binary last (STRING);
+  }
+  optional group tags (LIST) {
+    repeated group list {
+      required binary element (STRING);
+    }
+  }
+  optional group attrs (MAP) {
+    repeated group key_value {
+      required binary key (STRING);
+      optional int64 value;
+    }
+  }
+}"""
+
+# Athena-style LIST naming (validateListLogicalType lenient shape)
+SCHEMA_BAG = """message athena {
+  optional group tags (LIST) {
+    repeated group bag {
+      optional binary array_element (STRING);
+    }
+  }
+}"""
+
+
+def _build_row(schema, i):
+    b = RowBuilder(schema)
+    b.field("id").set(i)
+    if i % 3:
+        b.field("name").set(f"name{i}".encode())
+    who = b.field("who").group()
+    who.field("first").set(b"Hans")
+    if i % 2:
+        who.field("last").set(b"Mustermann")
+    lst = b.field("tags").list()
+    for k in range(i % 4):
+        lst.add().set(f"tag{k}".encode())
+    m = b.field("attrs").map()
+    for k in range(i % 3):
+        kel, vel = m.add()
+        kel.set(f"k{k}".encode())
+        vel.set(i * 10 + k)
+    return b.data
+
+
+def test_builder_roundtrip(tmp_path):
+    schema = parse_schema_definition(SCHEMA)
+    p = tmp_path / "b.parquet"
+    rows = [_build_row(schema.root, i) for i in range(50)]
+    with FileWriter(p, schema, codec=1) as w:
+        for r in rows:
+            w.write_row(r)
+    with FileReader(p) as r:
+        got = list(r.iter_rows())
+    assert len(got) == 50
+    for i, row in enumerate(got):
+        v = RowView(row, schema.root)
+        assert v.field("id").int64() == i
+        if i % 3:
+            assert v.field("name").bytes() == f"name{i}".encode()
+        who = v.field("who").group()
+        assert who.field("first").bytes() == b"Hans"
+        tags = [e.bytes() for e in v.field("tags").list()]
+        assert tags == [f"tag{k}".encode() for k in range(i % 4)]
+        attrs = {k.bytes(): val.int64() for k, val in v.field("attrs").map()}
+        assert attrs == {f"k{k}".encode(): i * 10 + k for k in range(i % 3)}
+
+
+def test_builder_athena_bag_shape():
+    schema = parse_schema_definition(SCHEMA_BAG)
+    b = RowBuilder(schema.root)
+    lst = b.field("tags").list()
+    lst.add().set(b"x")
+    lst.add().set(b"y")
+    # the builder must have chosen the bag/array_element naming from the schema
+    assert b.data == {"tags": {"bag": [{"array_element": b"x"},
+                                       {"array_element": b"y"}]}}
+    v = RowView(b.data, schema.root)
+    assert [e.bytes() for e in v.field("tags").list()] == [b"x", b"y"]
+
+
+def test_view_errors():
+    schema = parse_schema_definition(SCHEMA)
+    v = RowView({"id": 7, "who": {"first": b"a"}}, schema.root)
+    with pytest.raises(FieldNotPresent):
+        v.field("missing")
+    with pytest.raises(ParquetError):
+        v.field("id").bytes()  # wrong type
+    with pytest.raises(ParquetError):
+        v.field("id").group()
+    assert v.field("id").int64() == 7
+    # FieldNotPresent is a KeyError too (except KeyError idiom works)
+    assert issubclass(FieldNotPresent, KeyError)
+
+
+def test_builder_without_schema_defaults_standard_list():
+    b = RowBuilder()
+    lst = b.field("tags").list()
+    lst.add().set(b"a")
+    assert b.data == {"tags": {"list": [{"element": b"a"}]}}

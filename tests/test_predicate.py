@@ -411,3 +411,45 @@ def test_page_pruning_misaligned_column_boundaries(tmp_path):
     idx = (kk // 5).astype(np.int64)
     assert np.array_equal(vv, v32[idx])
     assert (kk < int(k[3000])).sum() == 3000
+
+
+def test_header_only_walk_matches_walk_pages(tmp_path):
+    """_walk_headers_file (pruning planner's seek-based walk) must yield the
+    SAME data-page ordinal sequence as chunk_decode.walk_pages — skip_pages
+    indices computed by one are applied against the other."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from tpu_parquet.chunk_decode import validate_chunk_meta, walk_pages
+    from tpu_parquet.device_reader import DeviceFileReader
+    from tpu_parquet.format import PageType
+    from tpu_parquet.reader import FileReader
+
+    p = str(tmp_path / "hdrs.parquet")
+    n = 40_000
+    pq.write_table(
+        pa.table({
+            "a": np.arange(n, dtype=np.int64),
+            "s": pa.array([f"v{i % 13}" for i in range(n)]),  # dict page
+        }),
+        p, compression="snappy", row_group_size=n,
+        data_page_size=4096,
+    )
+    with FileReader(p) as host:
+        rg = host.metadata.row_groups[0]
+        for chunk in rg.columns:
+            leaf = {tuple(l.path): l for l in host.schema.leaves}[
+                tuple(chunk.meta_data.path_in_schema)]
+            md, offset = validate_chunk_meta(chunk, leaf)
+            host._f.seek(offset)
+            buf = host._f.read(md.total_compressed_size)
+            want = [ps.header for ps in walk_pages(buf, md.num_values)
+                    if ps.header.type in (PageType.DATA_PAGE,
+                                          PageType.DATA_PAGE_V2)]
+            got = DeviceFileReader._walk_headers_file(
+                host._f, offset, md.total_compressed_size, md.num_values)
+            assert len(got) == len(want) > 1
+            for g, w in zip(got, want):
+                gh = g.data_page_header or g.data_page_header_v2
+                wh = w.data_page_header or w.data_page_header_v2
+                assert gh.num_values == wh.num_values

@@ -256,6 +256,9 @@ class ChunkEncoder:
         self.write_crc = write_crc
         self.fallback_encoding = encoding or Encoding.PLAIN
         self.write_statistics = write_statistics
+        # (min, max) bytes for dict-encoded BYTE_ARRAY page stats; set per
+        # write() from the dictionary (O(distinct)), see _page_statistics
+        self._dict_stat_bounds = None
 
     # -- page boundary selection ----------------------------------------------
 
@@ -322,6 +325,16 @@ class ChunkEncoder:
         if self.use_dictionary and ptype != Type.BOOLEAN:
             dict_pair = _unique_with_indices(cd.values, ptype)
         use_dict = dict_pair is not None
+        # dictionary-wide lexicographic bounds for BYTE_ARRAY page stats:
+        # one O(distinct) pass here instead of O(values) per page
+        self._dict_stat_bounds = None
+        if (use_dict and self.write_statistics
+                and ptype == Type.BYTE_ARRAY
+                and isinstance(dict_pair[0], ByteArrayData)
+                and len(dict_pair[0])):
+            from .stats import _lex_minmax
+
+            self._dict_stat_bounds = _lex_minmax(dict_pair[0])
 
         encodings: set[int] = set()
         encoding_used = Encoding.RLE_DICTIONARY if use_dict else self.fallback_encoding
@@ -424,13 +437,21 @@ class ChunkEncoder:
     def _page_statistics(self, cd: ColumnData, lo, hi, vlo, vhi):
         """Per-page Statistics for fixed-width numeric pages (data_store.go:
         159-179 parity — the reference carries stats in every data page).
-        Ragged/boolean/INT96 pages skip them: the per-page lexicographic
-        pass was the writer's hottest path before stats moved chunk-level,
-        and page pruning keys on numeric sort order anyway."""
+        Dict-encoded BYTE_ARRAY pages carry DICTIONARY-WIDE min/max bounds
+        (set by write(): O(distinct) once per chunk, not O(values) per page
+        — the per-page lexicographic pass was the writer's hottest path) and
+        page-exact null counts; bounds wider than the page's actual values
+        are sound for pruning readers.  Other ragged/boolean/INT96 pages
+        skip stats."""
         if not self.write_statistics:
             return None
         if self.leaf.physical_type not in (Type.INT32, Type.INT64,
                                            Type.FLOAT, Type.DOUBLE):
+            if self._dict_stat_bounds is not None and vhi > vlo:
+                st = Statistics(null_count=(hi - lo) - (vhi - vlo))
+                st.min = st.min_value = self._dict_stat_bounds[0]
+                st.max = st.max_value = self._dict_stat_bounds[1]
+                return st
             return None
         vals = cd.values[vlo:vhi]
         if len(vals) == 0:

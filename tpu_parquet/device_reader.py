@@ -475,19 +475,25 @@ class _RowGroupStager:
         return base
 
     def _copy_range(self, buf: np.ndarray, lo: int, hi: int) -> None:
-        """Zero-fill ``buf`` and copy every registered byte in [lo, hi) into
-        it.  Parts are appended in ascending base order and never mutated, so
-        a worker thread may scan the list while the main thread appends."""
-        buf[:] = 0
+        """Copy every registered byte in [lo, hi) into ``buf``, zeroing only
+        the GAPS (alignment padding + zero-filled reserves) — a full 16 MiB
+        memset per strip re-wrote the whole scan's staged volume once over
+        (~1 s of a 100M-row rep).  Parts are appended in ascending base
+        order and never mutated, so a worker thread may scan the list while
+        the main thread appends."""
+        pos = lo
         for kind, payload, base, nbytes in self._parts:
             if base >= hi:
                 break
             if base + nbytes <= lo:
                 continue
+            s = max(lo, base)
+            if s > pos:
+                buf[pos - lo : s - lo] = 0  # reserve tail / alignment gap
             if kind == "arr":
-                s = max(lo, base)
                 e = min(hi, base + nbytes)
                 buf[s - lo : e - lo] = payload[s - base : e - base]
+                pos = e
             else:
                 off = base
                 for raw, start, size in payload:
@@ -499,7 +505,10 @@ class _RowGroupStager:
                         buf[s - lo : e - lo] = np.frombuffer(
                             raw, np.uint8, e - s, start + (s - off)
                         )
+                        pos = e
                     off += size
+        if pos < hi:
+            buf[pos - lo :] = 0
 
     def _flush_ready(self) -> None:
         """Hand every newly completed strip to the worker (copy + device_put
@@ -602,11 +611,26 @@ def _enable_compile_cache() -> None:
         if jax.config.jax_compilation_cache_dir:
             return  # application (or JAX_COMPILATION_CACHE_DIR) already chose
         # per-backend dir: CPU AOT entries compiled by one process flavor
-        # can trip machine-feature mismatches when another loads them
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            env or f"/tmp/tpq_jax_cache_{os.getuid()}_{jax.default_backend()}",
+        # can trip machine-feature mismatches when another loads them.
+        # User-owned location (NOT world-writable /tmp, where another local
+        # user could pre-create the path and poison the serialized
+        # executables jax would then load); created 0700.
+        cache_root = os.environ.get(
+            "XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")
         )
+        cache_dir = env or os.path.join(
+            cache_root, f"tpq_jax_cache_{jax.default_backend()}"
+        )
+        os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+        st = os.stat(cache_dir)
+        if st.st_uid != os.getuid():
+            return  # refuse a squatted directory; run uncached
+        if st.st_mode & 0o022:
+            # pre-existing dir with group/other write (permissive umask):
+            # close it before trusting — jax deserializes executables from
+            # here
+            os.chmod(cache_dir, 0o700)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:  # noqa: BLE001 — the cache is an optimization only
@@ -1577,14 +1601,14 @@ class _ChunkAssembler:
         so the exact-max request is skipped — that upgrade turns the
         O(runs) header walk into an O(values) scan, the single hottest host
         cost on dictionary-heavy files (~4 s of a 100-row-group 22 s scan).
-        For the uncovered case the max now DEFAULTS to the device: since the
-        _Plan refactor the ``jnp.max`` rides INSIDE the chunk's one fused
-        executable (zero extra dispatches — round 4's opt-in deferral paid
-        ~190 ms per separate `_max_jit` execution on the tunneled backend,
-        which is why it lost 20× then), and all deferred maxima sync once
-        at finalize via one stacked fetch (_finalize_many).
-        TPQ_DEFER_DICT_CHECK=0 forces the native O(values) host scan back
-        on (for corrupt-input diagnosis at the exact page).
+        The deferred device-side max stays OPT-IN (TPQ_DEFER_DICT_CHECK=1)
+        even though the _Plan refactor folds the ``jnp.max`` into the
+        chunk's one fused executable with all maxima synced once at
+        finalize: measured round 5 on the tunneled backend, a 100M-row scan
+        holding ~700 live tiny max buffers degraded warm reps 24 s → 514 s
+        (and round 4's separate-dispatch variant lost 20× before that).
+        The host walk's O(values) scan is the cheaper evil at every scale
+        measured, and it reports corruption at the exact page.
         """
         stream = p.raw[p.value_pos :]
         if len(stream) < 1:
@@ -1593,7 +1617,7 @@ class _ChunkAssembler:
         if width > 32:
             raise ParquetError(f"dictionary index width {width} invalid")
         covered = width < 31 and self.dict_len >= (1 << width)
-        defer = os.environ.get("TPQ_DEFER_DICT_CHECK", "1") != "0"
+        defer = os.environ.get("TPQ_DEFER_DICT_CHECK", "") == "1"
         meta = parse_hybrid_meta(stream, width, p.defined, pos=1,
                                  compute_max=not covered and not defer)
         if p.defined == 0:
@@ -2246,6 +2270,70 @@ class DeviceFileReader:
     def num_row_groups(self) -> int:
         return self._host.num_row_groups
 
+    @staticmethod
+    def _walk_headers_file(f, offset: int, size: int, num_values: int):
+        """Page headers of a chunk read via seeks — header bytes only, never
+        the payloads (the pruning planner needs page BOUNDARIES of every
+        selected column; loading whole chunks for that doubled peak host
+        memory under row_filter).  Returns the data-page headers in order."""
+        from .chunk_decode import _read_page_header
+        from .thrift import ThriftError
+
+        headers = []
+        pos = 0
+        seen = 0
+        seen_dict = False
+        while seen < num_values:
+            if pos >= size:
+                raise ParquetError(
+                    f"chunk exhausted at {seen}/{num_values} values")
+            win = 1024
+            while True:
+                f.seek(offset + pos)
+                head = f.read(min(win, size - pos))
+                try:
+                    header, hlen = _read_page_header(head, 0)
+                    break
+                except ThriftError as e:
+                    # could be a truncated window, not corruption: widen
+                    # until the whole remaining chunk has been tried
+                    if win >= size - pos:
+                        raise ParquetError(
+                            f"corrupt page header: {e}") from e
+                    win *= 8
+            csize = header.compressed_page_size
+            if csize is None or csize < 0:
+                raise ParquetError(f"invalid compressed page size {csize}")
+            usize = header.uncompressed_page_size
+            if usize is None or usize < 0:
+                raise ParquetError(f"invalid uncompressed page size {usize}")
+            if hlen + csize > size - pos:
+                raise ParquetError("page payload extends past chunk end")
+            # CONTRACT: the data-page ordinals this walk yields must match
+            # walk_pages' exactly — skip_pages indices computed here are
+            # applied against walk_pages' sequence in _collect_chunk, so
+            # the reject set below mirrors walk_pages (missing per-type
+            # headers raise; anything else would silently shift ordinals
+            # and prune the wrong pages)
+            if header.type == PageType.DATA_PAGE:
+                if header.data_page_header is None:
+                    raise ParquetError("data page v1 missing its header")
+                seen += header.data_page_header.num_values or 0
+                headers.append(header)
+            elif header.type == PageType.DATA_PAGE_V2:
+                if header.data_page_header_v2 is None:
+                    raise ParquetError("data page v2 missing its header")
+                seen += header.data_page_header_v2.num_values or 0
+                headers.append(header)
+            elif header.type == PageType.DICTIONARY_PAGE:
+                if seen_dict or headers:
+                    raise ParquetError("unexpected extra dictionary page")
+                if header.dictionary_page_header is None:
+                    raise ParquetError("dictionary page missing its header")
+                seen_dict = True
+            pos += hlen + csize
+        return headers
+
     def _plan_page_pruning(self, rg, leaves):
         """Page-level predicate pushdown (beyond the reference, which writes
         page Statistics but never reads them): within a surviving row group,
@@ -2283,8 +2371,11 @@ class DeviceFileReader:
         f = self._host._f
         filter_pages = {}
         boundaries = {}
-        # selected chunks' bytes, handed to the decode loop — the planner
-        # already paid the IO; re-reading would double it
+        # FILTER chunks' bytes, handed to the decode loop when also selected
+        # — the planner already paid their IO.  Non-filter selected columns
+        # are walked header-only via seeks (loading their whole chunks here
+        # roughly doubled peak host memory under row_filter); the decode
+        # loop reads them exactly once, as without a filter.
         bufs: dict = {}
         walk = set(fcols) | {".".join(p) for p in leaves}
         for name in walk:
@@ -2293,14 +2384,18 @@ class DeviceFileReader:
                 return None, 0, bufs  # selected column missing: decode raises
             leaf = all_leaves[name]
             md, offset = validate_chunk_meta(chunk, leaf)
-            f.seek(offset)
-            buf = f.read(md.total_compressed_size)
-            if tuple(name.split(".")) in leaves:
-                bufs[tuple(name.split("."))] = buf
+            if name in fcols:
+                f.seek(offset)
+                buf = f.read(md.total_compressed_size)
+                if tuple(name.split(".")) in leaves:
+                    bufs[tuple(name.split("."))] = buf
+                hdrs = [ps.header for ps in walk_pages(buf, md.num_values)]
+            else:
+                hdrs = self._walk_headers_file(
+                    f, offset, md.total_compressed_size, md.num_values)
             ends, stats = [], []
             total = 0
-            for ps in walk_pages(buf, md.num_values):
-                h = ps.header
+            for h in hdrs:
                 if h.type == PageType.DATA_PAGE and h.data_page_header:
                     total += h.data_page_header.num_values or 0
                     st = h.data_page_header.statistics
@@ -2691,6 +2786,13 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
     descriptors stay bounded for arbitrarily many shards — the deferred
     scalars are device arrays, not file state), and every reader is closed
     on exit even on error.
+
+    .. warning:: Consumers that abandon the scan early (``break``,
+       ``islice``) and let the generator be closed by GC lose the deferred
+       range-check exception (``GeneratorExit`` semantics swallow it); the
+       corruption is still reported via ``logging.error`` on the
+       ``tpu_parquet.device_reader`` logger.  Close the generator
+       explicitly (or iterate to exhaustion) to get the ``ParquetError``.
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -2718,9 +2820,17 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
             # idempotent re-check: covers consumers that abandon the scan
             # early (break/islice) — their consumed-but-unchecked files
             # still validate when the generator closes.  (A GC-time close
-            # swallows exceptions by Python semantics; consumers that break
-            # early and care should close the generator explicitly.)
-            _finalize_many(readers)
+            # swallows exceptions by Python semantics — see the docstring
+            # warning — so corrupt indices are ALSO logged before raising.)
+            try:
+                _finalize_many(readers)
+            except ParquetError as e:
+                import logging
+
+                logging.getLogger(__name__).error(
+                    "scan_files deferred validation failed "
+                    "(swallowed if this close is GC-driven): %s", e)
+                raise
         finally:
             for r in readers:
                 r.close()

@@ -180,14 +180,16 @@ def snappy_decompress(data, max_size: int = -1):
             f"snappy stream claims {n} bytes, page declared {max_size}"
         )
     # np.empty skips create_string_buffer's zero-init memset (decompress
-    # overwrites every byte on success; failures discard the buffer)
-    out = np.empty(n, dtype=np.uint8)
+    # overwrites every byte on success; failures discard the buffer).
+    # +16 slack bytes: tpq_snappy_decompress's short-op fast paths do blind
+    # 16-byte stores (see its contract); the logical output is out[:n].
+    out = np.empty(n + 16, dtype=np.uint8)
     rc = lib.tpq_snappy_decompress(
         dptr, len(data), out.ctypes.data_as(ctypes.c_char_p), n
     )
     if rc != 0:
         raise ValueError(f"malformed snappy data (error {rc})")
-    return out
+    return out[:n]
 
 
 def snappy_compress(data: bytes) -> bytes:

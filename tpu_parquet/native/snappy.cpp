@@ -143,6 +143,11 @@ long long tpq_snappy_uncompressed_length(const uint8_t* src, size_t n) {
 
 // Decompress src (raw snappy) into dst of exactly dst_len bytes.
 // Returns 0 on success, negative error codes on malformed input.
+// Contract: dst must have >= 16 writable SLACK bytes past dst_len (the
+// Python wrapper over-allocates) — the short-op fast paths below do blind
+// 16-byte stores and the slack keeps them in-bounds without per-op length
+// branches.  Bytes past dst_len are scratch; the logical output is
+// dst[0:dst_len].
 int tpq_snappy_decompress(const uint8_t* src, size_t n, uint8_t* dst,
                           size_t dst_len) {
   size_t pos = 0;
@@ -155,13 +160,25 @@ int tpq_snappy_decompress(const uint8_t* src, size_t n, uint8_t* dst,
     uint32_t kind = tag & 3;
     if (kind == 0) {  // literal
       size_t len = tag >> 2;
-      if (len >= 60) {
-        size_t extra = len - 59;
-        if (pos + extra > n) return -4;
-        len = 0;
-        for (size_t i = 0; i < extra; i++) len |= size_t(src[pos + i]) << (8 * i);
-        pos += extra;
+      if (len < 60) {
+        len += 1;
+        if (pos + len > n || out + len > dst_len) return -5;
+        if (len <= 16 && pos + 16 <= n) {
+          // blind 16-byte copy (slack covers the overshoot); the typical
+          // literal is short and a memcpy call dominated it
+          std::memcpy(dst + out, src + pos, 16);
+        } else {
+          std::memcpy(dst + out, src + pos, len);
+        }
+        pos += len;
+        out += len;
+        continue;
       }
+      size_t extra = len - 59;
+      if (pos + extra > n) return -4;
+      len = 0;
+      for (size_t i = 0; i < extra; i++) len |= size_t(src[pos + i]) << (8 * i);
+      pos += extra;
       len += 1;
       if (pos + len > n || out + len > dst_len) return -5;
       std::memcpy(dst + out, src + pos, len);
@@ -188,12 +205,14 @@ int tpq_snappy_decompress(const uint8_t* src, size_t n, uint8_t* dst,
       }
       if (offset == 0 || offset > out) return -7;
       if (out + len > dst_len) return -8;
-      if (offset >= len) {
-        std::memcpy(dst + out, dst + out - offset, len);
+      uint8_t* d = dst + out;
+      const uint8_t* s = d - offset;
+      if (offset >= 8) {
+        // 8-byte stride blind copy into the slack (format caps copy len at
+        // 64, so this is at most 8 wide stores, usually 1-2)
+        for (size_t i = 0; i < len; i += 8) std::memcpy(d + i, s + i, 8);
       } else {
         // overlapping copy: byte-wise (RLE-style repetition)
-        uint8_t* d = dst + out;
-        const uint8_t* s = d - offset;
         for (size_t i = 0; i < len; i++) d[i] = s[i];
       }
       out += len;
