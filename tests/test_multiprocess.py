@@ -1,0 +1,128 @@
+"""Real multi-process seam for the work-list sharding (SURVEY.md §5.8).
+
+Everything else in tests/ exercises the sharded decode on a single-process
+virtual mesh; this file spawns TWO OS processes joined through
+``jax.distributed.initialize`` (4 virtual CPU devices each → one 8-device
+global mesh) and drives ``process_local_column`` end-to-end on a real file:
+each process decodes only ITS row span, the runtime assembles the global
+row-sharded array, and a replicated-out jit checksum must equal the
+single-process decode of the same column.  This is the actual cross-process
+contract (`make_array_from_process_local_data`, global avals, collective
+assembly) that a single-process mesh cannot fake.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["TPQ_REPO_ROOT"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=sys.argv[1],
+    num_processes=2,
+    process_id=int(sys.argv[2]),
+)
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_parquet.parallel import process_local_column, shard_row_ranges
+from tpu_parquet.reader import FileReader
+
+path = sys.argv[3]
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())  # 4 local x 2 processes
+
+mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+with FileReader(path) as r:
+    arr, total = process_local_column(r, "v", mesh)
+    # every process recomputes the identical plan from the footer alone
+    spans = shard_row_ranges(total, 2)
+    lo, hi = spans[jax.process_index()]
+
+# replicated-out checksum over the GLOBAL array: runs as one pjit across
+# both processes, so it exercises the collective assembly for real
+@jax.jit
+def checks(x):
+    n = x.shape[0]
+    w = jnp.arange(n, dtype=jnp.int64) % 97
+    return jnp.sum(x * w), jnp.sum(x), jnp.max(x)
+
+with jax.enable_x64():
+    got = [int(v) for v in jax.device_get(checks(arr))]
+
+# single-process oracle: host decode of the whole column (+ zero padding to
+# the uniform span size, matching process_local_column's tail padding)
+with FileReader(path) as r:
+    host = np.concatenate(
+        [np.asarray(rg["v"].values) for rg in r.iter_row_groups()])
+per = spans[0][1] - spans[0][0]
+full = np.zeros(per * 2, dtype=np.int64)
+full[: len(host)] = host
+w = np.arange(len(full), dtype=np.int64) % 97
+want = [int((full * w).sum()), int(full.sum()), int(full.max())]
+assert got == want, (got, want)
+
+# the process-local shards hold exactly this process's span
+local_rows = np.concatenate(
+    [np.asarray(s.data).reshape(-1) for s in arr.addressable_shards])
+want_local = full[jax.process_index() * per : (jax.process_index() + 1) * per]
+assert np.array_equal(np.sort(local_rows), np.sort(want_local))
+print(f"proc {jax.process_index()} OK", flush=True)
+"""
+
+
+@pytest.mark.skipif(os.environ.get("TPQ_SKIP_MULTIPROC") == "1",
+                    reason="multi-process seam disabled by env")
+def test_two_process_global_column(tmp_path):
+    from tpu_parquet.format import FieldRepetitionType as FRT, Type
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.writer import FileWriter
+
+    p = str(tmp_path / "mp.parquet")
+    n = 200_000
+    rng = np.random.default_rng(5)
+    vals = rng.integers(0, 1 << 40, n)
+    schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
+    with FileWriter(p, schema, codec=1, row_group_size=1 << 19) as w:
+        w.write_columns({"v": vals})
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        coord = f"localhost:{s.getsockname()[1]}"
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(_WORKER)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPQ_REPO_ROOT"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    env.pop("JAX_NUM_PROCESSES", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script, coord, str(i), p],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for pr in procs:
+        try:
+            out, _ = pr.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (pr, out) in enumerate(zip(procs, outs)):
+        assert pr.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
+        assert f"proc {i} OK" in out, out[-4000:]

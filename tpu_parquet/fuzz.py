@@ -281,6 +281,115 @@ def fuzz_page_header(data: bytes) -> None:
         raise AssertionError(f"field mismatch: {c!r} != {py!r}")
 
 
+def fuzz_snappy(data: bytes) -> None:
+    """Native vs pure-Python raw-snappy differential: identical accept/reject
+    set and identical output bytes (the C fast paths — blind 16-byte literal
+    stores, 8-byte stride copies — must be invisible)."""
+    from . import native
+    from .compress import CompressionError, _py_snappy_decompress
+
+    if not native.available():
+        return
+    try:
+        want = _py_snappy_decompress(data, max_size=1 << 22)
+        py_ok = True
+    except CompressionError:
+        py_ok = False
+    try:
+        got = native.snappy_decompress(data, max_size=1 << 22)
+        c_ok = True
+    except (ValueError, RuntimeError):
+        c_ok = False
+    if py_ok != c_ok:
+        raise AssertionError(f"snappy acceptance mismatch: py={py_ok} c={c_ok}")
+    if py_ok and bytes(got) != want:
+        raise AssertionError("snappy output mismatch")
+
+
+def fuzz_snappy_plan(data: bytes) -> None:
+    """Device-snappy PLANNER differential (the round-4 native surface the
+    compressed-page shipping path trusts): ``tpq_snappy_plan``'s op tables,
+    resolved sequentially on host with the device resolver's copy semantics
+    (out[dst+j] = out[dst - off + (j % off)]), must reproduce
+    ``tpq_snappy_decompress`` byte for byte — and the two must agree on the
+    accept/reject set."""
+    from . import native
+
+    if not native.available():
+        return
+    try:
+        out = native.snappy_decompress(data, max_size=1 << 20)
+        dec_ok = True
+    except (ValueError, RuntimeError):
+        dec_ok = False
+    plan = native.snappy_plan(data, len(out) if dec_ok else (1 << 20))
+    if plan is None:
+        return
+    plan_ok = not isinstance(plan, int)
+    if plan_ok != dec_ok:
+        raise AssertionError(
+            f"plan/decompress acceptance mismatch: plan={plan} dec={dec_ok}")
+    if not dec_ok:
+        return
+    dst_end, op_src, is_lit, depth = plan
+    res = np.zeros(len(out), dtype=np.uint8)
+    src = np.frombuffer(data, dtype=np.uint8)
+    pos = 0
+    for e, s, lit in zip(dst_end, op_src, is_lit):
+        e = int(e)
+        n = e - pos
+        if lit:
+            res[pos:e] = src[int(s) : int(s) + n]
+        else:
+            off = int(s)
+            # a plan op with off=0 or off>pos is itself a planner bug (the
+            # decompressor rejects those streams); assert rather than let
+            # numpy negative-index wraparound mask it against zero tails
+            if not 1 <= off <= pos:
+                raise AssertionError(f"plan copy offset {off} at pos {pos}")
+            # device copy semantics: j-th byte reads dst_start - off + j%off
+            idx = pos - off + (np.arange(n) % off)
+            res[pos:e] = res[idx]
+        pos = e
+    if depth < 0 or pos != len(out):
+        raise AssertionError(f"plan shape bad: end={pos} depth={depth}")
+    if res.tobytes() != bytes(out):
+        raise AssertionError("plan resolution diverges from decompress")
+
+
+def fuzz_narrow(data: bytes) -> None:
+    """Narrow-int transcode differential (the round-4 transfer-cut path):
+    minmax + k-byte truncate + widen-and-rebias must reconstruct the source
+    values exactly, for both widths, at every alignment the planner uses."""
+    from . import native
+    from .device_reader import _narrow_max_k, _span_bytes
+
+    if not native.available() or len(data) < 8:
+        return
+    for width, dt in ((8, np.int64), (4, np.int32)):
+        n = len(data) // width
+        if n == 0:
+            continue
+        vals = np.frombuffer(data[: n * width], dtype=dt)
+        mm = native.int_minmax(data, 0, n, width)
+        mn, mx = int(vals.min()), int(vals.max())
+        if mm != (mn, mx):
+            raise AssertionError(f"minmax mismatch w{width}: {mm} != {(mn, mx)}")
+        k = _span_bytes(mn, mx)
+        if k > _narrow_max_k(width):
+            continue  # planner would decline; nothing to transcode
+        out = np.empty(n * k, dtype=np.uint8)
+        assert native.int_truncate(data, 0, n, width, mn, k, out)
+        # widen: little-endian k-byte rows -> u64 -> + bias -> dtype wrap
+        rows = out.reshape(n, k).astype(np.uint64)
+        acc = np.zeros(n, dtype=np.uint64)
+        for b in range(k):
+            acc |= rows[:, b] << np.uint64(8 * b)
+        got = (acc + np.uint64(mn % (1 << 64))).astype(np.uint64).astype(dt)
+        if not np.array_equal(got, vals):
+            raise AssertionError(f"narrow roundtrip diverges (w{width}, k={k})")
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -290,6 +399,9 @@ TARGETS = {
     "schema_dsl": fuzz_schema_dsl,
     "device_reader": fuzz_device_reader,
     "page_header": fuzz_page_header,
+    "snappy": fuzz_snappy,
+    "snappy_plan": fuzz_snappy_plan,
+    "narrow": fuzz_narrow,
 }
 
 
@@ -401,6 +513,27 @@ def _seed_inputs(target: str) -> list[bytes]:
     if target == "schema_dsl":
         return [b"message m { required int64 a; optional group l (LIST) "
                 b"{ repeated group list { optional binary element (STRING); } } }"]
+    if target in ("snappy", "snappy_plan"):
+        from . import native
+        from .compress import _py_snappy_compress
+
+        comp = (native.snappy_compress if native.available()
+                else _py_snappy_compress)
+        seeds = [
+            comp(b"the quick brown fox " * 40),     # literal+copy mix
+            comp(bytes(rng.integers(0, 4, 600).astype(np.uint8))),
+            comp(b"\x00" * 3000),                   # deep RLE-style chains
+            comp(b"ab" * 2000),                     # offset-2 overlap copies
+            comp(b""),
+        ]
+        return seeds
+    if target == "narrow":
+        return [
+            rng.integers(500, 1500, 64).astype(np.int64).tobytes(),
+            (rng.integers(-40, 40, 64) * 1000).astype(np.int64).tobytes(),
+            rng.integers(0, 200, 64).astype(np.int32).tobytes(),
+            np.full(32, -(1 << 62), dtype=np.int64).tobytes(),
+        ]
     raise KeyError(target)
 
 
