@@ -168,7 +168,7 @@ def _py_snappy_compress(data: bytes) -> bytes:
 class SnappyCompressor(BlockCompressor):
     def compress_block(self, block: bytes) -> bytes:
         if _native.available():
-            return _native.snappy_compress(bytes(block))
+            return _native.snappy_compress(block)  # input: any buffer
         return _py_snappy_compress(bytes(block))
 
     def decompress_block(self, block: bytes, uncompressed_size: int):

@@ -519,14 +519,15 @@ def _seed_inputs(target: str) -> list[bytes]:
 
         comp = (native.snappy_compress if native.available()
                 else _py_snappy_compress)
-        seeds = [
-            comp(b"the quick brown fox " * 40),     # literal+copy mix
-            comp(bytes(rng.integers(0, 4, 600).astype(np.uint8))),
-            comp(b"\x00" * 3000),                   # deep RLE-style chains
-            comp(b"ab" * 2000),                     # offset-2 overlap copies
-            comp(b""),
-        ]
-        return seeds
+        # bytes() each seed: native compress returns a uint8 array, and
+        # mutate()'s truthiness/slicing assumes bytes semantics
+        return [bytes(comp(x)) for x in (
+            b"the quick brown fox " * 40,            # literal+copy mix
+            bytes(rng.integers(0, 4, 600).astype(np.uint8)),
+            b"\x00" * 3000,                          # deep RLE-style chains
+            b"ab" * 2000,                            # offset-2 overlap copies
+            b"",
+        )]
     if target == "narrow":
         return [
             rng.integers(500, 1500, 64).astype(np.int64).tobytes(),

@@ -126,9 +126,19 @@ def decode_byte_array(buf: bytes, count: int) -> ByteArrayData:
 
 def encode(values, ptype: int, type_length: int = 0) -> bytes:
     """PLAIN-encode values (inverse of :func:`decode`)."""
+    out = encode_view(values, ptype, type_length)
+    return out if isinstance(out, bytes) else out.tobytes()
+
+
+def encode_view(values, ptype: int, type_length: int = 0):
+    """PLAIN-encode; fixed-width types return a zero-copy uint8 VIEW of the
+    (contiguous) value array instead of bytes — the writer compresses the
+    buffer directly, and the per-page tobytes copy was ~25% of a plain
+    int64 chunk write."""
     ptype = Type(ptype)
     if ptype in _FIXED:
-        return np.ascontiguousarray(values, dtype=_FIXED[ptype]).tobytes()
+        arr = np.ascontiguousarray(values, dtype=_FIXED[ptype])
+        return arr.view(np.uint8).reshape(-1)
     if ptype == Type.INT96:
         arr = np.ascontiguousarray(values, dtype="<u4")
         if arr.ndim != 2 or arr.shape[1] != 3:
