@@ -11,6 +11,7 @@ reference's fallback threshold (> 32767 distinct values → plain, chunk_writer.
 
 from __future__ import annotations
 
+import struct
 import zlib
 from dataclasses import dataclass, field
 from typing import Optional
@@ -35,7 +36,7 @@ from .format import (
 )
 from .kernels import bitpack, bytearray as ba_codec, delta, plain, rle
 from .schema.core import SchemaNode
-from .stats import compute_statistics
+from .stats import _lex_minmax, compute_statistics
 from .thrift import serialize
 
 MAX_DICT_SIZE = 32767  # MaxInt16, the reference's dictionary fallback threshold
@@ -342,8 +343,6 @@ class ChunkEncoder:
                 and ptype == Type.BYTE_ARRAY
                 and isinstance(dict_pair[0], ByteArrayData)
                 and len(dict_pair[0])):
-            from .stats import _lex_minmax
-
             self._dict_stat_bounds = _lex_minmax(dict_pair[0])
 
         encodings: set[int] = set()
@@ -572,8 +571,6 @@ class ChunkEncoder:
 def _fold_page_stats(plist, ptype: Type, null_count: int):
     """Chunk Statistics folded from per-page Statistics (numeric fixed
     types; None when any page lacks bounds — caller recomputes)."""
-    import struct
-
     fmts = {Type.INT32: "<i", Type.INT64: "<q",
             Type.FLOAT: "<f", Type.DOUBLE: "<d"}
     fmt = fmts.get(ptype)
