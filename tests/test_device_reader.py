@@ -984,3 +984,19 @@ def test_snappy_plan_four_byte_offset_copy():
         start = e
     assert bytes(out) == bytes(want)
     assert depth >= 1
+
+
+def test_fused_row_group_mode_matches_default():
+    """TPQ_FUSE_RG=1 (the opt-in whole-row-group fused jit) must decode
+    byte-identically to the default per-plan dispatch — the opt-in path
+    shares the _Plan contract and would otherwise rot untested."""
+    import tpu_parquet.device_reader as dr
+
+    path = _write(_mixed_schema(), _mixed_rows(3000),
+                  page_size=4096, row_group_size=128 << 10)
+    old = dr._FUSE_RG
+    dr._FUSE_RG = True
+    try:
+        _compare_file(path)
+    finally:
+        dr._FUSE_RG = old
