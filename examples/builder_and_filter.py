@@ -1,0 +1,71 @@
+"""Builder-style rows + predicate-pushdown scan (no dataclass needed).
+
+Two API surfaces with no direct reference example but full reference
+parity: the floor builder (floor/interfaces/marshaller.go MarshalObject
+shapes — schema-guided nested row construction without defining a class)
+and statistics-based pushdown (`row_filter=` prunes row groups from chunk
+stats and whole-page runs from page stats before anything decompresses).
+
+    python examples/builder_and_filter.py [dir]
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_parquet.floor.builder import RowBuilder, RowView
+from tpu_parquet.predicate import col
+from tpu_parquet.reader import FileReader
+from tpu_parquet.schema.dsl import parse_schema_definition
+from tpu_parquet.writer import FileWriter
+
+SCHEMA = """message order {
+  required int64 order_id;
+  required group customer {
+    required binary name (STRING);
+  }
+  optional group items (LIST) {
+    repeated group list {
+      required binary element (STRING);
+    }
+  }
+}"""
+
+
+def main(outdir: str) -> None:
+    schema = parse_schema_definition(SCHEMA)
+    path = os.path.join(outdir, "orders.parquet")
+
+    # -- build rows programmatically, guided by the schema ------------------
+    with FileWriter(path, schema, codec=1, row_group_size=1 << 14) as w:
+        for i in range(10_000):
+            b = RowBuilder(schema.root)
+            b.field("order_id").set(i)
+            b.field("customer").group().field("name").set(f"cust-{i % 97}".encode())
+            items = b.field("items").list()
+            for j in range(i % 3):
+                items.add().set(f"sku-{j}".encode())
+            w.write_row(b.data)
+
+    # -- filtered scan: row groups AND whole pages the predicate provably
+    #    cannot match are skipped before decompression ----------------------
+    pred = (col("order_id") >= 9_000) & (col("order_id") < 9_010)
+    hits = []
+    with FileReader(path, row_filter=pred) as r:
+        for row in r.iter_rows():
+            v = RowView(row, schema.root)
+            if 9_000 <= v.field("order_id").int64() < 9_010:  # exact re-filter
+                hits.append((
+                    v.field("order_id").int64(),
+                    v.field("customer").group().field("name").bytes(),
+                    [e.bytes() for e in v.field("items").list()],
+                ))
+    print(f"matched {len(hits)} rows; first: {hits[0]}")
+    assert [h[0] for h in hits] == list(range(9_000, 9_010))
+
+
+if __name__ == "__main__":
+    with tempfile.TemporaryDirectory() as d:
+        main(sys.argv[1] if len(sys.argv) > 1 else d)
