@@ -355,3 +355,53 @@ def test_nan_handling(tmp_path):
     # stats must ignore NaN
     st = pq.read_metadata(p).row_group(0).column(0).statistics
     assert st.min == -np.inf and st.max == 2.0
+
+
+def test_writer_output_header_field_sweep(tmp_path):
+    """Self-validation beyond what pyarrow tolerates: walk EVERY page header
+    of our writer's output and assert the format invariants a stricter
+    reader (parquet-mr) would reject on — sizes, value accounting, stats
+    bound ordering, dictionary placement."""
+    import struct
+
+    from tpu_parquet.chunk_decode import validate_chunk_meta, walk_pages
+    from tpu_parquet.format import PageType
+
+    p = str(tmp_path / "sweep.parquet")
+    rows = sample_rows(20_000)
+    with FileWriter(p, flat_schema(), codec=CompressionCodec.SNAPPY,
+                    row_group_size=1 << 16, write_crc=True) as w:
+        for row in rows:
+            w.write_row(row)
+    with FileReader(p) as r:
+        leaves = {tuple(l.path): l for l in r.schema.leaves}
+        for rg in r.metadata.row_groups:
+            for chunk in rg.columns:
+                md, offset = validate_chunk_meta(
+                    chunk, leaves[tuple(chunk.meta_data.path_in_schema)])
+                r._f.seek(offset)
+                buf = r._f.read(md.total_compressed_size)
+                total = 0
+                first = True
+                for ps in walk_pages(buf, md.num_values):
+                    h = ps.header
+                    assert h.compressed_page_size >= 0
+                    assert h.uncompressed_page_size >= 0
+                    assert h.crc is not None  # write_crc=True: every page
+                    if h.type == PageType.DICTIONARY_PAGE:
+                        assert first, "dictionary page must be first"
+                        assert h.dictionary_page_header.num_values >= 0
+                    elif h.type == PageType.DATA_PAGE:
+                        dh = h.data_page_header
+                        total += dh.num_values
+                        st = dh.statistics
+                        if st is not None and st.min_value is not None:
+                            assert st.min_value <= st.max_value or (
+                                # numeric stats compare by decoded value
+                                len(st.min_value) in (4, 8))
+                            if len(st.min_value) == 8:
+                                lo = struct.unpack("<q", st.min_value)[0]
+                                hi = struct.unpack("<q", st.max_value)[0]
+                                assert lo <= hi
+                    first = False
+                assert total == md.num_values, "page value accounting"
