@@ -53,6 +53,11 @@ Sampling protocol (disclosed here and in README) — SYMMETRIC since round 5:
   recorded in the JSON, so a depressed headline is attributable from the
   artifact itself.
 
+A ``pipeline`` section (BENCH_PIPELINE=0 to skip) benches the overlapped
+chunk pipeline on the headline file: host decode at prefetch={0,4}, the
+speedup, and the per-stage counters (overlap efficiency = busy/wall) from
+``FileReader.pipeline_stats()``.
+
 Env knobs: BENCH_SCALE (default 1.0), BENCH_DEVICE_REPS (default 4),
 BENCH_BASELINE_REPS (default: one below device reps, capped at 3),
 BENCH_CONFIGS (comma list, default "4,2,3,1,5" — headline banked first),
@@ -532,6 +537,41 @@ def bench_writes(rows=2_000_000, reps=2):
     return out
 
 
+def bench_pipeline(path, rows, reps=3):
+    """Overlapped-chunk-pipeline bench (ISSUE 1 acceptance gate): host
+    decode of the lineitem16 file at prefetch={0,4} — same file, same
+    decoder, only the pipeline depth differs — plus the per-stage counters
+    that make the speedup attributable (overlap efficiency = sum of stage
+    seconds / wall seconds; 1.0 is perfectly serial)."""
+    from tpu_parquet.reader import FileReader
+
+    out = {"rows": rows}
+    for k in (0, 4):
+        best = float("inf")
+        best_stats = None
+        for i in range(reps):
+            t0 = time.perf_counter()
+            with FileReader(path, prefetch=k) as r:
+                r.read_all()
+                st = r.pipeline_stats()
+            dt = time.perf_counter() - t0
+            log(f"  pipeline prefetch={k} rep {i}: {dt:.3f}s "
+                f"({rows/dt/1e6:.2f} M rows/s)")
+            if dt < best:
+                best, best_stats = dt, st.as_dict()
+        out[f"prefetch{k}_s"] = round(best, 3)
+        out[f"prefetch{k}_rows_per_sec"] = round(rows / best, 1)
+        if k:
+            for key in ("io_seconds", "decompress_seconds", "stall_seconds",
+                        "busy_seconds", "overlap_efficiency",
+                        "peak_in_flight_bytes"):
+                out[key] = best_stats[key]
+    out["pipeline_speedup"] = round(out["prefetch0_s"] / out["prefetch4_s"], 3)
+    log(f"pipeline: {out['pipeline_speedup']:.2f}x at prefetch=4 "
+        f"(overlap efficiency {out['overlap_efficiency']:.2f})")
+    return out
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache (one implementation: the library's —
     device_reader._enable_compile_cache defers to an app-configured dir /
@@ -763,6 +803,23 @@ def main():
             f"({r['device_mb_per_sec']:.0f} MB/s)"
             + (f", {vs:.1f}x host" if vs is not None else "")
             + (f", {pipe:.1f}x host+upload pipeline" if pipe is not None else ""))
+
+    # Overlapped chunk pipeline: host decode prefetch={0,4} on the headline
+    # file (ISSUE 1 acceptance: >= 1.3x sequential).  Skip: BENCH_PIPELINE=0.
+    if os.environ.get("BENCH_PIPELINE", "1") != "0" and not over_budget():
+        try:
+            li = dev_times.get("lineitem16")
+            if li is not None:
+                _w, ppath, prows, _k, _mb = li
+            else:
+                name, gen, base_rows = CONFIGS["4"]
+                prows = int(base_rows * SCALE)
+                ppath = f"/tmp/tpq_bench_{name}_{prows}.parquet"
+                if not os.path.exists(ppath):
+                    gen(ppath, prows)
+            results["pipeline"] = bench_pipeline(ppath, prows)
+        except Exception as e:  # noqa: BLE001
+            log(f"pipeline bench FAILED: {e!r}")
 
     # Writer throughput (host encode; ~10s).  Skip with BENCH_WRITES=0.
     if os.environ.get("BENCH_WRITES", "1") != "0" and not over_budget():

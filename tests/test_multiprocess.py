@@ -55,7 +55,9 @@ def checks(x):
     w = jnp.arange(n, dtype=jnp.int64) % 97
     return jnp.sum(x * w), jnp.sum(x), jnp.max(x)
 
-with jax.enable_x64():
+from tpu_parquet.jax_kernels import enable_x64
+
+with enable_x64():
     got = [int(v) for v in jax.device_get(checks(arr))]
 
 # single-process oracle: host decode of the whole column (+ zero padding to

@@ -122,7 +122,13 @@ def test_graft_entry_single_chip():
     g = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(g)
     fn, args = g.entry()
-    out = jax.jit(fn)(*args)
+    from tpu_parquet.jax_kernels import enable_x64
+
+    # trace under x64: the example args are int64 metadata, and a no-x64
+    # jit boundary would downcast them before the kernels' scoped_x64
+    # contexts apply (mixed i32/i64 jaxpr on 0.4.x jax)
+    with enable_x64():
+        out = jax.jit(fn)(*args)
     jax.block_until_ready(out)
     assert out[0].shape == (256,)
     assert out[1].shape == (256,)

@@ -1,0 +1,377 @@
+"""Overlapped chunk pipeline (tpu_parquet/pipeline.py + prefetch= readers).
+
+ISSUE 1 coverage: bit-identical output across prefetch={0,1,4} with and
+without CRC validation, a mid-file corrupt page raising cleanly without
+deadlocking or leaking pool threads, and the max_memory budget bounding
+in-flight bytes (backpressure, not OOM) — plus unit tests of prefetch_map
+ordering/cleanup and InFlightBudget semantics.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpu_parquet.alloc import InFlightBudget
+from tpu_parquet.column import ByteArrayData
+from tpu_parquet.footer import ParquetError
+from tpu_parquet.pipeline import PipelineStats, prefetch_map
+from tpu_parquet.reader import FileReader
+
+
+def _leaked_pool_threads():
+    return [t for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith("tpq-prefetch")]
+
+
+def _make_file(path, rows=40_000, row_group_size=5_000, compression="snappy"):
+    rng = np.random.default_rng(11)
+    vals = [None if rng.random() < 0.2 else int(v)
+            for v in rng.integers(0, 1 << 40, rows)]
+    strs = [None if rng.random() < 0.2 else f"name_{i % 257:04d}"
+            for i in range(rows)]
+    table = pa.table({
+        "v": pa.array(vals, pa.int64()),
+        "d": pa.array(rng.uniform(0, 1e6, rows), pa.float64()),
+        "s": pa.array(strs, pa.string()),
+        "k": pa.array(rng.integers(0, 50, rows), pa.int32()),
+    })
+    pq.write_table(table, path, compression=compression,
+                   row_group_size=row_group_size)
+    return path
+
+
+@pytest.fixture(scope="module")
+def pfile(tmp_path_factory):
+    return str(_make_file(tmp_path_factory.mktemp("pipe") / "p.parquet"))
+
+
+def _assert_same_columns(a, b):
+    assert set(a) == set(b)
+    for name in a:
+        ca, cb = a[name], b[name]
+        assert ca.num_leaf_slots == cb.num_leaf_slots, name
+        assert ca.max_def == cb.max_def and ca.max_rep == cb.max_rep, name
+        for attr in ("def_levels", "rep_levels"):
+            xa, xb = getattr(ca, attr), getattr(cb, attr)
+            assert (xa is None) == (xb is None), name
+            if xa is not None:
+                np.testing.assert_array_equal(xa, xb)
+        if isinstance(ca.values, ByteArrayData):
+            np.testing.assert_array_equal(ca.values.offsets, cb.values.offsets)
+            np.testing.assert_array_equal(ca.values.heap, cb.values.heap)
+        else:
+            np.testing.assert_array_equal(ca.values, cb.values)
+
+
+# ---------------------------------------------------------------------------
+# correctness: bit-identical across prefetch depths
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("validate_crc", [False, True])
+def test_bit_identical_across_prefetch(pfile, validate_crc):
+    outs = {}
+    for k in (0, 1, 4):
+        with FileReader(pfile, validate_crc=validate_crc, prefetch=k) as r:
+            groups = list(r.iter_row_groups())
+            outs[k] = r.read_all()
+            stats = r.pipeline_stats()
+        assert len(groups) == 8  # 40k rows / 5k per group
+        if k:
+            assert stats.chunks == 8 * 4
+            assert stats.row_groups == 8
+            assert stats.stage_seconds("decompress") > 0
+    _assert_same_columns(outs[0], outs[1])
+    _assert_same_columns(outs[0], outs[4])
+    assert not _leaked_pool_threads()
+
+
+def test_bit_identical_bytes_source(pfile):
+    """The locked seek+read SharedReader path (no usable fd)."""
+    with open(pfile, "rb") as f:
+        raw = f.read()
+    seq = FileReader(raw).read_all()
+    pipe = FileReader(raw, prefetch=4).read_all()
+    _assert_same_columns(seq, pipe)
+
+
+def test_read_row_group_and_projection_parity(pfile):
+    with FileReader(pfile, columns=["v", "s"]) as r0, \
+            FileReader(pfile, columns=["v", "s"], prefetch=3) as r4:
+        for i in (0, 3, 7):
+            _assert_same_columns(r0.read_row_group(i), r4.read_row_group(i))
+        # per-call override: pipelined reader forced sequential and back
+        _assert_same_columns(r4.read_row_group(1, prefetch=0),
+                             r0.read_row_group(1, prefetch=4))
+
+
+def test_gzip_codec_parity(tmp_path):
+    p = str(_make_file(tmp_path / "g.parquet", rows=8_000,
+                       row_group_size=2_000, compression="gzip"))
+    _assert_same_columns(FileReader(p).read_all(),
+                         FileReader(p, prefetch=4).read_all())
+
+
+# ---------------------------------------------------------------------------
+# corruption: ordered raise, no deadlock, no leaked threads
+# ---------------------------------------------------------------------------
+
+def _corrupt_mid_file(pfile, tmp_path):
+    with FileReader(pfile) as r:
+        md = r.metadata.row_groups[4].columns[0].meta_data
+        off = md.data_page_offset
+        if (md.dictionary_page_offset is not None
+                and md.dictionary_page_offset >= 0):
+            off = min(off, md.dictionary_page_offset)
+    with open(pfile, "rb") as f:
+        raw = bytearray(f.read())
+    raw[off:off + 64] = b"\xff" * 64
+    bad = tmp_path / "corrupt.parquet"
+    bad.write_bytes(bytes(raw))
+    return str(bad)
+
+
+@pytest.mark.parametrize("validate_crc", [False, True])
+def test_corrupt_mid_file_page_raises_cleanly(pfile, tmp_path, validate_crc):
+    bad = _corrupt_mid_file(pfile, tmp_path)
+    t0 = time.perf_counter()
+    with FileReader(bad, validate_crc=validate_crc, prefetch=4) as r:
+        good = 0
+        with pytest.raises(ParquetError):
+            for _ in r.iter_row_groups():
+                good += 1
+        # groups before the corrupt one decoded fine and in order
+        assert good == 4
+    assert time.perf_counter() - t0 < 60  # no deadlock
+    assert not _leaked_pool_threads()
+
+
+def test_early_abandon_shuts_pool_down(pfile):
+    with FileReader(pfile, prefetch=4) as r:
+        it = r.iter_row_groups()
+        next(it)
+        it.close()  # consumer walks away mid-pipeline
+    assert not _leaked_pool_threads()
+
+
+# ---------------------------------------------------------------------------
+# memory budget: bounded in-flight bytes, backpressure instead of raise
+# ---------------------------------------------------------------------------
+
+def test_max_memory_bounds_in_flight_bytes(pfile):
+    with FileReader(pfile) as r:
+        costs = []
+        for rg in r.metadata.row_groups:
+            for cc in rg.columns:
+                md = cc.meta_data
+                comp = md.total_compressed_size
+                costs.append(comp + max(md.total_uncompressed_size or 0, comp))
+        baseline = r.read_all()
+    budget = 2 * max(costs) + 1024  # room for ~2 chunks, far below the file
+    assert budget < sum(costs)
+    with FileReader(pfile, max_memory=budget, prefetch=4) as r:
+        out = r.read_all()
+        stats = r.pipeline_stats()
+    _assert_same_columns(baseline, out)
+    assert 0 < stats.peak_in_flight_bytes <= budget
+    assert stats.as_dict()["budget_bytes"] == budget
+
+
+# ---------------------------------------------------------------------------
+# prefetch_map / InFlightBudget units
+# ---------------------------------------------------------------------------
+
+def test_prefetch_map_orders_results():
+    def work(i):
+        time.sleep(0.02 if i % 3 == 0 else 0.001)  # scramble completion order
+        return i * i
+
+    assert list(prefetch_map(range(20), work, 4)) == [i * i for i in range(20)]
+    assert not _leaked_pool_threads()
+
+
+def test_prefetch_map_error_position_and_cleanup():
+    seen = []
+
+    def work(i):
+        if i == 5:
+            raise ValueError("boom")
+        seen.append(i)
+        return i
+
+    out = []
+    with pytest.raises(ValueError, match="boom"):
+        for v in prefetch_map(range(10), work, 3):
+            out.append(v)
+    assert out == [0, 1, 2, 3, 4]  # everything before the failing item
+    assert not _leaked_pool_threads()
+
+
+def test_prefetch_map_consumer_break_cleans_up():
+    def work(i):
+        time.sleep(0.005)
+        return i
+
+    for v in prefetch_map(range(100), work, 4):
+        if v == 3:
+            break
+    assert not _leaked_pool_threads()
+
+
+def test_prefetch_map_budget_backpressure():
+    budget = InFlightBudget(100)
+    stats = PipelineStats(prefetch=2, budget_bytes=100)
+    in_flight = []
+    lock = threading.Lock()
+    peak = [0]
+
+    def work(i):
+        with lock:
+            in_flight.append(i)
+            peak[0] = max(peak[0], len(in_flight))
+        time.sleep(0.005)
+        with lock:
+            in_flight.remove(i)
+        return i
+
+    out = list(prefetch_map(range(12), work, 4, budget=budget,
+                            cost=lambda i: 40, stats=stats))
+    assert out == list(range(12))
+    assert budget.held == 0
+    assert budget.peak <= 100  # never more than 2 x 40 in flight
+    assert peak[0] <= 2
+
+
+def test_in_flight_budget_oversize_admitted_alone():
+    b = InFlightBudget(100)
+    b.acquire(1000)  # capped at the budget, admitted with nothing in flight
+    assert b.held == 100
+    assert not b.try_acquire(1)  # nothing else fits alongside
+    b.release(1000)
+    assert b.held == 0
+    assert b.try_acquire(60) and not b.try_acquire(60)
+    b.release(60)
+
+
+def test_in_flight_budget_disabled():
+    b = InFlightBudget(0)
+    b.acquire(1 << 40)
+    assert b.try_acquire(1 << 40)
+    b.release(1 << 40)
+    assert b.held == 0 and b.peak == 0
+
+
+# ---------------------------------------------------------------------------
+# device reader + scan_files prefetch parity
+# ---------------------------------------------------------------------------
+
+def _host_view(col):
+    if hasattr(col, "to_host") and callable(getattr(col, "to_host")):
+        try:
+            col = col.to_host()
+        except Exception:  # plain DeviceColumnData has no to_host
+            pass
+    if isinstance(col, ByteArrayData):
+        return np.asarray(col.offsets), np.asarray(col.heap)
+    if isinstance(col, np.ndarray):
+        return (col,)
+    if getattr(col, "values", None) is not None:
+        v = np.asarray(col.values)
+        n = getattr(col, "n_values", None)
+        return (v[:n] if n is not None else v,)
+    return np.asarray(col.offsets), np.asarray(col.heap)
+
+
+def test_device_reader_prefetch_parity(pfile):
+    from tpu_parquet.device_reader import DeviceFileReader
+
+    def read(k):
+        with DeviceFileReader(pfile, prefetch=k) as r:
+            groups = [{n: _host_view(c) for n, c in cols.items()}
+                      for cols in r.iter_row_groups()]
+            stats = r.pipeline_stats()
+        return groups, stats
+
+    g0, _ = read(0)
+    g4, s4 = read(4)
+    assert len(g0) == len(g4) == 8
+    for a, b in zip(g0, g4):
+        assert set(a) == set(b)
+        for name in a:
+            for xa, xb in zip(a[name], b[name]):
+                np.testing.assert_array_equal(xa, xb)
+    assert s4.chunks == 8 * 4
+    assert s4.stage_seconds("decompress") > 0
+    assert s4.stage_seconds("dispatch") > 0
+    assert not _leaked_pool_threads()
+
+
+def test_scan_files_prefetch_parity(pfile):
+    from tpu_parquet.device_reader import scan_files
+
+    def read(k):
+        return [{n: _host_view(c) for n, c in cols.items()}
+                for cols in scan_files([pfile, pfile], prefetch=k)]
+
+    g0 = read(0)
+    g4 = read(4)
+    assert len(g0) == len(g4) == 16
+    for a, b in zip(g0, g4):
+        for name in a:
+            for xa, xb in zip(a[name], b[name]):
+                np.testing.assert_array_equal(xa, xb)
+    assert not _leaked_pool_threads()
+
+
+def test_device_prefetch_with_row_filter(tmp_path):
+    """The pruning planner runs inside the chunk feed (thread-safe header
+    walks through the pread view); yielded groups/pages must match the
+    sequential filtered scan exactly."""
+    from tpu_parquet.device_reader import DeviceFileReader
+    from tpu_parquet.predicate import col
+
+    rng = np.random.default_rng(5)
+    n = 20_000
+    table = pa.table({
+        "k": pa.array(np.sort(rng.integers(0, 1000, n)), pa.int64()),
+        "x": pa.array(rng.uniform(0, 1, n), pa.float64()),
+    })
+    p = str(tmp_path / "f.parquet")
+    pq.write_table(table, p, compression="snappy", row_group_size=2_500)
+    pred = col("k") < 200
+
+    def read(k):
+        with DeviceFileReader(p, row_filter=pred, prefetch=k) as r:
+            groups = [{nm: _host_view(c) for nm, c in cols.items()}
+                      for cols in r.iter_row_groups()]
+            pruned = r.stats().pages_pruned
+        return groups, pruned
+
+    g0, pruned0 = read(0)
+    g4, pruned4 = read(4)
+    assert len(g0) == len(g4) and len(g0) > 0
+    assert pruned0 == pruned4
+    for a, b in zip(g0, g4):
+        for name in a:
+            for xa, xb in zip(a[name], b[name]):
+                np.testing.assert_array_equal(xa, xb)
+    assert not _leaked_pool_threads()
+
+
+def test_shard_scan_row_groups_pipelined(pfile):
+    from tpu_parquet.parallel import shard_scan_row_groups
+
+    with FileReader(pfile) as r:
+        seq = {i: out for i, out in shard_scan_row_groups(r, 0, 2)}
+        seq.update({i: out for i, out in shard_scan_row_groups(r, 1, 2)})
+    with FileReader(pfile) as r:
+        pipe = {}
+        for s in (0, 1):
+            for i, out in shard_scan_row_groups(r, s, 2, prefetch=3):
+                pipe[i] = out
+    assert set(seq) == set(pipe) == set(range(8))
+    for i in seq:
+        _assert_same_columns(seq[i], pipe[i])

@@ -49,3 +49,69 @@ class AllocTracker:
     def reset(self) -> None:
         with self._lock:
             self.total = 0
+
+
+class InFlightBudget:
+    """Bounded in-flight bytes with *backpressure* instead of an exception.
+
+    The prefetch pipeline (tpu_parquet/pipeline.py) holds several chunks'
+    decompressed bytes concurrently; raising (AllocTracker semantics) would
+    turn a legal file into an error just because the pipeline ran ahead.
+    Instead ``acquire`` BLOCKS the submitting thread until enough in-flight
+    bytes drain — the pipeline degrades toward sequential under memory
+    pressure rather than OOMing or failing.
+
+    A single item larger than the whole budget is admitted alone (charged at
+    the budget cap, after the pipeline has fully drained): per-chunk
+    decompression-bomb enforcement stays AllocTracker's job, this class only
+    bounds cross-chunk concurrency.  ``max_bytes <= 0`` disables all gating.
+
+    ``peak`` records the high-water mark of concurrently held bytes so tests
+    (and bench.py) can assert the bound was honored.
+    """
+
+    def __init__(self, max_bytes: int = 0):
+        self.max_bytes = int(max_bytes)
+        self.held = 0
+        self.peak = 0
+        self._cv = threading.Condition()
+
+    def _charge(self, nbytes: int) -> int:
+        n = int(nbytes)
+        if self.max_bytes > 0:
+            n = min(n, self.max_bytes)
+        return max(n, 0)
+
+    def _fits(self, n: int) -> bool:
+        return self.held == 0 or self.held + n <= self.max_bytes
+
+    def try_acquire(self, nbytes: int) -> bool:
+        """Non-blocking acquire; False when the bytes must wait their turn."""
+        if self.max_bytes <= 0:
+            return True
+        n = self._charge(nbytes)
+        with self._cv:
+            if not self._fits(n):
+                return False
+            self.held += n
+            self.peak = max(self.peak, self.held)
+            return True
+
+    def acquire(self, nbytes: int) -> None:
+        """Block until ``nbytes`` fit under the cap, then take them."""
+        if self.max_bytes <= 0:
+            return
+        n = self._charge(nbytes)
+        with self._cv:
+            while not self._fits(n):
+                self._cv.wait()
+            self.held += n
+            self.peak = max(self.peak, self.held)
+
+    def release(self, nbytes: int) -> None:
+        if self.max_bytes <= 0:
+            return
+        n = self._charge(nbytes)
+        with self._cv:
+            self.held -= n
+            self._cv.notify_all()

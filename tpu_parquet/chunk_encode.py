@@ -468,8 +468,12 @@ class ChunkEncoder:
                                            Type.FLOAT, Type.DOUBLE):
             if self._dict_stat_bounds is not None and vhi > vlo:
                 st = Statistics(null_count=(hi - lo) - (vhi - vlo))
-                st.min = st.min_value = self._dict_stat_bounds[0]
-                st.max = st.max_value = self._dict_stat_bounds[1]
+                # dictionary-wide BOUNDS are only legal in min_value/max_value
+                # (which permit non-occurring values); the deprecated min/max
+                # fields imply actual page values and an ambiguous BYTE_ARRAY
+                # sort order, so modern writers leave them unset here
+                st.min_value = self._dict_stat_bounds[0]
+                st.max_value = self._dict_stat_bounds[1]
                 return st
             return None
         vals = cd.values[vlo:vhi]

@@ -10,6 +10,12 @@ size, which is the first line of defense against decompression bombs (mirrors
 SNAPPY uses the native C++ codec (tpu_parquet/native/snappy.cpp) with a pure-Python
 raw-snappy implementation as fallback; GZIP uses stdlib zlib; ZSTD uses the
 ``zstandard`` module when present.
+
+Thread-safety contract: ``decompress_block``/``compress_block`` on a
+registered codec instance may be called CONCURRENTLY from the prefetch
+pipeline's worker threads (tpu_parquet/pipeline.py).  The built-ins satisfy
+it (stateless, or per-thread contexts — see ZstdCompressor); codecs plugged
+in via ``register_codec`` must too.
 """
 
 from __future__ import annotations
@@ -214,18 +220,36 @@ class GzipCompressor(BlockCompressor):
 
 
 class ZstdCompressor(BlockCompressor):
+    """zstd codec with PER-THREAD compressor/decompressor objects.
+
+    zstandard's context objects are explicitly not safe for concurrent use
+    of the same method from multiple threads, and the prefetch pipeline
+    (tpu_parquet/pipeline.py) decompresses several chunks' pages on a pool
+    against ONE registered codec instance — so each thread lazily builds its
+    own pair.  The other built-ins are audited stateless: Plain copies,
+    Snappy calls a pure function (native or python), Gzip constructs a fresh
+    decompressobj per call.
+    """
+
     def __init__(self, level: int = 3):
         if _zstd is None:
             raise CompressionError("zstandard module not available")
-        self._c = _zstd.ZstdCompressor(level=level)
-        self._d = _zstd.ZstdDecompressor()
+        self._level = level
+        self._tls = threading.local()
+
+    def _ctx(self):
+        t = self._tls
+        if not hasattr(t, "c"):
+            t.c = _zstd.ZstdCompressor(level=self._level)
+            t.d = _zstd.ZstdDecompressor()
+        return t
 
     def compress_block(self, block: bytes) -> bytes:
-        return self._c.compress(bytes(block))
+        return self._ctx().c.compress(bytes(block))
 
     def decompress_block(self, block: bytes, uncompressed_size: int) -> bytes:
         try:
-            return self._d.decompress(
+            return self._ctx().d.decompress(
                 bytes(block), max_output_size=max(uncompressed_size, 1)
             )
         except _zstd.ZstdError as e:

@@ -627,8 +627,21 @@ def _enable_compile_cache() -> None:
             return  # refuse a squatted directory; run uncached
         if st.st_mode & 0o022:
             # pre-existing dir with group/other write (permissive umask):
-            # close it before trusting — jax deserializes executables from
-            # here
+            # jax deserializes executables from here, so it cannot be
+            # trusted as-is.  Only the DEFAULT XDG-derived path is ours to
+            # tighten; a user-chosen TPQ_COMPILE_CACHE dir may be
+            # group-writable on purpose (a shared team cache) — warn and
+            # run uncached instead of silently stripping its permissions.
+            if env:
+                import warnings
+
+                warnings.warn(
+                    f"TPQ_COMPILE_CACHE directory {cache_dir!r} is "
+                    f"group/other-writable; refusing to use it for "
+                    f"deserialized executables (chmod it 0700, or accept "
+                    f"uncached compiles)", RuntimeWarning, stacklevel=2,
+                )
+                return
             os.chmod(cache_dir, 0o700)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
@@ -883,7 +896,22 @@ _FUSED_LOCK = threading.Lock()
 _FUSE_RG = os.environ.get("TPQ_FUSE_RG", "") == "1"
 
 _DEV_MEMO: dict = {}
+_DEV_MEMO_LOCK = threading.Lock()
 _DEV_MEMO_MAX_ARRAY = 4096  # bytes; tables above this ride the staged buffer
+
+
+def _memo_scope() -> tuple:
+    """The (platform, device id) a bare device_put commits to right now.
+
+    Keys are scoped by it so a default-device change mid-process (or a
+    multi-backend embedder) never hands a plan an array committed to the
+    wrong device."""
+    d = jax.config.jax_default_device
+    if isinstance(d, str):  # the config also accepts a platform string
+        d = jax.devices(d)[0]
+    elif d is None:
+        d = jax.devices()[0]
+    return (d.platform, d.id)
 
 
 def _memo_dev(x):
@@ -895,7 +923,13 @@ def _memo_dev(x):
     distinct value once and handing jit an already-committed device array
     makes later row groups' dispatches transfer-free — the per-call scalar
     `device_put`s were 4.9 s of a 27 s warm 100M-row rep on the tunneled
-    backend (BENCH_SCALE20.md)."""
+    backend (BENCH_SCALE20.md).
+
+    Thread-safe (dispatches may come from pipeline threads) and
+    self-healing: entries whose buffers were deleted out from under the
+    memo (jax.clear_caches, backend teardown) are dropped and re-put rather
+    than handed to a plan as dead arrays.  A racing double put is benign —
+    both arrays are valid, last one stays cached."""
     if isinstance(x, np.generic):
         key = ("s", x.dtype.str, x.item())
     elif isinstance(x, np.ndarray):
@@ -907,13 +941,21 @@ def _memo_dev(x):
             return x
     else:
         return x
-    hit = _DEV_MEMO.get(key)
-    if hit is None:
+    key = _memo_scope() + key
+    with _DEV_MEMO_LOCK:
+        hit = _DEV_MEMO.get(key)
+    if hit is not None:
+        try:
+            if not hit.is_deleted():
+                return hit
+        except Exception:  # noqa: BLE001 — treat unknowable as dead
+            pass
+    fresh = jax.device_put(x)
+    with _DEV_MEMO_LOCK:
         if len(_DEV_MEMO) > 8192:
             _DEV_MEMO.clear()
-        hit = jax.device_put(x)
-        _DEV_MEMO[key] = hit
-    return hit
+        _DEV_MEMO[key] = fresh
+    return fresh
 
 
 def _single_for(key, fn):
@@ -2234,7 +2276,8 @@ class DeviceFileReader:
 
     def __init__(self, source, columns=None, validate_crc: bool = False,
                  profile_dir: "str | None" = None, max_memory: int = 0,
-                 row_filter=None):
+                 row_filter=None, prefetch: int = 0):
+        from .pipeline import PipelineStats
         from .reader import FileReader
 
         _enable_compile_cache()
@@ -2243,6 +2286,12 @@ class DeviceFileReader:
                                 validate_crc=validate_crc,
                                 max_memory=max_memory,
                                 row_filter=row_filter)
+        # chunk-granular host prefetch depth (IO + CRC + decompress + parse
+        # of upcoming chunks on a bounded pool, spanning row-group
+        # boundaries); 0 = the sequential host phase
+        self._prefetch = int(prefetch)
+        self._pipe_stats = PipelineStats(prefetch=self._prefetch,
+                                         budget_bytes=int(max_memory))
         self.metadata = self._host.metadata
         self.schema = self._host.schema
         self.validate_crc = validate_crc
@@ -2334,7 +2383,7 @@ class DeviceFileReader:
             pos += hlen + csize
         return headers
 
-    def _plan_page_pruning(self, rg, leaves):
+    def _plan_page_pruning(self, rg, leaves, f=None):
         """Page-level predicate pushdown (beyond the reference, which writes
         page Statistics but never reads them): within a surviving row group,
         maximal row runs the predicate provably cannot match — aligned to
@@ -2368,7 +2417,8 @@ class DeviceFileReader:
                 by_path[".".join(md.path_in_schema)] = chunk
         if not fcols <= set(by_path):
             return None, 0, {}
-        f = self._host._f
+        if f is None:  # the chunk feed passes a thread-safe pread view
+            f = self._host._f
         filter_pages = {}
         boundaries = {}
         # FILTER chunks' bytes, handed to the decode loop when also selected
@@ -2433,13 +2483,18 @@ class DeviceFileReader:
         return skip, rows_dropped, bufs
 
     @scoped_x64
-    def _prepare_row_group(self, index: int, executor=None):
+    def _prepare_row_group(self, index: int, executor=None, collected=None):
         """Host phase: decompress + parse every chunk of the row group,
         registering all byte regions with ONE stager.
 
         With ``executor`` (the iter_row_groups staging worker) the stager
         streams completed 16 MiB strips to the device while this thread is
         still decompressing later chunks — see _RowGroupStager.
+
+        With ``collected`` (the chunk feed's output — IO + CRC + decompress
+        + structure parse already done on the prefetch pool, possibly while
+        an EARLIER row group was dispatching) the host phase here collapses
+        to stager registration and plan construction.
 
         No device calls on the common paths (plain/bool/bytes/dict/delta);
         the _finish_host fallback (mixed encodings, FLBA, INT96, delta byte
@@ -2456,8 +2511,12 @@ class DeviceFileReader:
         out: dict[str, DeviceColumnData] = {}
         f = self._host._f
         self.alloc.reset()
-        skip_pages, rows_dropped, planned_bufs = self._plan_page_pruning(
-            rg, leaves)
+        if collected is None:
+            skip_pages, rows_dropped, planned_bufs = self._plan_page_pruning(
+                rg, leaves)
+        else:
+            skip_pages, planned_bufs = None, {}
+            rows_dropped = collected["rows_dropped"]
         stager = _RowGroupStager(executor)
         plans: list[tuple[str, object]] = []
         for chunk in rg.columns or []:
@@ -2468,22 +2527,36 @@ class DeviceFileReader:
             leaf = leaves.get(path)
             if leaf is None:
                 continue
-            md, offset = validate_chunk_meta(chunk, leaf)
-            buf = planned_bufs.get(path)
-            if buf is None:
-                f.seek(offset)
-                buf = f.read(md.total_compressed_size)
-            if len(buf) != md.total_compressed_size:
-                raise ParquetError("chunk truncated")
-            self._stats.chunks += 1
-            self._stats.compressed_bytes += md.total_compressed_size
-            self.alloc.register(md.total_compressed_size)
-            asm = _collect_chunk(
-                buf, md.codec, md.num_values, leaf, self._deferred,
-                validate_crc=self.validate_crc, alloc=self.alloc,
-                statistics=md.statistics,
-                skip_pages=(skip_pages or {}).get(path),
-            )
+            if collected is not None:
+                entry = collected["chunks"].get(path)
+                if entry is None:
+                    # selection changed between feed and prepare (both run
+                    # in the consumer thread, so this is a caller bug)
+                    raise ParquetError(
+                        f"prefetched row group {index} missing chunk "
+                        f"{'.'.join(path)}"
+                    )
+                md, asm = entry
+                self._stats.chunks += 1
+                self._stats.compressed_bytes += md.total_compressed_size
+                self.alloc.register(md.total_compressed_size)
+            else:
+                md, offset = validate_chunk_meta(chunk, leaf)
+                buf = planned_bufs.get(path)
+                if buf is None:
+                    f.seek(offset)
+                    buf = f.read(md.total_compressed_size)
+                if len(buf) != md.total_compressed_size:
+                    raise ParquetError("chunk truncated")
+                self._stats.chunks += 1
+                self._stats.compressed_bytes += md.total_compressed_size
+                self.alloc.register(md.total_compressed_size)
+                asm = _collect_chunk(
+                    buf, md.codec, md.num_values, leaf, self._deferred,
+                    validate_crc=self.validate_crc, alloc=self.alloc,
+                    statistics=md.statistics,
+                    skip_pages=(skip_pages or {}).get(path),
+                )
             if asm is not None:
                 self._stats.pages += len(asm.pages)
                 self._stats.pages_pruned += asm.pages_pruned
@@ -2524,22 +2597,43 @@ class DeviceFileReader:
         out, plans, stager = prepared
         if plans:
             if buf_dev is None:
-                buf_dev = stager.stage()
-            out.update(_run_plans(plans, buf_dev))
+                with self._pipe_stats.timed("stage"):
+                    buf_dev = stager.stage()
+            with self._pipe_stats.timed("dispatch"):
+                out.update(_run_plans(plans, buf_dev))
         now = _time.perf_counter()
         with self._stats_lock:
             self._stats.device_seconds += now - t0
         if self._t0 is not None:
             self._stats.wall_seconds = now - self._t0
+        self._pipe_stats.count_row_group()
+        self._pipe_stats.touch_wall()
         return out
 
     def stats(self) -> ReaderStats:
         """Decode counters so far (rows/s, bytes/s, pages/chunk, HBM staged)."""
         return self._stats
 
+    def pipeline_stats(self):
+        """Per-stage pipeline timing (io / decompress / stage / dispatch /
+        finalize) plus stall time and the in-flight high-water mark — see
+        pipeline.PipelineStats.  The io/decompress stages are only populated
+        when ``prefetch`` > 0 routed the host phase through the chunk pool;
+        stage/dispatch/finalize accumulate on every path."""
+        return self._pipe_stats
+
     @scoped_x64
     def read_row_group(self, index: int, finalize: bool = True):
-        out = self._dispatch_row_group(self._prepare_row_group(index))
+        collected = None
+        if self._prefetch > 0:
+            feed = _chunk_feed(iter([(self, None, index)]), self._prefetch,
+                               self.alloc.max_size)
+            try:
+                _r, _p, _i, collected = next(feed)
+            finally:
+                feed.close()
+        out = self._dispatch_row_group(
+            self._prepare_row_group(index, collected=collected))
         if finalize:
             self.finalize()
         return out
@@ -2547,7 +2641,9 @@ class DeviceFileReader:
     @scoped_x64
     def finalize(self) -> None:
         """Run deferred validity checks (one device sync for all chunks)."""
-        _finalize_many([self])
+        with self._pipe_stats.timed("finalize"):
+            _finalize_many([self])
+        self._pipe_stats.touch_wall()
 
     def iter_batches(self, batch_size: int, columns=None):
         """Yield fixed-size device batches {column: jax.Array[batch_size, ...]}.
@@ -2577,7 +2673,7 @@ class DeviceFileReader:
             # trace everything under a scoped x64 context; yields happen
             # outside it so the consumer's dtype semantics are untouched
             # (a decorator on a generator would only scope its construction)
-            with jax.enable_x64():
+            with K.enable_x64():
                 arrays = {}
                 for name, col in cols.items():
                     if want is not None and name not in want:
@@ -2672,6 +2768,14 @@ class DeviceFileReader:
         from concurrent.futures import ThreadPoolExecutor
         import contextlib
 
+        from .pipeline import PipelineStats
+
+        # fresh counters per scan: the wall clock anchors at the scan's
+        # first touch, so overlap_efficiency never absorbs idle time
+        # between two scans on one reader (pipeline_stats() reports the
+        # current/most recent scan)
+        self._pipe_stats = PipelineStats(prefetch=self._prefetch,
+                                         budget_bytes=self.alloc.max_size)
         indices = [i for i in range(self.num_row_groups)
                    if self._host.row_group_selected(i)]
         if not indices:
@@ -2683,6 +2787,8 @@ class DeviceFileReader:
             for _, out in _scan_pipeline(
                 ((self, None, i) for i in indices), ex,
                 finalize_each=finalize_each,
+                prefetch=self._prefetch,
+                budget_bytes=self.alloc.max_size,
             ):
                 yield out
 
@@ -2717,28 +2823,178 @@ def _timed_stage(reader: DeviceFileReader, stager: _RowGroupStager):
 
     t0 = _time.perf_counter()
     buf_dev = stager.stage()
+    dt = _time.perf_counter() - t0
     with reader._stats_lock:
-        reader._stats.device_seconds += _time.perf_counter() - t0
+        reader._stats.device_seconds += dt
+    reader._pipe_stats.add("stage", dt)
     return buf_dev
+
+
+def _chunk_feed(work, prefetch: int, budget_bytes: int = 0):
+    """Chunk-granular prefetch over the ``(reader, path, index)`` stream.
+
+    The host half of the overlapped pipeline (ISSUE 1 tentpole): IO + CRC +
+    decompression + structure parse of upcoming chunks runs on a bounded
+    pool of ``prefetch`` threads — work items FLATTENED across row-group
+    and file boundaries, so the pool never drains while the main thread
+    registers/stages/dispatches the current group.  Yields
+    ``(reader, path, index, collected)`` in work order, where ``collected``
+    is the dict ``_prepare_row_group(collected=...)`` consumes
+    ({column_path: (md, _ChunkAssembler)} plus the pruning row count).
+
+    Structurally this mirrors FileReader._decode_row_groups (reader.py) —
+    same flatten/regroup protocol, sentinel convention, and cost formula;
+    a change to one should be checked against the other.  They stay
+    separate because the payloads differ (parsed assemblers + pruning
+    plans + per-reader stats attribution here, finished ColumnData there).
+
+    Page-pruning planning runs in the CONSUMER thread as items are pulled
+    (it must precede its group's reads); its header walks go through the
+    SharedReader's pread view, so they never race the pool's reads on the
+    shared descriptor.  In-flight decompressed bytes are bounded by an
+    InFlightBudget over ``budget_bytes`` — backpressure, not OOM.  Worker
+    chunks register against fresh per-chunk AllocTrackers (the
+    decompression-bomb guard keeps its teeth without sharing the reader's
+    per-row-group counter across threads).
+    """
+    from .alloc import AllocTracker, InFlightBudget
+    from .pipeline import SharedReader, prefetch_map
+
+    budget = InFlightBudget(budget_bytes)
+    srs: dict[int, SharedReader] = {}
+    pending: dict[tuple, dict] = {}
+    current = {"stats": None}  # stats of the reader whose item is submitting
+
+    class _StatsFwd:
+        """Route prefetch_map's stall/peak accounting to the owning reader.
+
+        Submission happens in the consumer thread right after gen_items
+        yields an item, so ``current`` always names the reader whose chunk
+        is paying the budget wait."""
+
+        @staticmethod
+        def add_stall(seconds):
+            st = current["stats"]
+            if st is not None:
+                st.add_stall(seconds)
+
+        @staticmethod
+        def note_peak(b):
+            st = current["stats"]
+            if st is not None:
+                st.note_peak(b)
+
+    def gen_items():
+        for r, path, i in work:
+            current["stats"] = r._pipe_stats
+            sr = srs.get(id(r))
+            if sr is None:
+                sr = srs[id(r)] = SharedReader(r._host._f)
+            rg = r.metadata.row_groups[i]
+            leaves = {l.path: l for l in r.schema.selected_leaves()}
+            skip_pages, rows_dropped, planned_bufs = r._plan_page_pruning(
+                rg, leaves, f=sr.as_file())
+            items = []
+            for chunk in rg.columns or []:
+                md = chunk.meta_data
+                if md is None or md.path_in_schema is None:
+                    raise ParquetError("column chunk missing metadata/path")
+                p = tuple(md.path_in_schema)
+                leaf = leaves.get(p)
+                if leaf is None:
+                    continue  # unselected: never read its bytes
+                md, offset = validate_chunk_meta(chunk, leaf)
+                items.append((r, sr, i, p, leaf, md, offset,
+                              (skip_pages or {}).get(p),
+                              planned_bufs.get(p)))
+            key = (id(r), i)
+            pending[key] = {"r": r, "path": path, "i": i,
+                            "todo": max(len(items), 1), "chunks": {},
+                            "rows_dropped": rows_dropped}
+            if not items:
+                items.append((r, None, i, None, None, None, None, None, None))
+            yield from items
+
+    def cost(item):
+        md = item[5]
+        if md is None:
+            return 0
+        comp = max(md.total_compressed_size or 0, 0)
+        return comp + max(md.total_uncompressed_size or 0, comp)
+
+    def collect(item):
+        r, sr, i, p, leaf, md, offset, skip, buf0 = item
+        if md is None:
+            return (id(r), i), None, None
+        stats = r._pipe_stats
+        tracker = AllocTracker(r.alloc.max_size)
+        tracker.register(md.total_compressed_size)
+        if buf0 is not None:
+            buf = buf0  # the pruning planner already paid this chunk's IO
+        else:
+            with stats.timed("io"):
+                buf = sr.pread(offset, md.total_compressed_size)
+        if len(buf) != md.total_compressed_size:
+            raise ParquetError("chunk truncated")
+        with stats.timed("decompress"):
+            asm = _collect_chunk(
+                buf, md.codec, md.num_values, leaf, r._deferred,
+                validate_crc=r.validate_crc, alloc=tracker,
+                statistics=md.statistics, skip_pages=skip,
+            )
+        stats.count_chunk()
+        return (id(r), i), p, (md, asm)
+
+    for key, p, payload in prefetch_map(gen_items(), collect, prefetch,
+                                        budget=budget, cost=cost,
+                                        stats=_StatsFwd()):
+        slot = pending[key]
+        if p is not None:
+            slot["chunks"][p] = payload
+        slot["todo"] -= 1
+        if slot["todo"] == 0:
+            del pending[key]
+            r = slot["r"]
+            r._pipe_stats.note_peak(budget)
+            r._pipe_stats.touch_wall()
+            yield r, slot["path"], slot["i"], {
+                "chunks": slot["chunks"],
+                "rows_dropped": slot["rows_dropped"],
+            }
 
 
 def _scan_pipeline(work, ex, finalize_each: bool = False,
                    close_finished: bool = False,
-                   defer_finalize: bool = False):
+                   defer_finalize: bool = False,
+                   prefetch: int = 0, budget_bytes: int = 0):
     """The one-deep prepare/stage/dispatch pipeline shared by
     ``DeviceFileReader.iter_row_groups`` (one reader) and :func:`scan_files`
     (many).  ``work`` yields ``(reader, path, row_group_index)``; this yields
     ``(path, columns)`` per row group.
 
+    With ``prefetch`` > 0 the host phase (chunk IO + decompress + parse) is
+    pulled out of ``_prepare_row_group`` onto :func:`_chunk_feed`'s pool:
+    chunks of row group N+1 (and beyond, budget permitting) decompress on
+    worker threads while group N stages and dispatches — the chunk-granular
+    overlap on top of the existing group-granular stage/dispatch overlap.
+    An eager error from a prefetched chunk may then preempt the preceding
+    yield by up to the feed's depth (the sequential path's by exactly one).
+
     Ordering contract: a row group is always YIELDED before its reader's
     deferred checks can raise (finalize runs after the yield, either at a
     file boundary or at the end), matching iter_row_groups' yield-then-raise
     semantics.  With ``close_finished`` a reader is closed as soon as its
-    last row group is delivered, bounding open file descriptors to one.
+    last row group is delivered, bounding open file descriptors to one (all
+    of a reader's chunk reads precede its last group's yield, so the feed
+    never touches a closed descriptor).
     """
+    if prefetch > 0:
+        stream = _chunk_feed(work, prefetch, budget_bytes)
+    else:
+        stream = ((r, path, i, None) for r, path, i in work)
     prev = None  # (reader, path, prepared, staging future)
-    for r, path, i in work:
-        prepared = r._prepare_row_group(i, executor=ex)
+    for r, path, i, collected in stream:
+        prepared = r._prepare_row_group(i, executor=ex, collected=collected)
         fut = ex.submit(_timed_stage, r, prepared[2]) if prepared[1] else None
         if prev is not None:
             pr, pp, pprep, pfut = prev
@@ -2764,8 +3020,17 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
 
 
 def scan_files(paths, columns=None, validate_crc: bool = False,
-               max_memory: int = 0, row_filter=None, with_path: bool = False):
+               max_memory: int = 0, row_filter=None, with_path: bool = False,
+               prefetch: int = 0):
     """Scan several files' row groups through ONE continuous transfer pipeline.
+
+    ``prefetch=K`` additionally runs chunk IO + decompression K-deep on a
+    worker pool spanning row-group AND file boundaries (see _chunk_feed), so
+    the host phase of file N+1's first group overlaps file N's tail
+    transfers — the same lookahead the group-granular pipeline below already
+    provides for staging, extended to the host's half of the work.  The
+    feed's lookahead opens upcoming files a little earlier, so the open-fd
+    bound becomes O(prefetch) instead of one.
 
     The multi-file dataset form of ``DeviceFileReader.iter_row_groups``
     (BASELINE config 5 is a multi-file row-group scan): per-file iteration
@@ -2812,7 +3077,9 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
     try:
         with ThreadPoolExecutor(1) as ex:
             for pp, out in _scan_pipeline(work(), ex, close_finished=True,
-                                          defer_finalize=True):
+                                          defer_finalize=True,
+                                          prefetch=int(prefetch),
+                                          budget_bytes=int(max_memory)):
                 yield (pp, out) if with_path else out
         _finalize_many(readers)
     finally:

@@ -147,6 +147,13 @@ def _decode_native(buf: bytes, width: int, count: int) -> "np.ndarray | None":
     n_runs, _consumed, ends, kinds, vals, starts = res[:6]
     if width == 0:
         return np.zeros(count, dtype=np.uint32)
+    # C expansion first: same run-table contract, one pass, GIL released
+    # (the numpy sweep below is the fallback and the fuzz-parity oracle)
+    expanded = native.hybrid_expand(buf, ends[:n_runs], kinds[:n_runs],
+                                    vals[:n_runs], starts[:n_runs],
+                                    width, count)
+    if expanded is not None:
+        return expanded
     i = np.arange(count, dtype=np.int64)
     r = np.searchsorted(ends, i, side="right")
     r = np.minimum(r, n_runs - 1)

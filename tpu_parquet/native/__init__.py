@@ -143,6 +143,17 @@ def load():
                 c_ll, ctypes.c_void_p,
                 c_ll, ctypes.c_uint64, ctypes.c_void_p,
             ]
+            lib.tpq_ragged_take.restype = None
+            lib.tpq_ragged_take.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, c_ll,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            lib.tpq_hybrid_expand.restype = None
+            lib.tpq_hybrid_expand.argtypes = [
+                ctypes.c_char_p, c_ll,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, c_ll, ctypes.c_int, c_ll, ctypes.c_void_p,
+            ]
             _lib = lib
         except Exception:
             _load_failed = True
@@ -627,6 +638,53 @@ def delta_ba_stitch(prefix_lens, suf_off, suf_heap, out_off, heap) -> "int | Non
         heap.ctypes.data_as(pu8),
         len(prefix_lens),
     ))
+
+
+def ragged_take(offsets, heap, idx, out_off, out_heap) -> bool:
+    """Gather ragged rows: out_heap[out_off[i]:out_off[i+1]] =
+    heap[offsets[idx[i]]:offsets[idx[i]+1]] (dictionary expansion).
+
+    All arrays are caller-allocated, contiguous numpy (offsets/idx/out_off
+    int64, heaps uint8); the caller computed ``out_off`` and bounds-checked
+    ``idx``.  Returns False when the native library is unavailable (caller
+    keeps the numpy gather).  Runs with the GIL released — the prefetch
+    pipeline's worker threads overlap here.
+    """
+    lib = load()
+    if lib is None:
+        return False
+    lib.tpq_ragged_take(
+        offsets.ctypes.data, heap.ctypes.data, idx.ctypes.data, len(idx),
+        out_off.ctypes.data, out_heap.ctypes.data,
+    )
+    return True
+
+
+def hybrid_expand(buf, ends, kinds, vals, starts, width: int, count: int):
+    """Expand hybrid run tables (hybrid_meta output) to uint32[count].
+
+    Same value contract as the numpy sweep in kernels/rle.py:_decode_native
+    (bit-packed fields at starts[r] + i*width, RLE broadcasting vals[r]).
+    Returns the array, or None when the native library is unavailable.
+    GIL-free like ragged_take.
+    """
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    out = np.empty(count, dtype=np.uint32)
+    # locals keep the (possibly converted) tables alive across the C call
+    e = np.ascontiguousarray(ends, np.int64)
+    k = np.ascontiguousarray(kinds, np.uint8)
+    v = np.ascontiguousarray(vals, np.uint32)
+    s = np.ascontiguousarray(starts, np.int64)
+    lib.tpq_hybrid_expand(
+        _buf_arg(buf), len(buf),
+        e.ctypes.data, k.ctypes.data, v.ctypes.data, s.ctypes.data,
+        len(e), width, count, out.ctypes.data,
+    )
+    return out
 
 
 def available() -> bool:

@@ -278,4 +278,67 @@ long long tpq_snappy_compress(const uint8_t* src, size_t n, uint8_t* dst) {
   return out - dst;
 }
 
+// --- ragged gather / hybrid expansion (host decode hot paths) ---------------
+//
+// These two transforms dominated the host decode profile as numpy
+// (repeat+arange gather for dictionary take, searchsorted + byte-window
+// sweep for hybrid expansion) and — unlike numpy's non-ufunc kernels — run
+// here with the GIL released (ctypes), so the chunk-prefetch pipeline's
+// worker threads genuinely overlap.
+
+// Dictionary expansion for ragged BYTE_ARRAY rows: output row i is
+// heap[offsets[idx[i]] : offsets[idx[i]+1]], landing at out_off[i].  The
+// caller computes out_off (cumsum of the selected lengths) and has already
+// bounds-checked idx against the dictionary.
+void tpq_ragged_take(const int64_t* offsets, const uint8_t* heap,
+                     const int64_t* idx, long long n,
+                     const int64_t* out_off, uint8_t* out_heap) {
+  for (long long i = 0; i < n; ++i) {
+    const int64_t j = idx[i];
+    const int64_t start = offsets[j];
+    const int64_t len = offsets[j + 1] - start;
+    if (len > 0) std::memcpy(out_heap + out_off[i], heap + start, size_t(len));
+  }
+}
+
+// Expand parsed hybrid run tables (tpq_hybrid_meta's output, meta_parse.cpp)
+// to `count` uint32 values.  kinds[r] == 0 is a bit-packed run whose value
+// at global position i sits at bit starts[r] + i*width (starts are
+// pre-normalized by -run_start*width, exactly the contract the numpy sweep
+// in kernels/rle.py consumes); nonzero kinds are RLE runs filling vals[r].
+// width 1..32.  Reads never pass nbuf (tail fields assemble byte-wise).
+void tpq_hybrid_expand(const uint8_t* buf, long long nbuf,
+                       const int64_t* ends, const uint8_t* kinds,
+                       const uint32_t* vals, const int64_t* starts,
+                       long long n_runs, int width, long long count,
+                       uint32_t* out) {
+  const uint64_t mask =
+      (width >= 32) ? 0xffffffffull : ((1ull << width) - 1ull);
+  int64_t pos = 0;
+  for (long long r = 0; r < n_runs && pos < count; ++r) {
+    int64_t end = ends[r];
+    if (end > count) end = count;
+    if (end <= pos) continue;
+    if (kinds[r] != 0) {  // RLE: broadcast the run value
+      const uint32_t v = vals[r];
+      for (; pos < end; ++pos) out[pos] = v;
+    } else {  // bit-packed: extract width-bit fields at affine positions
+      const int64_t sbit = starts[r];
+      for (; pos < end; ++pos) {
+        const int64_t bit = sbit + pos * int64_t(width);
+        const int64_t byte0 = bit >> 3;
+        uint64_t acc = 0;
+        if (byte0 + 8 <= nbuf) {
+          std::memcpy(&acc, buf + byte0, 8);
+        } else {
+          for (int k = 0; k < 8 && byte0 + k < nbuf; ++k)
+            acc |= uint64_t(buf[byte0 + k]) << (8 * k);
+        }
+        out[pos] = uint32_t((acc >> (bit & 7)) & mask);
+      }
+    }
+  }
+  for (; pos < count; ++pos) out[pos] = 0;  // defensive: runs short of count
+}
+
 }  // extern "C"

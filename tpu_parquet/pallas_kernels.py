@@ -161,7 +161,9 @@ def unpack_bp_groups(buf_dev, bp_base: int, width: int, groups_pad: int,
                          f"{_GROUPS_PER_TILE}")
     if isinstance(bp_base, (int, np.integer)):
         bp_base = np.int32(bp_base)  # traced callers pass their own i32
-    with jax.enable_x64(False):
+    from .jax_kernels import enable_x64
+
+    with enable_x64(False):
         return _bp_groups_jit(buf_dev, bp_base, width=width,
                               groups_pad=groups_pad,
                               interpret=bool(interpret))

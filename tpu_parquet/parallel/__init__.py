@@ -37,9 +37,24 @@ from .. import jax_kernels as K
 from ..jax_kernels import scoped_x64
 from ..jax_decode import HybridMeta, DeltaMeta, parse_hybrid_meta, parse_delta_meta, _bucket, _SLACK
 
+# shard_map moved and renamed a kwarg across jax releases: newer jax exposes
+# ``jax.shard_map(..., check_vma=)``, 0.4.x only
+# ``jax.experimental.shard_map.shard_map(..., check_rep=)``.  Resolve once.
+if hasattr(jax, "shard_map"):
+    def _shard_map(fn, mesh, in_specs, out_specs):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(fn, mesh, in_specs, out_specs):
+        return _exp_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
 __all__ = [
     "make_mesh",
     "plan_shards",
+    "shard_scan_row_groups",
     "PageBatch",
     "pack_hybrid_pages",
     "pack_delta_pages",
@@ -89,6 +104,47 @@ def plan_shards(sizes: Sequence[int], n_shards: int) -> list[list[int]]:
     for shard in plan:
         shard.sort()
     return plan
+
+
+def _reader_prefetch(reader) -> int:
+    """A reader's configured pipeline depth: FileReader exposes ``prefetch``,
+    DeviceFileReader ``_prefetch``; any other reader defaults to 0."""
+    return int(getattr(reader, "prefetch", None)
+               or getattr(reader, "_prefetch", 0) or 0)
+
+
+def shard_scan_row_groups(reader, shard_index: int, n_shards: int,
+                          prefetch: Optional[int] = None):
+    """Decode the row groups LPT-assigned to ``shard_index``, pipelined.
+
+    The per-SHARD pipeline form of the work-list split: every shard computes
+    the identical byte-balanced plan from the shared footer (plan_shards —
+    no coordination traffic) and decodes only its own groups, each through
+    the reader's overlapped chunk pipeline (``prefetch`` per-call override;
+    the reader's own setting otherwise).  Shards run in different
+    processes/hosts, so pipelines are deliberately per-shard rather than
+    one global pool.  Yields ``(row_group_index, {column: ColumnData})``.
+    """
+    sizes = [
+        sum(cc.meta_data.total_compressed_size or 0
+            for cc in (rg.columns or []) if cc.meta_data is not None)
+        for rg in reader.metadata.row_groups
+    ]
+    plan = plan_shards(sizes, n_shards)
+    if not 0 <= shard_index < n_shards:
+        raise ValueError(f"shard {shard_index} of {n_shards}")
+    mine = plan[shard_index]
+    k = _reader_prefetch(reader) if prefetch is None else int(prefetch)
+    if k > 0 and hasattr(reader, "_decode_row_groups"):
+        # ONE pipeline over the whole shard: the window spans group
+        # boundaries (per-group read_row_group calls would build and drain
+        # a pool at every boundary — exactly the stall this exists to hide)
+        yield from reader._decode_row_groups(mine, k)
+        return
+    for i in mine:
+        # bare call: the generic reader contract (a DeviceFileReader's
+        # read_row_group has no prefetch kwarg)
+        yield i, reader.read_row_group(i)
 
 
 # ---------------------------------------------------------------------------
@@ -254,13 +310,12 @@ def sharded_dict_decode(
         ])
         return out, stats
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(axis, None), P(axis, None),
                   P(axis, None), P(None, None)),
         out_specs=(P(axis, None), P()),
-        check_vma=False,
     )
     return fn(
         batch.bufs, batch.run_ends, batch.run_is_rle, batch.run_values,
@@ -312,13 +367,12 @@ def sharded_dict_decode_2d(
         full = jax.lax.psum(gathered, model_axis)
         return full.reshape(idx.shape + full.shape[1:])
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(data_axis, None), P(data_axis, None), P(data_axis, None),
                   P(data_axis, None), P(data_axis, None), P(model_axis, None)),
         out_specs=P(data_axis, None),
-        check_vma=False,
     )
     return fn(
         batch.bufs, batch.run_ends, batch.run_is_rle, batch.run_values,
@@ -341,13 +395,12 @@ def sharded_delta_decode(
             )
         )(bufs, firsts, starts, widths, mins)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(axis, None), P(axis, None),
                   P(axis, None)),
         out_specs=P(axis, None),
-        check_vma=False,
     )
     return fn(
         batch.bufs, batch.first_values, batch.mini_bit_starts,
@@ -364,9 +417,9 @@ def sharded_plain_decode(
     def shard_fn(b):
         return jax.vmap(lambda x: K.plain_decode_fixed(x, dtype, count))(b)
 
-    fn = jax.shard_map(
-        shard_fn, mesh=mesh, in_specs=(P(axis, None),), out_specs=P(axis, None),
-        check_vma=False,
+    fn = _shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(axis, None),),
+        out_specs=P(axis, None),
     )
     return fn(bufs)
 
@@ -387,9 +440,8 @@ def column_stats(values: jax.Array, mesh: Mesh, axis: str = "data"):
             jax.lax.pmax(jnp.max(v).astype(jnp.int64), axis),
         ])
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         shard_fn, mesh=mesh, in_specs=(P(axis, None),), out_specs=P(),
-        check_vma=False,
     )
     return fn(values)
 
@@ -442,7 +494,8 @@ def column_span_dtype(reader, column: str) -> np.dtype:
     return dt
 
 
-def decode_row_span(reader, column: str, row_start: int, row_end: int) -> np.ndarray:
+def decode_row_span(reader, column: str, row_start: int, row_end: int,
+                    prefetch: Optional[int] = None) -> np.ndarray:
     """Decode exactly rows [row_start, row_end) of a flat column on host.
 
     Touches only the row groups the span intersects (others are never read —
@@ -450,28 +503,53 @@ def decode_row_span(reader, column: str, row_start: int, row_end: int) -> np.nda
     granularity) and slices boundary groups.  Column selection is narrowed to
     the one requested column for the duration of the call, so sibling chunks
     in touched row groups are seeked past, not decoded.
+
+    ``prefetch`` > 0 routes each touched group through the reader's chunk
+    pipeline (reader.FileReader prefetch semantics) — the per-SHARD decode
+    pipeline: every shard of a work list overlaps its own IO and
+    decompression independently, so a multi-host scan pipelines on every
+    host without coordination.
     """
     dtype = column_span_dtype(reader, column)
     parts = []
     base = 0
+    # touched groups + their row slices, planned up front so the pipelined
+    # path can run ONE window across all of them (a per-group
+    # read_row_group call would drain the pool at every boundary)
+    touched = []  # (index, lo, hi, n)
+    for i, rg in enumerate(reader.metadata.row_groups):
+        n = rg.num_rows
+        lo, hi = max(row_start - base, 0), min(row_end - base, n)
+        if lo < hi:
+            touched.append((i, lo, hi, n))
+        base += n
+        if base >= row_end:
+            break
+    k = _reader_prefetch(reader) if prefetch is None else int(prefetch)
     prev_selected = [tuple(l.path) for l in reader.schema.selected_leaves()]
     reader.schema.set_selected([tuple(column.split("."))])
     try:
-        for i, rg in enumerate(reader.metadata.row_groups):
-            n = rg.num_rows
-            lo, hi = max(row_start - base, 0), min(row_end - base, n)
-            if lo < hi:
-                cd = reader.read_row_group(i)[column]
-                vals = cd.values
-                if len(vals) != n:
-                    raise ValueError(
-                        f"decode_row_span requires a flat required column; "
-                        f"{column!r} has {len(vals)} values for {n} rows"
-                    )
-                parts.append(np.asarray(vals)[lo:hi])
-            base += n
-            if base >= row_end:
-                break
+        spans = {i: (lo, hi, n) for i, lo, hi, n in touched}
+        if k > 0 and hasattr(reader, "_decode_row_groups"):
+            groups = reader._decode_row_groups(sorted(spans), k)
+        elif hasattr(reader, "_decode_row_groups"):
+            # our FileReader: honor an explicit prefetch=0 even when the
+            # reader's own setting is pipelined
+            groups = ((i, reader.read_row_group(i, prefetch=0))
+                      for i in sorted(spans))
+        else:
+            # generic reader contract: bare call only
+            groups = ((i, reader.read_row_group(i)) for i in sorted(spans))
+        for i, cols in groups:
+            lo, hi, n = spans[i]
+            cd = cols[column]
+            vals = cd.values
+            if len(vals) != n:
+                raise ValueError(
+                    f"decode_row_span requires a flat required column; "
+                    f"{column!r} has {len(vals)} values for {n} rows"
+                )
+            parts.append(np.asarray(vals)[lo:hi])
     finally:
         reader.schema.set_selected(prev_selected)
     if not parts:
@@ -490,7 +568,8 @@ def _pad_span(local: np.ndarray, per: int, dtype: np.dtype) -> np.ndarray:
 
 @scoped_x64
 def global_column_array(
-    reader, column: str, mesh: Mesh, axis: str = "data"
+    reader, column: str, mesh: Mesh, axis: str = "data",
+    prefetch: Optional[int] = None,
 ) -> tuple[jax.Array, int]:
     """Work-list → one global row-sharded device array (single-host form).
 
@@ -516,7 +595,8 @@ def global_column_array(
     if not per:
         return jnp.zeros((0,), dtype=dtype), 0
     decoded = [
-        _pad_span(decode_row_span(reader, column, lo, hi), per, dtype)
+        _pad_span(decode_row_span(reader, column, lo, hi, prefetch=prefetch),
+                  per, dtype)
         for lo, hi in spans
     ]
     pieces = [
@@ -530,7 +610,8 @@ def global_column_array(
 
 @scoped_x64
 def process_local_column(
-    reader, column: str, mesh: Mesh, axis: str = "data"
+    reader, column: str, mesh: Mesh, axis: str = "data",
+    prefetch: Optional[int] = None,
 ) -> tuple[jax.Array, int]:
     """True multi-host form: this process decodes only ITS span of the work
     list and contributes it via ``jax.make_array_from_process_local_data``.
@@ -547,7 +628,8 @@ def process_local_column(
     spans = shard_row_ranges(total, nproc)
     lo, hi = spans[jax.process_index()]
     per = spans[0][1] - spans[0][0] if total else 0
-    local = _pad_span(decode_row_span(reader, column, lo, hi), per,
+    local = _pad_span(decode_row_span(reader, column, lo, hi,
+                                      prefetch=prefetch), per,
                       column_span_dtype(reader, column))
     sharding = NamedSharding(mesh, P(axis))
     arr = jax.make_array_from_process_local_data(

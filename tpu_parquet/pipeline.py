@@ -1,0 +1,320 @@
+"""Overlapped chunk pipeline: threaded IO + decompress prefetch.
+
+SURVEY.md §7.4.7's stance — "pipelining beats any single kernel" — applied to
+the host side of the decode path.  The engine's natural work unit is the
+column chunk (one contiguous IO, one decompress+parse, one staged region, one
+fused dispatch); until this module, file → row group → chunk executed strictly
+sequentially, so the device idled during every chunk's IO and the CPU idled
+during every transfer.
+
+Three pieces, shared by the host ``FileReader`` and the batched
+``DeviceFileReader``:
+
+- :func:`prefetch_map` — an *ordered* overlapped map: up to ``prefetch``
+  items run on a bounded thread pool ahead of the consumer, results are
+  yielded in submission order, and errors surface at the failing item's
+  position (never out of order, never swallowed).  Decompression releases the
+  GIL (zlib via stdlib, snappy via ctypes → the C++ codec), and chunk IO is
+  blocking reads, so host threads genuinely overlap.  The item stream is
+  pulled lazily in the CONSUMER thread, so work generation (page-pruning
+  planning, schema snapshots) keeps its sequential semantics.
+- :class:`PipelineStats` — per-stage wall-time counters
+  (io / decompress / stage / dispatch / finalize) plus stall time and the
+  in-flight high-water mark, surfaced by both readers' ``pipeline_stats()``
+  so bench.py can report overlap efficiency (sum of stage time ÷ wall time:
+  1.0 is perfectly serial, higher means overlap).
+- :class:`SharedReader` — thread-safe positioned reads over one byte source:
+  ``os.pread`` on real files (parallel, never touches the shared fd
+  position), a lock around seek+read otherwise (BytesIO, sockets wrapped in
+  a buffer).
+
+Memory is bounded by :class:`tpu_parquet.alloc.InFlightBudget`: the submitter
+acquires each chunk's estimated bytes (compressed + decompressed, from the
+footer) BEFORE handing it to the pool and releases them when the consumer
+takes the result — backpressure instead of OOM, asserted in tests.  The
+budget is only ever awaited in the consumer thread while nothing is in
+flight, or skipped in favor of draining the window head, so it cannot
+deadlock against itself.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
+from typing import Callable, Iterable, Iterator, Optional, TypeVar
+
+from .alloc import InFlightBudget
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+STAGES = ("io", "decompress", "stage", "dispatch", "finalize")
+
+
+class PipelineStats:
+    """Per-stage timing for the overlapped decode pipeline (SURVEY.md §5.5).
+
+    Stage meanings (a stage a path never enters simply stays 0):
+
+    - ``io``          chunk byte reads from the source
+    - ``decompress``  page decompress + CRC + structure parse + host decode
+    - ``stage``       host→device staging (buffer assembly + transfer)
+    - ``dispatch``    issuing the fused XLA calls
+    - ``finalize``    deferred validity syncs
+
+    ``busy_seconds`` is the sum over stages — the serial cost the pipeline is
+    hiding; ``overlap_efficiency = busy_seconds / wall_seconds`` reads 1.0
+    for a perfectly serial run and >1 when stages genuinely overlap.
+    ``stall_seconds`` counts submitter time blocked on the memory budget.
+    Thread-safe: workers and the main thread add concurrently.
+    """
+
+    def __init__(self, prefetch: int = 0, budget_bytes: int = 0):
+        self.prefetch = int(prefetch)
+        self.budget_bytes = int(budget_bytes)
+        self.chunks = 0
+        self.row_groups = 0
+        self.stall_seconds = 0.0
+        self.wall_seconds = 0.0
+        self.peak_in_flight_bytes = 0
+        self._stage_seconds = {s: 0.0 for s in STAGES}
+        self._lock = threading.Lock()
+        self._t0: Optional[float] = None
+
+    # -- accumulation ---------------------------------------------------------
+
+    def add(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            self._stage_seconds[stage] += seconds
+
+    @contextmanager
+    def timed(self, stage: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(stage, time.perf_counter() - t0)
+
+    def add_stall(self, seconds: float) -> None:
+        with self._lock:
+            self.stall_seconds += seconds
+
+    def count_chunk(self) -> None:
+        with self._lock:
+            self.chunks += 1
+
+    def count_row_group(self) -> None:
+        with self._lock:
+            self.row_groups += 1
+
+    def touch_wall(self) -> None:
+        """Extend the wall clock to now (first call starts it)."""
+        now = time.perf_counter()
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = now
+            self.wall_seconds = now - self._t0
+
+    def note_peak(self, budget: InFlightBudget) -> None:
+        with self._lock:
+            self.peak_in_flight_bytes = max(self.peak_in_flight_bytes,
+                                            budget.peak)
+
+    # -- reporting ------------------------------------------------------------
+
+    def stage_seconds(self, stage: str) -> float:
+        with self._lock:
+            return self._stage_seconds[stage]
+
+    @property
+    def busy_seconds(self) -> float:
+        with self._lock:
+            return sum(self._stage_seconds.values())
+
+    @property
+    def overlap_efficiency(self) -> float:
+        return self.busy_seconds / self.wall_seconds if self.wall_seconds else 0.0
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            stages = {f"{s}_seconds": round(v, 6)
+                      for s, v in self._stage_seconds.items()}
+        busy = self.busy_seconds
+        return {
+            "prefetch": self.prefetch,
+            "budget_bytes": self.budget_bytes,
+            "chunks": self.chunks,
+            "row_groups": self.row_groups,
+            **stages,
+            "busy_seconds": round(busy, 6),
+            "wall_seconds": round(self.wall_seconds, 6),
+            "stall_seconds": round(self.stall_seconds, 6),
+            "peak_in_flight_bytes": self.peak_in_flight_bytes,
+            "overlap_efficiency": round(self.overlap_efficiency, 3),
+        }
+
+
+class SharedReader:
+    """Thread-safe positioned reads over one open byte source.
+
+    Real files read via ``os.pread`` — fully parallel, and the shared fd's
+    position is never touched, so a main thread interleaving its own
+    seek+read (the page-pruning planner) stays correct.  Sources without a
+    usable fd (BytesIO, wrapped streams) fall back to a lock around
+    seek+read; ``parallel`` is False there so callers that ALSO seek the raw
+    object outside this class know to stay sequential.
+    """
+
+    def __init__(self, f):
+        self._f = f
+        self._lock = threading.Lock()
+        self._fd: Optional[int] = None
+        try:
+            self._fd = f.fileno()
+        except Exception:  # noqa: BLE001 — io.UnsupportedOperation et al.
+            self._fd = None
+        if self._fd is not None:
+            # some file-likes expose a fileno that pread cannot serve (a
+            # pipe), and some platforms lack os.pread entirely (Windows);
+            # probe once and fall back to the locked path forever
+            try:
+                os.pread(self._fd, 0, 0)
+            except (OSError, AttributeError):
+                self._fd = None
+
+    @property
+    def parallel(self) -> bool:
+        return self._fd is not None
+
+    def as_file(self) -> "_PReadFile":
+        """A minimal file-like (seek/read pairs) whose every read goes
+        through ``pread`` — for code written against a raw file that must
+        run while worker threads read the same source (the page-pruning
+        planner's header walks)."""
+        return _PReadFile(self)
+
+    def pread(self, offset: int, size: int) -> bytes:
+        if self._fd is not None:
+            parts = []
+            pos = offset
+            remaining = size
+            while remaining > 0:
+                b = os.pread(self._fd, remaining, pos)
+                if not b:
+                    break
+                parts.append(b)
+                pos += len(b)
+                remaining -= len(b)
+            return b"".join(parts) if len(parts) != 1 else parts[0]
+        with self._lock:
+            self._f.seek(offset)
+            return self._f.read(size)
+
+
+class _PReadFile:
+    """File-like adapter over :class:`SharedReader` — tracks its own
+    position, so concurrent holders never fight over the shared fd's."""
+
+    def __init__(self, sr: SharedReader):
+        self._sr = sr
+        self._pos = 0
+
+    def seek(self, pos: int) -> int:
+        self._pos = int(pos)
+        return self._pos
+
+    def read(self, size: int) -> bytes:
+        b = self._sr.pread(self._pos, size)
+        self._pos += len(b)
+        return b
+
+
+def prefetch_map(
+    items: Iterable[T],
+    fn: Callable[[T], R],
+    prefetch: int,
+    budget: Optional[InFlightBudget] = None,
+    cost: Optional[Callable[[T], int]] = None,
+    stats: Optional[PipelineStats] = None,
+) -> Iterator[R]:
+    """Ordered overlapped map: run ``fn`` over ``items`` on a bounded pool.
+
+    Up to ``prefetch`` items are in flight ahead of the consumer; results
+    yield strictly in item order; an item whose ``fn`` raises re-raises at
+    its ordered position, after which remaining work is cancelled and the
+    pool is joined — no leaked threads, even when the consumer abandons the
+    generator early (``break`` triggers the same cleanup via close()).
+
+    ``cost(item)`` bytes are acquired from ``budget`` before submission and
+    released when the consumer receives the result (ownership transfers).
+    Backpressure never blocks while results are poppable: when the next
+    item's bytes don't fit, the window head is drained first; a true blocking
+    wait happens only with nothing in flight (the oversize-item case, which
+    :class:`InFlightBudget` admits alone).
+
+    ``prefetch <= 0`` degrades to a plain sequential map with zero threads —
+    the bit-identical baseline the tests compare against.
+    """
+    if prefetch <= 0:
+        for item in items:
+            yield fn(item)
+        return
+    it = iter(items)
+    pending: deque = deque()  # (future, charged_cost)
+    carried: Optional[tuple] = None  # (item, cost) awaiting budget headroom
+    # the WINDOW is prefetch items deep, but the pool never exceeds the
+    # machine's cores: chunk decode is a numpy/ctypes mix that still holds
+    # the GIL between releases, and oversubscribed workers convoy on it
+    # (measured 0.88x at 4 threads on 2 cores; queued-but-not-running items
+    # keep the lookahead without the contention)
+    workers = max(1, min(prefetch, os.cpu_count() or 1))
+    ex = ThreadPoolExecutor(max_workers=workers,
+                            thread_name_prefix="tpq-prefetch")
+    try:
+        exhausted = False
+        while True:
+            while not exhausted and len(pending) < prefetch:
+                if carried is None:
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    carried = (item, int(cost(item)) if cost is not None else 0)
+                item, c = carried
+                if budget is not None and c:
+                    if not budget.try_acquire(c):
+                        if pending:
+                            break  # drain the head; its release frees room
+                        t0 = time.perf_counter()
+                        budget.acquire(c)
+                        if stats is not None:
+                            stats.add_stall(time.perf_counter() - t0)
+                    if stats is not None:
+                        stats.note_peak(budget)
+                carried = None
+                pending.append((ex.submit(fn, item), c))
+            if not pending:
+                if carried is None:
+                    break
+                continue  # budget-carried item with empty window: block-acquire
+            fut, c = pending.popleft()
+            try:
+                res = fut.result()
+            finally:
+                if budget is not None and c:
+                    budget.release(c)
+            yield res
+    finally:
+        for fut, _c in pending:
+            fut.cancel()
+        ex.shutdown(wait=True)
+        for fut, c in pending:
+            if budget is not None and c:
+                budget.release(c)
+            if not fut.cancelled():
+                fut.exception()  # retrieve, so failures aren't warned as lost

@@ -16,11 +16,12 @@ from typing import BinaryIO, Iterable, Optional, Sequence, Union
 
 import numpy as np
 
-from .alloc import AllocTracker
-from .chunk_decode import read_chunk
+from .alloc import AllocTracker, InFlightBudget
+from .chunk_decode import ChunkDecoder, read_chunk, validate_chunk_meta
 from .column import ByteArrayData, ColumnData
 from .footer import ParquetError, read_file_metadata
 from .format import FileMetaData, Type
+from .pipeline import PipelineStats, SharedReader, prefetch_map
 from .schema.core import Schema, SchemaNode
 
 
@@ -36,6 +37,17 @@ class FileReader:
     Options mirror file_reader.go:65-149: ``columns`` (projection),
     ``validate_crc``, ``max_memory`` (WithMaximumMemorySize), ``metadata``
     (WithFileMetaData).
+
+    ``prefetch=K`` (K > 0) turns the group/chunk iteration APIs into an
+    overlapped pipeline: a bounded pool of K threads runs IO + CRC +
+    decompression + decode for upcoming chunks — flattened ACROSS row-group
+    boundaries, so the pipeline never drains between groups — while the
+    consumer assembles finished groups.  Output is bit-identical to the
+    sequential path.  Memory semantics shift from the sequential path's
+    per-row-group AllocTracker raise to per-CHUNK bomb enforcement plus a
+    blocking in-flight cap over the same ``max_memory`` (backpressure
+    instead of an error when only concurrency, not any single chunk,
+    exceeds the budget).  ``pipeline_stats()`` exposes per-stage timing.
     """
 
     def __init__(
@@ -46,6 +58,7 @@ class FileReader:
         max_memory: int = 0,
         metadata: Optional[FileMetaData] = None,
         row_filter=None,
+        prefetch: int = 0,
     ):
         if isinstance(source, (str, os.PathLike)):
             self._f: BinaryIO = open(source, "rb")
@@ -65,6 +78,9 @@ class FileReader:
                 self.set_selected_columns(columns)
             self.validate_crc = validate_crc
             self.alloc = AllocTracker(max_memory)
+            self.prefetch = int(prefetch)
+            self._pipe_stats = PipelineStats(prefetch=self.prefetch,
+                                             budget_bytes=int(max_memory))
             self._current_row_group = 0
             self._preloaded: Optional[dict[str, ColumnData]] = None
             # statistics-based row-group pruning (predicate pushdown): groups
@@ -162,14 +178,128 @@ class FileReader:
 
     # -- columnar reads --------------------------------------------------------
 
-    def read_row_group(self, index: int) -> dict[str, ColumnData]:
+    def pipeline_stats(self) -> PipelineStats:
+        """Per-stage timing of the last/current prefetch pipeline
+        (io / decompress / stall / peak in-flight); zeros when ``prefetch``
+        was never used.  See pipeline.PipelineStats.overlap_efficiency."""
+        return self._pipe_stats
+
+    def _decode_row_groups(self, indices, k: int):
+        """Chunk-granular overlapped decode (the prefetch pipeline).
+
+        Work items are (row group, chunk) pairs FLATTENED across ``indices``
+        — row-group lookahead falls out of the flattening: the K-deep window
+        spans group boundaries, so worker threads keep decoding the next
+        group's chunks while a finished group is assembled and yielded.
+        Yields ``(index, {dotted_path: ColumnData})`` in ``indices`` order;
+        per-group missing-column checks match read_row_group exactly.
+
+        Memory: every worker chunk gets its own AllocTracker(max_memory)
+        (the per-chunk decompression-bomb guard), and cross-chunk in-flight
+        bytes are bounded by an InFlightBudget over the same budget —
+        backpressure in the submitting thread, never a raise for a file the
+        sequential path would accept chunk by chunk.
+
+        device_reader._chunk_feed mirrors this flatten/regroup protocol
+        (different payloads); a change here should be checked against it.
+        """
+        stats = PipelineStats(prefetch=k, budget_bytes=self.alloc.max_size)
+        self._pipe_stats = stats
+        budget = InFlightBudget(self.alloc.max_size)
+        sr = SharedReader(self._f)
+        pending: dict[int, dict] = {}  # rg index -> regrouping slot
+
+        def gen_items():
+            # runs in the CONSUMER thread as the window refills, so the
+            # schema-selection snapshot keeps sequential semantics
+            for i in indices:
+                rg = self.metadata.row_groups[i]
+                by_path = {l.path: l for l in self.schema.selected_leaves()}
+                items = []
+                for chunk in rg.columns or []:
+                    md = chunk.meta_data
+                    if md is None or md.path_in_schema is None:
+                        raise ParquetError("column chunk missing metadata/path")
+                    path = tuple(md.path_in_schema)
+                    leaf = by_path.get(path)
+                    if leaf is None:
+                        continue  # unselected: never read its bytes
+                    items.append((i, path, chunk, leaf))
+                pending[i] = {
+                    "expect": {".".join(p) for p in by_path},
+                    "todo": max(len(items), 1),
+                    "out": {},
+                }
+                if not items:
+                    # sentinel so an empty group still finalizes in order
+                    items.append((i, None, None, None))
+                yield from items
+
+        def chunk_cost(item):
+            _i, _path, chunk, _leaf = item
+            if chunk is None:
+                return 0
+            md = chunk.meta_data
+            comp = max(md.total_compressed_size or 0, 0)
+            return comp + max(md.total_uncompressed_size or 0, comp)
+
+        def decode_item(item):
+            i, path, chunk, leaf = item
+            if chunk is None:
+                return i, None, None
+            md, offset = validate_chunk_meta(chunk, leaf)
+            alloc = AllocTracker(self.alloc.max_size)
+            alloc.register(md.total_compressed_size)
+            with stats.timed("io"):
+                buf = sr.pread(offset, md.total_compressed_size)
+            if len(buf) != md.total_compressed_size:
+                raise ParquetError(
+                    f"chunk truncated: wanted {md.total_compressed_size} "
+                    f"bytes at {offset}, got {len(buf)}"
+                )
+            with stats.timed("decompress"):
+                dec = ChunkDecoder(leaf, validate_crc=self.validate_crc,
+                                   alloc=alloc)
+                cd = dec.decode(buf, md.codec, md.num_values)
+            stats.count_chunk()
+            return i, ".".join(path), cd
+
+        stats.touch_wall()
+        for i, name, cd in prefetch_map(gen_items(), decode_item, k,
+                                        budget=budget, cost=chunk_cost,
+                                        stats=stats):
+            slot = pending[i]
+            if name is not None:
+                slot["out"][name] = cd
+            slot["todo"] -= 1
+            if slot["todo"] == 0:
+                missing = slot["expect"] - set(slot["out"])
+                if missing:
+                    raise ParquetError(
+                        f"row group {i} missing columns {sorted(missing)}"
+                    )
+                del pending[i]
+                stats.count_row_group()
+                stats.note_peak(budget)
+                stats.touch_wall()
+                yield i, slot["out"]
+        stats.touch_wall()
+
+    def read_row_group(self, index: int,
+                       prefetch: Optional[int] = None) -> dict[str, ColumnData]:
         """Decode all selected column chunks of one row group.
 
         Returns {dotted_column_path: ColumnData}.  This is the TPU work unit:
-        each chunk is one contiguous IO + one batch decode.
+        each chunk is one contiguous IO + one batch decode.  With
+        ``prefetch`` > 0 (argument, else the reader's setting) the group's
+        chunks decode concurrently on the pipeline pool.
         """
         if not 0 <= index < self.num_row_groups:
             raise IndexError(f"row group {index} of {self.num_row_groups}")
+        k = self.prefetch if prefetch is None else int(prefetch)
+        if k > 0:
+            for _i, out in self._decode_row_groups([index], k):
+                return out
         rg = self.metadata.row_groups[index]
         self.alloc.reset()
         leaves = self.schema.selected_leaves()
@@ -192,15 +322,23 @@ class FileReader:
             raise ParquetError(f"row group {index} missing columns {sorted(missing)}")
         return out
 
-    def iter_row_groups(self):
-        for i in range(self.num_row_groups):
-            if not self.row_group_selected(i):
-                continue  # pruned: its bytes are never read
-            yield self.read_row_group(i)
+    def iter_row_groups(self, prefetch: Optional[int] = None):
+        k = self.prefetch if prefetch is None else int(prefetch)
+        selected = [i for i in range(self.num_row_groups)
+                    if self.row_group_selected(i)]  # pruned: bytes never read
+        if k > 0:
+            for _i, out in self._decode_row_groups(selected, k):
+                yield out
+            return
+        for i in selected:
+            yield self.read_row_group(i, prefetch=0)
 
-    def read_all(self) -> dict[str, ColumnData]:
-        """Concatenate all row groups' columns (convenience for small files)."""
-        groups = list(self.iter_row_groups())
+    def read_all(self, prefetch: Optional[int] = None) -> dict[str, ColumnData]:
+        """Concatenate all row groups' columns (convenience for small files).
+
+        ``prefetch`` overrides the reader's pipeline depth for this call
+        (0 forces the sequential path, K > 0 the overlapped one)."""
+        groups = list(self.iter_row_groups(prefetch=prefetch))
         if not groups:
             return {
                 ".".join(l.path): ColumnData(
