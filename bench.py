@@ -1,7 +1,15 @@
 """Benchmark: device (TPU) columnar decode vs host (NumPy) columnar decode.
 
-Prints exactly ONE JSON line on stdout:
-    {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N, "configs": {...}}
+Output contract (round-6 artifact plumbing — the r04/r05 one-line JSON
+overflowed the driver's 2000-char tail window, leaving the binding record
+unparseable):
+
+- FULL results are written as indented multi-line JSON to the artifact file
+  (``BENCH_JSON`` env, default ``BENCH_LOCAL_latest.json`` next to this
+  script);
+- stdout's LAST line is ONE compact JSON summary, guaranteed < 2000 chars:
+    {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N,
+     "artifact": ..., "configs": {<scalar highlights only>}}
 Everything else goes to stderr.
 
 Configs mirror BASELINE.md (sizes scaled to keep a driver run in minutes;
@@ -54,14 +62,18 @@ Sampling protocol (disclosed here and in README) — SYMMETRIC since round 5:
   artifact itself.
 
 A ``pipeline`` section (BENCH_PIPELINE=0 to skip) benches the overlapped
-chunk pipeline on the headline file: host decode at prefetch={0,4}, the
-speedup, and the per-stage counters (overlap efficiency = busy/wall) from
-``FileReader.pipeline_stats()``.
+chunk pipeline at host decode prefetch={0,4} — on the headline file AND on
+plain_int64 (the round-4 ≥0.9×-host target, re-measured against the overlap
+path) — with the per-stage counters (overlap efficiency = busy/wall) from
+``FileReader.pipeline_stats()``.  A ``loader`` section (BENCH_LOADER=0 to
+skip) measures one shuffled ``data.DataLoader`` epoch over the headline
+file's fixed-width columns at prefetch={0,4} vs a raw ``scan_files`` pass.
 
 Env knobs: BENCH_SCALE (default 1.0), BENCH_DEVICE_REPS (default 4),
 BENCH_BASELINE_REPS (default: one below device reps, capped at 3),
 BENCH_CONFIGS (comma list, default "4,2,3,1,5" — headline banked first),
-BENCH_RESAMPLE (default 2 — extra sampling windows over all configs).
+BENCH_RESAMPLE (default 2 — extra sampling windows over all configs),
+BENCH_JSON (artifact path).
 """
 
 import json
@@ -183,7 +195,7 @@ def gen_dict_strings(path, rows):
             w.write_columns({"s": _strings_col(rng, n, pool)})
 
 
-def gen_lineitem16(path, rows):
+def gen_lineitem16(path, rows, rows_per_group=1_000_000):
     import numpy as np
     from tpu_parquet.format import (
         ConvertedType, Encoding, FieldRepetitionType as FRT, LogicalType,
@@ -225,8 +237,8 @@ def gen_lineitem16(path, rows):
                           "l_receiptdate": Encoding.DELTA_BINARY_PACKED},
     ) as w:
         key = 0
-        for lo in range(0, rows, 1_000_000):
-            n = min(1_000_000, rows - lo)
+        for lo in range(0, rows, rows_per_group):
+            n = min(rows_per_group, rows - lo)
             keys = key + np.cumsum(rng.integers(1, 5, n))
             key = int(keys[-1])
             # l_comment: free-text-ish plain strings (the host-bound column)
@@ -250,6 +262,13 @@ def gen_lineitem16(path, rows):
                 "l_shipmode": _strings_col(rng, n, modes),
                 "l_comment": _strings_col(rng, n, comment_pool),
             })
+            # one group per chunk.  At the default 1M-row chunking this is
+            # byte-identical to the old size-trigger behavior (each chunk is
+            # ~130MB >= the 128MB threshold, and only the final chunk can be
+            # smaller — close() flushed it alone either way), so cached
+            # /tmp files from earlier rounds stay comparable; the explicit
+            # flush exists for the loader bench's smaller rows_per_group.
+            w.flush_row_group()
 
 
 def gen_nested(path, rows):
@@ -572,6 +591,104 @@ def bench_pipeline(path, rows, reps=3):
     return out
 
 
+def bench_loader(path, rows, reps=None):
+    """Training-input loader bench (ISSUE 2 acceptance gate): one shuffled
+    epoch of ``data.DataLoader`` over the lineitem16 fixed-width columns at
+    prefetch={0,4} — same files, same shuffle seed, only the overlap depth
+    differs — plus a raw ``scan_files`` pass over the same columns as the
+    no-shuffle/no-batch reference.  Reps INTERLEAVE the two depths (this
+    VM's weather — page-cache drops, CPU steal — lasts seconds to minutes,
+    so alternating reps exposes both sides to the same conditions; own
+    back-to-back trials have recorded the same config at 3.0s and 6.8s)."""
+    import jax
+    from tpu_parquet.data import DataLoader
+    from tpu_parquet.device_reader import scan_files
+
+    if reps is None:
+        reps = int(os.environ.get("BENCH_LOADER_REPS", "4"))
+    reps = max(reps, 1)  # 0 reps would leave the medians/stats unpopulated
+    # (skip the section with BENCH_LOADER=0 instead)
+    cols = ["l_orderkey", "l_partkey", "l_suppkey", "l_linenumber",
+            "l_quantity", "l_extendedprice", "l_discount", "l_tax",
+            "l_shipdate", "l_commitdate", "l_receiptdate"]
+    # dedicated file with TRAINING-shaped row groups (~250k rows each, vs the
+    # decode bench's single-transfer-optimized 1M-row groups): the loader
+    # pipelines at unit granularity, and a 5-unit file spends 15% of its
+    # wall on the first unit's cold decode that lookahead can never hide
+    # layout-stamped name: a cached file from a build with different group
+    # sizing can never be silently reused
+    lpath = f"{path}.loader_rg250k"
+    if not os.path.exists(lpath):
+        t0 = time.perf_counter()
+        gen_lineitem16(lpath, rows, rows_per_group=250_000)
+        log(f"generated {lpath} in {time.perf_counter()-t0:.1f}s")
+    path = lpath
+    out = {"rows": rows, "batch_size": 8192, "columns": len(cols),
+           "rows_per_group": 250_000}
+    for p in _bench_paths(path):  # warm the page cache off the timed path
+        with open(p, "rb", buffering=0) as f:
+            while f.read(32 << 20):
+                pass
+    warm = DataLoader(_bench_paths(path), 8192, columns=cols, shuffle=True,
+                      seed=11, prefetch=2, shuffle_window=1 << 16,
+                      drop_remainder=True)
+    for _ in warm:  # one untimed epoch: allocator/thread warmup off both sides
+        pass
+    times = {0: [], 4: []}
+    last_stats = None
+    emitted = 0
+    for i in range(reps):
+        for k in (0, 4):
+            loader = DataLoader(_bench_paths(path), 8192, columns=cols,
+                                shuffle=True, seed=11, prefetch=k,
+                                shuffle_window=1 << 16, drop_remainder=True)
+            t0 = time.perf_counter()
+            emitted = 0
+            for batch in loader:
+                emitted += len(batch["l_orderkey"])
+            dt = time.perf_counter() - t0
+            log(f"  loader prefetch={k} rep {i}: {dt:.3f}s "
+                f"({emitted/dt/1e6:.2f} M rows/s)")
+            times[k].append(dt)
+            if k:
+                last_stats = loader.stats().as_dict()
+    # MEDIAN of the interleaved reps on BOTH sides (the repo's symmetric-
+    # estimator rule): best-of would hand the ratio to whichever depth got
+    # the one quiet window on this weather-prone VM
+    for k in (0, 4):
+        out[f"prefetch{k}_s"] = round(_median(times[k]), 3)
+        out[f"prefetch{k}_reps_s"] = [round(t, 3) for t in times[k]]
+        out[f"prefetch{k}_rows_per_sec"] = round(emitted / _median(times[k]), 1)
+    out["decode_wait_seconds"] = last_stats["decode_wait_seconds"]
+    out["window_peak_rows"] = last_stats["window_peak_rows"]
+    out["rows_emitted"] = emitted
+    out["loader_speedup"] = round(out["prefetch0_s"] / out["prefetch4_s"], 3)
+    # raw device scan of the identical columns: what the loader's shuffle +
+    # batch assembly + host residency cost against the bare multi-file scan.
+    # MEDIAN of reps, like the loader sides above — the symmetric-estimator
+    # rule applies to this ratio too.
+    try:
+        scans = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            arrs = []
+            for colsd in scan_files(_bench_paths(path), columns=cols):
+                arrs.extend(v.values for v in colsd.values()
+                            if v.values is not None)
+            jax.block_until_ready(arrs)
+            scans.append(time.perf_counter() - t0)
+        out["scan_files_reps_s"] = [round(t, 3) for t in scans]
+        out["scan_files_rows_per_sec"] = round(rows / _median(scans), 1)
+        out["loader_vs_scan"] = round(
+            (emitted / _median(times[4]))
+            / (rows / _median(scans)), 3)
+    except Exception as e:  # noqa: BLE001 — reference only
+        log(f"loader scan reference FAILED: {e!r}")
+    log(f"loader: {out['loader_speedup']:.2f}x at prefetch=4 "
+        f"({out['prefetch4_rows_per_sec']/1e6:.2f} M rows/s shuffled)")
+    return out
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache (one implementation: the library's —
     device_reader._enable_compile_cache defers to an app-configured dir /
@@ -621,6 +738,62 @@ def _pallas_microbench(width=13, n=8_000_000):
         "pallas_mvals_per_sec": round(n / t_pl / 1e6, 1),
         "pallas_speedup": round(t_xla / t_pl, 2),
     }
+
+
+# per-config scalar keys worth repeating on the compact stdout line; rep
+# lists, window arrays, and sampling metadata live only in the artifact file
+_SUMMARY_KEYS = (
+    "rows", "device_rows_per_sec", "device_mb_per_sec", "device_vs_host",
+    "device_vs_pyarrow", "device_vs_host_pipeline", "host_rows_per_sec",
+    "pyarrow_rows_per_sec", "pipeline_speedup", "prefetch0_rows_per_sec",
+    "prefetch4_rows_per_sec", "overlap_efficiency", "loader_speedup",
+    "loader_vs_scan", "scan_files_rows_per_sec", "device_vs_host_prefetch4",
+    "pallas_speedup",
+)
+_SUMMARY_LIMIT = 1990  # < the driver's 2000-char tail window, with margin
+
+
+def emit_results(record):
+    """VERDICT r5 blocker fix: the full results go to a BENCH artifact file
+    as INDENTED multi-line JSON, and stdout's LAST line is a compact
+    single-line summary guaranteed under the driver's 2000-char tail window
+    (the r04/r05 one-line JSON overflowed it: ``parsed: null`` two rounds
+    running).  ``BENCH_JSON`` overrides the artifact path."""
+    out_path = os.environ.get("BENCH_JSON") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_LOCAL_latest.json")
+    artifact_name = os.path.basename(out_path)
+    try:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+            f.write("\n")
+        log(f"full results: {out_path}")
+    except OSError as e:
+        log(f"artifact write FAILED ({out_path}): {e!r}")
+        # never point the summary at a stale file from an earlier round
+        artifact_name = None
+    compact = {k: record[k] for k in ("metric", "value", "unit",
+                                      "vs_baseline")}
+    compact["artifact"] = artifact_name
+    cfgs = {}
+    for name, r in record.get("configs", {}).items():
+        if not isinstance(r, dict):
+            continue
+        c = {k: r[k] for k in _SUMMARY_KEYS
+             if isinstance(r.get(k), (int, float))}
+        if c:
+            cfgs[name] = c
+    compact["configs"] = cfgs
+    line = json.dumps(compact, separators=(",", ":"))
+    while len(line) > _SUMMARY_LIMIT and cfgs:
+        # shed the bulkiest config until the line fits; the artifact file
+        # keeps everything
+        bulkiest = max(cfgs, key=lambda n: len(json.dumps(cfgs[n])))
+        del cfgs[bulkiest]
+        line = json.dumps(compact, separators=(",", ":"))
+    if len(line) > _SUMMARY_LIMIT:
+        compact.pop("configs", None)
+        line = json.dumps(compact, separators=(",", ":"))
+    print(line)
 
 
 def main():
@@ -804,22 +977,52 @@ def main():
             + (f", {vs:.1f}x host" if vs is not None else "")
             + (f", {pipe:.1f}x host+upload pipeline" if pipe is not None else ""))
 
+    def _config_file(cfg_key):
+        """The config's bench file (reusing the measured path, else
+        generating); returns (path, rows)."""
+        name, gen, base_rows = CONFIGS[cfg_key]
+        entry = dev_times.get(name)
+        if entry is not None:
+            _w, ppath, prows, _k, _mb = entry
+            return ppath, prows
+        prows = int(base_rows * SCALE)
+        ppath = f"/tmp/tpq_bench_{name}_{prows}.parquet"
+        if not os.path.exists(ppath):
+            gen(ppath, prows)
+        return ppath, prows
+
     # Overlapped chunk pipeline: host decode prefetch={0,4} on the headline
-    # file (ISSUE 1 acceptance: >= 1.3x sequential).  Skip: BENCH_PIPELINE=0.
+    # file (ISSUE 1 acceptance: >= 1.3x sequential) AND on plain_int64 (the
+    # round-4 ≥0.9x-host target, re-measured against the overlap path —
+    # ISSUE 2 satellite).  Skip: BENCH_PIPELINE=0.
     if os.environ.get("BENCH_PIPELINE", "1") != "0" and not over_budget():
+        for cfg_key, out_name in (("4", "pipeline"),
+                                  ("1", "pipeline_plain_int64")):
+            try:
+                ppath, prows = _config_file(cfg_key)
+                results[out_name] = bench_pipeline(ppath, prows)
+                if cfg_key == "1":
+                    dev = results.get("plain_int64", {}).get(
+                        "device_rows_per_sec")
+                    if dev:
+                        # the round-4 target ratio, with the overlapped host
+                        # decode as the denominator
+                        results[out_name]["device_vs_host_prefetch4"] = round(
+                            dev / results[out_name]["prefetch4_rows_per_sec"],
+                            3)
+            except Exception as e:  # noqa: BLE001
+                log(f"pipeline bench ({out_name}) FAILED: {e!r}")
+            if over_budget():
+                break
+
+    # Training-input loader: shuffled-epoch throughput at prefetch={0,4} on
+    # the headline file's fixed-width columns.  Skip: BENCH_LOADER=0.
+    if os.environ.get("BENCH_LOADER", "1") != "0" and not over_budget():
         try:
-            li = dev_times.get("lineitem16")
-            if li is not None:
-                _w, ppath, prows, _k, _mb = li
-            else:
-                name, gen, base_rows = CONFIGS["4"]
-                prows = int(base_rows * SCALE)
-                ppath = f"/tmp/tpq_bench_{name}_{prows}.parquet"
-                if not os.path.exists(ppath):
-                    gen(ppath, prows)
-            results["pipeline"] = bench_pipeline(ppath, prows)
+            ppath, prows = _config_file("4")
+            results["loader"] = bench_loader(ppath, prows)
         except Exception as e:  # noqa: BLE001
-            log(f"pipeline bench FAILED: {e!r}")
+            log(f"loader bench FAILED: {e!r}")
 
     # Writer throughput (host encode; ~10s).  Skip with BENCH_WRITES=0.
     if os.environ.get("BENCH_WRITES", "1") != "0" and not over_budget():
@@ -850,18 +1053,18 @@ def main():
         decode_results = {k: v for k, v in results.items()
                           if "device_rows_per_sec" in v}
         if not decode_results:
-            print(json.dumps({"metric": "no_valid_configs", "value": 0.0,
-                              "unit": "rows/s", "vs_baseline": 0.0,
-                              "configs": results}))
+            emit_results({"metric": "no_valid_configs", "value": 0.0,
+                          "unit": "rows/s", "vs_baseline": 0.0,
+                          "configs": results})
             sys.exit(1)
         headline_name, headline = next(iter(decode_results.items()))
-    print(json.dumps({
+    emit_results({
         "metric": f"{headline_name}_decode_rows_per_sec_device",
         "value": headline["device_rows_per_sec"],
         "unit": "rows/s",
         "vs_baseline": headline.get("device_vs_host", 0.0),
         "configs": results,
-    }))
+    })
 
 
 if __name__ == "__main__":

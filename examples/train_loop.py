@@ -1,0 +1,110 @@
+"""Training loop over the checkpointable input pipeline.
+
+The product story of tpu_parquet.data in one file: a parquet dataset becomes
+shuffled, sharded, resumable device batches feeding a jitted SGD step — fixed
+shapes, one compile — and the input position checkpoints alongside the model
+(save mid-epoch, restore, and the remaining batches are bit-identical to the
+uninterrupted run).
+
+    python examples/train_loop.py [file.parquet]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tpu_parquet.data import DataLoader
+
+BATCH = 1024
+FEATURES = [f"f{j}" for j in range(8)]
+
+
+def write_demo(path: str) -> None:
+    """A linear-regression dataset: 8 float features, 1 label, 6 row groups."""
+    from tpu_parquet.format import FieldRepetitionType as FRT, Type
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.writer import FileWriter
+
+    rng = np.random.default_rng(0)
+    schema = build_schema(
+        [data_column(f, Type.FLOAT, FRT.REQUIRED) for f in FEATURES]
+        + [data_column("label", Type.FLOAT, FRT.REQUIRED)]
+    )
+    w_true = np.arange(1, 9, dtype=np.float32)
+    with FileWriter(path, schema) as w:
+        for _ in range(6):
+            n = int(rng.integers(4_000, 7_000))
+            x = rng.normal(size=(n, 8)).astype(np.float32)
+            y = x @ w_true + 0.01 * rng.normal(size=n).astype(np.float32)
+            w.write_columns({**{f: x[:, j] for j, f in enumerate(FEATURES)},
+                             "label": y})
+            w.flush_row_group()
+
+
+@jax.jit
+def train_step(w, feats, label, mask):
+    """One masked SGD step: the pad rows of the epoch's ragged tail carry
+    mask=False and contribute zero gradient."""
+
+    def loss(w):
+        err = (feats @ w - label) * mask
+        return jnp.sum(err * err) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    return w - 0.1 * jax.grad(loss)(w)
+
+
+def run_epoch(w, loader):
+    for batch in loader:  # device-resident, fixed shapes: one executable
+        feats = jnp.stack([batch[f] for f in FEATURES], axis=1)
+        w = train_step(w, feats, batch["label"],
+                       batch["mask"].astype(jnp.float32))
+    return w
+
+
+def main(path: str) -> None:
+    loader = DataLoader(
+        path, BATCH,
+        columns=FEATURES + ["label"],
+        shuffle=True, seed=42,
+        prefetch=2,          # decode overlaps the train step's host time
+        to_device=True,      # batches land as jax arrays
+        # on a multi-host job: shard=tpu_parquet.parallel.process_shard()
+    )
+    w = jnp.zeros(8, dtype=jnp.float32)
+    w = run_epoch(w, loader)  # epoch 0
+
+    # mid-epoch checkpoint: save the input position with the model, restore
+    # into a FRESH loader, and training continues exactly where it left off
+    it = iter(loader)
+    for _ in range(loader.num_batches // 2):
+        batch = next(it)
+        feats = jnp.stack([batch[f] for f in FEATURES], axis=1)
+        w = train_step(w, feats, batch["label"],
+                       batch["mask"].astype(jnp.float32))
+    it.close()
+    blob = loader.state_blob()  # ~300 bytes, versioned, validated on load
+    print(f"checkpointed at epoch {loader.epoch}, "
+          f"{loader.state()['rows_taken']} rows in ({len(blob)} B blob)")
+
+    resumed = DataLoader(path, BATCH, columns=FEATURES + ["label"],
+                         shuffle=True, prefetch=2, to_device=True,
+                         ).restore(blob)
+    w = run_epoch(w, resumed)  # the rest of epoch 1
+
+    print(f"learned weights: {np.round(np.asarray(w), 2)}")
+    print(f"loader stats: {resumed.stats().as_dict()}")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 2:
+        main(sys.argv[1])
+    else:
+        demo = "/tmp/train_demo.parquet"
+        write_demo(demo)
+        main(demo)

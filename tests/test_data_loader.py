@@ -1,0 +1,334 @@
+"""Training-input loader test family (ISSUE 2).
+
+The loader's whole contract is determinism: the shuffled order is a pure
+function of (seed, epoch, cursor) — so prefetch depth must not change it,
+shards must partition it, and save→restore must re-enter it bit-identically
+at any batch boundary.  Every test here asserts one face of that contract on
+a small multi-file, multi-row-group, ragged-tailed dataset.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_parquet.data import DataLoader, pack_state, unpack_state
+from tpu_parquet.data.checkpoint import MAGIC, STATE_VERSION
+from tpu_parquet.errors import CheckpointError, ParquetError
+
+BS = 256
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    """Two files, ten row groups of uneven sizes, three dtypes, a ragged
+    epoch tail (total % BS != 0), plus a string column to project out."""
+    from tpu_parquet.column import ByteArrayData, ColumnData
+    from tpu_parquet.format import (
+        CompressionCodec, FieldRepetitionType as FRT, Type,
+    )
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.writer import FileWriter
+
+    d = tmp_path_factory.mktemp("loader")
+    rng = np.random.default_rng(0)
+    schema = build_schema([
+        data_column("a", Type.INT64, FRT.REQUIRED),
+        data_column("b", Type.DOUBLE, FRT.REQUIRED),
+        data_column("c", Type.INT32, FRT.REQUIRED),
+        data_column("s", Type.BYTE_ARRAY, FRT.REQUIRED),
+    ])
+    paths, sizes = [], []
+    for fi, groups in enumerate([(900, 1100, 500, 1000, 700),
+                                 (1000, 300, 1300, 800, 411)]):
+        p = str(d / f"part{fi}.parquet")
+        with FileWriter(p, schema, codec=CompressionCodec.SNAPPY) as w:
+            for n in groups:
+                strs = [b"s%d" % i for i in range(n)]
+                w.write_columns({
+                    "a": rng.integers(0, 1 << 50, n),
+                    "b": rng.uniform(-1, 1, n),
+                    "c": rng.integers(0, 1 << 20, n).astype(np.int32),
+                    "s": ColumnData(values=ByteArrayData.from_list(strs)),
+                })
+                w.flush_row_group()
+            sizes.extend(groups)
+        paths.append(p)
+    return paths, sum(sizes)
+
+
+COLS = ["a", "b", "c"]
+
+
+def _loader(paths, **kw):
+    kw.setdefault("columns", COLS)
+    kw.setdefault("seed", 3)
+    kw.setdefault("shuffle", True)
+    kw.setdefault("shuffle_window", 1000)
+    return DataLoader(paths, BS, **kw)
+
+
+def _assert_batches_equal(got, want):
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert set(g) == set(w)
+        for c in g:
+            assert np.array_equal(np.asarray(g[c]), np.asarray(w[c])), c
+
+
+def _take(loader, n):
+    """First n batches of the current epoch, closing the iterator cleanly."""
+    it = iter(loader)
+    out = []
+    for batch in it:
+        out.append(batch)
+        if len(out) == n:
+            it.close()
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+
+def test_prefetch_depth_never_changes_the_stream(dataset):
+    paths, _total = dataset
+    runs = {k: list(iter(_loader(paths, prefetch=k))) for k in (0, 1, 4)}
+    _assert_batches_equal(runs[1], runs[0])
+    _assert_batches_equal(runs[4], runs[0])
+
+
+def test_same_seed_same_order_fresh_process_objects(dataset):
+    paths, _ = dataset
+    _assert_batches_equal(list(iter(_loader(paths))),
+                          list(iter(_loader(paths))))
+
+
+def test_seed_and_epoch_reshuffle(dataset):
+    paths, total = dataset
+    base = np.concatenate([b["a"][b["mask"]] for b in iter(_loader(paths))])
+    other_seed = np.concatenate(
+        [b["a"][b["mask"]] for b in iter(_loader(paths, seed=4))])
+    l2 = _loader(paths)
+    list(iter(l2))  # epoch 0
+    epoch1 = np.concatenate([b["a"][b["mask"]] for b in iter(l2)])
+    assert not np.array_equal(base, other_seed)
+    assert not np.array_equal(base, epoch1)
+    # same multiset every time: a shuffle, never a resample
+    assert np.array_equal(np.sort(base), np.sort(other_seed))
+    assert np.array_equal(np.sort(base), np.sort(epoch1))
+    assert len(base) == total
+
+
+def test_unshuffled_order_is_file_order(dataset):
+    from tpu_parquet.reader import FileReader
+
+    paths, total = dataset
+    got = np.concatenate([
+        b["a"][b["mask"]]
+        for b in iter(_loader(paths, shuffle=False))
+    ])
+    want = np.concatenate([
+        np.asarray(rg["a"].values)
+        for p in paths
+        for rg in FileReader(p, columns=["a"]).iter_row_groups()
+    ])
+    assert np.array_equal(got, want) and len(got) == total
+
+
+# ---------------------------------------------------------------------------
+# sharding
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n_shards", [1, 2, 8])
+def test_shard_union_equals_whole(dataset, n_shards):
+    paths, total = dataset
+    whole = np.sort(np.concatenate(
+        [b["a"][b["mask"]] for b in iter(_loader(paths))]))
+    parts = [
+        np.concatenate([b["a"][b["mask"]] for b in
+                        iter(_loader(paths, shard=(i, n_shards)))]
+                       or [np.zeros(0, dtype=np.int64)])
+        for i in range(n_shards)
+    ]
+    got = np.sort(np.concatenate(parts))
+    assert len(got) == total == len(whole)
+    assert np.array_equal(got, whole)
+
+
+def test_empty_shard_is_a_clean_noop(dataset):
+    paths, _ = dataset
+    l = _loader(paths, shard=(15, 16))  # 10 units, 16 shards: someone's empty
+    if l.num_rows == 0:
+        assert list(iter(l)) == []
+        assert l.epoch == 1  # the epoch still advances
+    else:  # LPT filled every shard: still a valid partition member
+        assert sum(b["mask"].sum() for b in iter(l)) == l.num_rows
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shard", [(0, 1), (1, 2)])
+@pytest.mark.parametrize("prefetch", [0, 1, 4])
+@pytest.mark.parametrize("cut", [1, 7, 13])
+def test_save_restore_bit_identical(dataset, prefetch, cut, shard):
+    paths, _ = dataset
+    want = list(iter(_loader(paths, prefetch=prefetch, shard=shard)))
+    l = _loader(paths, prefetch=0, shard=shard)
+    first = _take(l, cut)
+    assert len(first) == cut, "fixture too small for this cut point"
+    blob = l.state_blob()
+    resumed = _loader(paths, prefetch=prefetch, shard=shard).restore(blob)
+    rest = list(iter(resumed))
+    _assert_batches_equal(first + rest, want)
+    assert resumed.epoch == 1
+
+
+def test_restore_across_epoch_boundary(dataset):
+    paths, _ = dataset
+    ref = _loader(paths, prefetch=2)
+    want = list(ref.epochs(3))
+    l = _loader(paths, prefetch=0)
+    first = list(iter(l)) + _take(l, 5)  # 1 full epoch + 5 batches of epoch 1
+    resumed = _loader(paths, prefetch=4).restore(l.state())
+    rest = list(resumed.epochs(2))  # the remainder of epoch 1 + epoch 2
+    _assert_batches_equal(first + rest, want)
+
+
+def test_state_blob_roundtrip(dataset):
+    paths, _ = dataset
+    l = _loader(paths)
+    _take(l, 3)
+    st = l.state()
+    assert unpack_state(pack_state(st)) == st
+    assert st["rows_taken"] == 3 * BS and st["version"] == STATE_VERSION
+
+
+def test_checkpoint_rejects_garbage(dataset):
+    paths, _ = dataset
+    l = _loader(paths)
+    blob = l.state_blob()
+    for bad in (
+        b"",                                   # empty
+        b"NOPE" + blob[4:],                    # bad magic
+        blob[:-10],                            # truncated payload
+        MAGIC + (99).to_bytes(2, "big") + blob[6:],  # version bump
+        MAGIC + blob[4:6] + b"{not json",      # corrupt payload
+    ):
+        with pytest.raises(CheckpointError):
+            l.restore(bad)
+    # structurally valid but wrong pipeline: every fingerprint field refuses
+    for key, val in (("batch_size", BS + 1), ("shuffle", False),
+                     ("shuffle_window", 999), ("shard", [1, 2]),
+                     ("n_units", 11), ("total_rows", 1),
+                     ("drop_remainder", True)):
+        st = dict(l.state())
+        st[key] = val
+        if key in ("total_rows",):  # keep shard_rows <= total_rows valid
+            st["shard_rows"] = 0
+            st["rows_taken"] = 0
+        with pytest.raises(CheckpointError):
+            l.restore(st)
+    # cursor past the shard's rows
+    st = dict(l.state())
+    st["rows_taken"] = st["shard_rows"] + 1
+    with pytest.raises(CheckpointError):
+        l.restore(st)
+    # cursor off the batch grid: no state() call can produce it, so adopting
+    # it would shift every later batch by a fraction of a batch
+    st = dict(l.state())
+    st["rows_taken"] = BS + 1
+    with pytest.raises(CheckpointError):
+        l.restore(st)
+    # floats where ints belong (json round-trips them as floats)
+    st = dict(l.state())
+    st["epoch"] = 1.0
+    with pytest.raises(CheckpointError):
+        l.restore(st)
+
+
+def test_checkpoint_rejects_reordered_dataset(dataset):
+    paths, _ = dataset
+    blob = _loader(paths).state_blob()
+    # same files, same counts — different order: the dataset digest refuses
+    with pytest.raises(CheckpointError, match="dataset_digest"):
+        _loader(list(reversed(paths))).restore(blob)
+
+
+# ---------------------------------------------------------------------------
+# batch geometry
+# ---------------------------------------------------------------------------
+
+def test_ragged_tail_pads_and_masks(dataset):
+    paths, total = dataset
+    batches = list(iter(_loader(paths)))
+    assert total % BS != 0, "fixture must leave a ragged tail"
+    assert len(batches) == -(-total // BS)
+    for b in batches[:-1]:
+        assert b["mask"].all() and len(b["a"]) == BS
+    tail = batches[-1]
+    assert tail["mask"].sum() == total % BS
+    assert not tail["mask"][total % BS:].any()
+    for c in COLS:
+        assert len(tail[c]) == BS
+        assert (np.asarray(tail[c])[~tail["mask"]] == 0).all()
+
+
+def test_drop_remainder(dataset):
+    paths, total = dataset
+    batches = list(iter(_loader(paths, drop_remainder=True)))
+    assert len(batches) == total // BS
+    assert all("mask" not in b for b in batches)
+    assert all(len(b["a"]) == BS for b in batches)
+
+
+def test_mask_key_collision_and_rename(dataset):
+    paths, _ = dataset
+    with pytest.raises(ValueError):
+        DataLoader(paths, BS, columns=COLS, mask_key="a")
+    l = _loader(paths, mask_key="valid")
+    b = next(iter(l))
+    assert "valid" in b and "mask" not in b
+
+
+def test_to_device_batches(dataset):
+    import jax
+
+    paths, _ = dataset
+    host = next(iter(_loader(paths)))
+    dev = next(iter(_loader(paths, to_device=True)))
+    for c in host:
+        assert isinstance(dev[c], jax.Array)
+        assert np.array_equal(np.asarray(dev[c]), np.asarray(host[c])), c
+
+
+# ---------------------------------------------------------------------------
+# validation + observability
+# ---------------------------------------------------------------------------
+
+def test_column_validation(dataset):
+    paths, _ = dataset
+    with pytest.raises(TypeError):  # byte-array column has no static shape
+        DataLoader(paths, BS, columns=["a", "s"])
+    with pytest.raises(TypeError):  # default selection includes "s"
+        DataLoader(paths, BS)
+    with pytest.raises(ParquetError):
+        DataLoader(paths, BS, columns=["nope"])
+    with pytest.raises(ValueError):
+        DataLoader(paths, 0, columns=COLS)
+    with pytest.raises(ValueError):
+        DataLoader(paths, BS, columns=COLS, shard=(2, 2))
+
+
+def test_loader_stats(dataset):
+    paths, total = dataset
+    l = _loader(paths, prefetch=2)
+    list(iter(l))
+    st = l.stats()
+    assert st.rows == total and st.batches == -(-total // BS)
+    assert st.epochs_completed == 1 and st.padded_batches == 1
+    d = st.as_dict()
+    assert d["rows_per_sec"] > 0 and d["window_peak_rows"] >= 1000
+    assert d["pipeline"]["row_groups"] == 10  # one per decoded unit
+    assert d["pipeline"]["chunks"] == 30  # 3 selected columns per unit

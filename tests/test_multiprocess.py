@@ -81,27 +81,92 @@ print(f"proc {jax.process_index()} OK", flush=True)
 """
 
 
+_LOADER_WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["TPQ_REPO_ROOT"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=sys.argv[1],
+    num_processes=2,
+    process_id=int(sys.argv[2]),
+)
+import numpy as np
+
+from tpu_parquet.data import DataLoader
+from tpu_parquet.parallel import process_shard
+
+path = sys.argv[3]
+shard = process_shard()
+assert shard[1] == 2, shard
+
+def fresh(prefetch):
+    return DataLoader(path, 512, columns=["v"], shuffle=True, seed=21,
+                      shard=shard, shuffle_window=2048, prefetch=prefetch)
+
+# the resume contract, across a REAL process boundary: iterate, save the
+# blob, hand it to a brand-new loader (different prefetch), and the
+# continuation must be bit-identical to the uninterrupted epoch
+want = list(iter(fresh(prefetch=2)))
+l = fresh(prefetch=0)
+it = iter(l)
+first = [next(it) for _ in range(3)]
+it.close()
+blob = l.state_blob()
+rest = list(iter(fresh(prefetch=4).restore(blob)))
+got = first + rest
+assert len(got) == len(want), (len(got), len(want))
+for g, w in zip(got, want):
+    assert np.array_equal(g["v"], w["v"]) and np.array_equal(
+        g["mask"], w["mask"])
+
+mine = np.concatenate([b["v"][b["mask"]] for b in got])
+print(f"proc {shard[0]} LOADER rows={len(mine)} sum={int(mine.sum())}",
+      flush=True)
+"""
+
+
 @pytest.mark.skipif(os.environ.get("TPQ_SKIP_MULTIPROC") == "1",
                     reason="multi-process seam disabled by env")
-def test_two_process_global_column(tmp_path):
+def test_two_process_loader_resume(tmp_path):
+    """DataLoader sharding + mid-epoch resume across two OS processes joined
+    by jax.distributed: each process derives its shard from
+    ``parallel.process_shard()``, resumes from a state blob bit-identically,
+    and the parent checks the two shards partition the dataset exactly."""
     from tpu_parquet.format import FieldRepetitionType as FRT, Type
     from tpu_parquet.schema.core import build_schema, data_column
     from tpu_parquet.writer import FileWriter
 
-    p = str(tmp_path / "mp.parquet")
-    n = 200_000
-    rng = np.random.default_rng(5)
+    p = str(tmp_path / "mp_loader.parquet")
+    n = 50_000
+    rng = np.random.default_rng(9)
     vals = rng.integers(0, 1 << 40, n)
     schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
-    with FileWriter(p, schema, codec=1, row_group_size=1 << 19) as w:
-        w.write_columns({"v": vals})
+    splits = [0, 9000, 17000, 23000, 31000, 38000, 44000, n]
+    with FileWriter(p, schema, codec=1) as w:
+        for lo, hi in zip(splits, splits[1:]):
+            w.write_columns({"v": vals[lo:hi]})
+            w.flush_row_group()  # several uneven units: both shards get work
 
+    outs = _run_pair(tmp_path, _LOADER_WORKER, p)
+    rows = sums = 0
+    for i, out in enumerate(outs):
+        assert f"proc {i} LOADER" in out, out[-4000:]
+        tail = out[out.index(f"proc {i} LOADER"):].split()
+        rows += int(tail[3].split("=")[1])
+        sums += int(tail[4].split("=")[1])
+    assert rows == n
+    assert sums == int(vals.sum())
+
+
+def _run_pair(tmp_path, worker_src, path):
+    """Spawn two coordinated worker processes; returns their outputs."""
     with socket.socket() as s:
         s.bind(("localhost", 0))
         coord = f"localhost:{s.getsockname()[1]}"
     script = str(tmp_path / "worker.py")
     with open(script, "w") as f:
-        f.write(_WORKER)
+        f.write(worker_src)
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["JAX_PLATFORMS"] = "cpu"
@@ -110,7 +175,7 @@ def test_two_process_global_column(tmp_path):
     env.pop("JAX_NUM_PROCESSES", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, script, coord, str(i), p],
+            [sys.executable, script, coord, str(i), path],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         )
@@ -127,4 +192,24 @@ def test_two_process_global_column(tmp_path):
         outs.append(out)
     for i, (pr, out) in enumerate(zip(procs, outs)):
         assert pr.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
+    return outs
+
+
+@pytest.mark.skipif(os.environ.get("TPQ_SKIP_MULTIPROC") == "1",
+                    reason="multi-process seam disabled by env")
+def test_two_process_global_column(tmp_path):
+    from tpu_parquet.format import FieldRepetitionType as FRT, Type
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.writer import FileWriter
+
+    p = str(tmp_path / "mp.parquet")
+    n = 200_000
+    rng = np.random.default_rng(5)
+    vals = rng.integers(0, 1 << 40, n)
+    schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
+    with FileWriter(p, schema, codec=1, row_group_size=1 << 19) as w:
+        w.write_columns({"v": vals})
+
+    outs = _run_pair(tmp_path, _WORKER, p)
+    for i, out in enumerate(outs):
         assert f"proc {i} OK" in out, out[-4000:]

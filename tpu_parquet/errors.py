@@ -10,3 +10,14 @@ callers — and the fuzz harness's crash oracle — catch exactly one type.
 
 class ParquetError(ValueError):
     """Malformed parquet input."""
+
+
+class CheckpointError(ParquetError):
+    """Malformed, incompatible, or version-mismatched loader checkpoint state.
+
+    Raised by ``tpu_parquet.data.checkpoint`` for any state blob that cannot
+    be adopted safely — truncation, bad magic, unknown version, type/range
+    violations, and dataset-fingerprint mismatches all land here rather than
+    silently mis-seeking the loader.  Rooted at ParquetError so the fuzz
+    harness's single-type crash oracle covers the checkpoint surface too.
+    """

@@ -390,6 +390,73 @@ def fuzz_narrow(data: bytes) -> None:
             raise AssertionError(f"narrow roundtrip diverges (w{width}, k={k})")
 
 
+_FUZZ_LOADER = None
+
+
+def _loader_for_fuzz():
+    """A tiny two-row-group DataLoader over a temp file, built once.
+
+    The restore surface is pure cursor math, so one canned loader covers it;
+    mutated states that survive unpack mostly die on the config fingerprint,
+    and the few that are genuinely compatible drive a real one-batch pull.
+    """
+    global _FUZZ_LOADER
+    if _FUZZ_LOADER is None:
+        import tempfile
+
+        from .data import DataLoader
+        from .format import CompressionCodec, FieldRepetitionType as FRT, Type
+        from .schema.core import build_schema, data_column
+        from .writer import FileWriter
+
+        path = os.path.join(tempfile.mkdtemp(prefix="tpq_fuzz_loader_"),
+                            "tiny.parquet")
+        schema = build_schema([data_column("v", Type.INT64, FRT.REQUIRED)])
+        rng = np.random.default_rng(0)
+        with FileWriter(path, schema,
+                        codec=CompressionCodec.UNCOMPRESSED) as w:
+            for _ in range(2):
+                w.write_columns({"v": rng.integers(0, 1 << 30, 60)})
+                w.flush_row_group()
+        _FUZZ_LOADER = DataLoader(path, 16, shuffle=True, seed=7,
+                                  shuffle_window=32)
+    return _FUZZ_LOADER
+
+
+def fuzz_loader_state(data: bytes) -> None:
+    """Checkpoint-blob surface (data/checkpoint.py): ANY bytes must either
+    unpack+restore cleanly or raise a tpu_parquet.errors type — truncated,
+    bit-flipped, and version-bumped blobs must never crash or silently
+    mis-seek the loader."""
+    _force_cpu_jax()  # DataLoader's shard planning imports jax
+    from .data import checkpoint as ck
+
+    try:
+        st = ck.unpack_state(data)
+    except ParquetError:
+        return
+    # accepted: the state must round-trip the pack/unpack pair exactly
+    st2 = ck.unpack_state(ck.pack_state(st))
+    if st2 != st:
+        raise AssertionError(f"state round-trip diverges: {st} != {st2}")
+    loader = _loader_for_fuzz()
+    pristine = loader.state()  # FULL reset below, seed included: a seed
+    # adopted from one input must never leak into the next input's run, or
+    # corpus replays of a single crasher stop reproducing
+    try:
+        loader.restore(st)
+    except ParquetError:
+        return
+    try:
+        # a state the loader ADOPTED must be iterable: a crash (or a yielded
+        # batch of the wrong shape) here is a mis-seek the validator missed
+        batch = next(iter(loader), None)
+        if batch is not None and len(batch["v"]) != loader.batch_size:
+            raise AssertionError(f"restored batch shape {len(batch['v'])}")
+    finally:
+        loader.restore(pristine)
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -402,6 +469,7 @@ TARGETS = {
     "snappy": fuzz_snappy,
     "snappy_plan": fuzz_snappy_plan,
     "narrow": fuzz_narrow,
+    "loader_state": fuzz_loader_state,
 }
 
 
@@ -528,6 +596,15 @@ def _seed_inputs(target: str) -> list[bytes]:
             b"ab" * 2000,                            # offset-2 overlap copies
             b"",
         )]
+    if target == "loader_state":
+        from .data import checkpoint as ck
+
+        _force_cpu_jax()
+        loader = _loader_for_fuzz()
+        fresh = loader.state_blob()
+        mid = dict(loader.state())
+        mid.update(epoch=2, rows_taken=2 * loader.batch_size)
+        return [fresh, ck.pack_state(mid)]
     if target == "narrow":
         return [
             rng.integers(500, 1500, 64).astype(np.int64).tobytes(),

@@ -54,6 +54,7 @@ else:  # pragma: no cover - depends on installed jax
 __all__ = [
     "make_mesh",
     "plan_shards",
+    "process_shard",
     "shard_scan_row_groups",
     "PageBatch",
     "pack_hybrid_pages",
@@ -104,6 +105,18 @@ def plan_shards(sizes: Sequence[int], n_shards: int) -> list[list[int]]:
     for shard in plan:
         shard.sort()
     return plan
+
+
+def process_shard() -> tuple[int, int]:
+    """This process's ``(shard_index, n_shards)`` under ``jax.distributed``.
+
+    The shard tuple ``data.DataLoader`` (and any plan_shards caller) wants on
+    a multi-host job: every host derives the identical LPT plan from the
+    shared footers, so the only coordination is jax.distributed's own
+    process enumeration.  On a single-process runtime this is ``(0, 1)`` —
+    the same code serves tests and clusters.
+    """
+    return int(jax.process_index()), int(jax.process_count())
 
 
 def _reader_prefetch(reader) -> int:

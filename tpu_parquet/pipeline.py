@@ -124,6 +124,24 @@ class PipelineStats:
             self.peak_in_flight_bytes = max(self.peak_in_flight_bytes,
                                             budget.peak)
 
+    def merge_from(self, other: "PipelineStats") -> None:
+        """Fold another pipeline's counters into this one (layering hook:
+        a DataLoader accumulates its per-unit readers' pipelines here).
+        Stage/stall seconds and item counts add; peaks take the max; the
+        wall clock stays this object's own (merged pipelines overlap it)."""
+        with other._lock:
+            stages = dict(other._stage_seconds)
+            chunks, row_groups = other.chunks, other.row_groups
+            stall = other.stall_seconds
+            peak = other.peak_in_flight_bytes
+        with self._lock:
+            for s, v in stages.items():
+                self._stage_seconds[s] += v
+            self.chunks += chunks
+            self.row_groups += row_groups
+            self.stall_seconds += stall
+            self.peak_in_flight_bytes = max(self.peak_in_flight_bytes, peak)
+
     # -- reporting ------------------------------------------------------------
 
     def stage_seconds(self, stage: str) -> float:
