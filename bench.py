@@ -390,13 +390,29 @@ def bench_device(path, rows):
     _device_run(path)  # warm: XLA executables cached after this
     samples = device_reps(path, rows, REPS)
     # observability counters from one instrumented pass (SURVEY.md §5.5),
-    # accumulated over every file of the config (multi-file nested scan)
+    # accumulated over every file of the config (multi-file nested scan).
+    # The ship-planner counters (per-route link bytes — ship.py) prove the
+    # link-byte cut from the artifact alone: `link_bytes_shipped` vs
+    # `link_bytes_logical` is the transfer the planner removed.
+    ship = {"link_bytes_shipped": 0, "link_bytes_logical": 0,
+            "ship_routes": {}}
     for p in _bench_paths(path):
         with DeviceFileReader(p) as r:
             for cols in r.iter_row_groups():
                 pass
-            log(f"  reader stats[{os.path.basename(p)}]: {r.stats().as_dict()}")
-    return samples
+            d = r.stats().as_dict()
+            log(f"  reader stats[{os.path.basename(p)}]: {d}")
+            ship["link_bytes_shipped"] += d["link_bytes_shipped"]
+            ship["link_bytes_logical"] += d["link_bytes_logical"]
+            for route, c in d["ship_routes"].items():
+                agg = ship["ship_routes"].setdefault(
+                    route, {"streams": 0, "logical": 0, "shipped": 0})
+                for k in agg:
+                    agg[k] += c[k]
+    if ship["link_bytes_logical"]:
+        ship["link_bytes_ratio"] = round(
+            ship["link_bytes_shipped"] / ship["link_bytes_logical"], 4)
+    return samples, ship
 
 
 def bench_pyarrow(path, rows):
@@ -748,7 +764,8 @@ _SUMMARY_KEYS = (
     "pyarrow_rows_per_sec", "pipeline_speedup", "prefetch0_rows_per_sec",
     "prefetch4_rows_per_sec", "overlap_efficiency", "loader_speedup",
     "loader_vs_scan", "scan_files_rows_per_sec", "device_vs_host_prefetch4",
-    "pallas_speedup",
+    "pallas_speedup", "link_bytes_shipped", "link_bytes_logical",
+    "link_bytes_ratio",
 )
 _SUMMARY_LIMIT = 1990  # < the driver's 2000-char tail window, with margin
 
@@ -807,6 +824,12 @@ def main():
     meta = {"device_reps": REPS, "baseline_reps": BASELINE_REPS}
     try:
         meta["link_mb_per_sec_start"] = probe_link()
+        # feed the MEASURED link speed to the ship planner (ship.py reads
+        # TPQ_LINK_MBPS) so route choices below reflect this run's weather,
+        # not the default planning point; an explicit env wins
+        if "TPQ_LINK_MBPS" not in os.environ:
+            os.environ["TPQ_LINK_MBPS"] = str(meta["link_mb_per_sec_start"])
+            meta["planner_link_mbps"] = meta["link_mb_per_sec_start"]
     except Exception as e:  # noqa: BLE001 — diagnostics only
         log(f"link probe FAILED: {e!r}")
 
@@ -852,7 +875,7 @@ def main():
         mb = _uncompressed_mb(path)
         log(f"config {key} {name}: {rows} rows, {mb:.0f} MB uncompressed")
         try:
-            samples = bench_device(path, rows)
+            samples, ship = bench_device(path, rows)
         except Exception as e:  # noqa: BLE001 — one bad config (or a tunnel
             # hiccup mid-compile) must not cost the driver its JSON line
             log(f"config {key} {name} FAILED: {e!r}; continuing")
@@ -863,6 +886,7 @@ def main():
             "device_rows_per_sec": round(rows / dev_t, 1),
             "device_mb_per_sec": round(mb / dev_t, 1),
             "device_windows_s": [[round(t, 3) for t in samples]],
+            **ship,
         }
         dev_times[name] = ([samples], path, rows, key, mb)
         log(f"config {key} {name}: device "

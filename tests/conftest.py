@@ -25,3 +25,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def require_codec(codec) -> None:
+    """Skip (never fail) when a codec has no registered implementation.
+
+    The sealed CI image ships without the ``zstandard`` module, so ZSTD
+    matrix cells would otherwise FAIL with a codec error and bury real
+    regressions among 15 standing red tests (round-7 hygiene).  An explicit
+    skip keeps the cells visible as environment gaps, exactly like the
+    corpus runners' missing-file skips.
+    """
+    import pytest
+
+    from tpu_parquet.compress import registered_codecs
+
+    if int(codec) not in registered_codecs():
+        name = getattr(codec, "name", str(codec))
+        pytest.skip(f"codec {name} unavailable in this image "
+                    f"(zstandard module not installed)")

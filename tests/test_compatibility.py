@@ -57,6 +57,10 @@ def _write(path, rows, codec, version):
 def test_matrix_cell_roundtrip_and_pyarrow(tmp_path, rows, codec, version):
     import pyarrow.parquet as pq
 
+    from conftest import require_codec
+
+    require_codec(CODECS[codec])
+
     p = tmp_path / f"out-{codec}-v{version}.parquet"
     _write(p, rows, codec, version)
 

@@ -455,6 +455,7 @@ def test_customer_golden(tmp_path, codec):
 @pytest.mark.parametrize("codec_name", ["UNCOMPRESSED", "GZIP", "SNAPPY", "ZSTD"])
 @pytest.mark.parametrize("v2", [False, True])
 def test_writer_interop_matrix(tmp_path, codec_name, v2):
+    from conftest import require_codec
     from tpu_parquet.column import ByteArrayData, ColumnData
     from tpu_parquet.format import (
         CompressionCodec, ConvertedType, FieldRepetitionType as FRT,
@@ -462,6 +463,8 @@ def test_writer_interop_matrix(tmp_path, codec_name, v2):
     )
     from tpu_parquet.schema.core import ColumnParameters, build_schema, data_column
     from tpu_parquet.writer import FileWriter
+
+    require_codec(getattr(CompressionCodec, codec_name))
 
     rng = np.random.default_rng(99)
     n = 1000

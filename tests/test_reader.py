@@ -53,6 +53,11 @@ def test_int64_plain_snappy(tmp_path):
 @pytest.mark.parametrize("codec", ["none", "snappy", "gzip", "zstd"])
 @pytest.mark.parametrize("page_version", ["1.0", "2.0"])
 def test_codec_page_matrix(tmp_path, codec, page_version):
+    if codec == "zstd":
+        from conftest import require_codec
+        from tpu_parquet.format import CompressionCodec
+
+        require_codec(CompressionCodec.ZSTD)
     rng = np.random.default_rng(1)
     ints = rng.integers(-(2**60), 2**60, 5000)
     data = {

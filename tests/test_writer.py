@@ -72,6 +72,9 @@ def sample_rows(n=1000):
 ])
 @pytest.mark.parametrize("version", [1, 2])
 def test_pyarrow_reads_our_files_matrix(tmp_path, codec, version):
+    from conftest import require_codec
+
+    require_codec(codec)
     p = tmp_path / "out.parquet"
     rows = sample_rows(2000)
     with FileWriter(p, flat_schema(), codec=codec, data_page_version=version) as w:

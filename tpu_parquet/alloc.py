@@ -40,6 +40,21 @@ class AllocTracker:
             if self.total > self.max_size:
                 raise MemoryBudgetExceeded(int(nbytes), self.total, self.max_size)
 
+    def register_transient(self, nbytes: int) -> None:
+        """Account a short-lived buffer against the cap without holding it.
+
+        The ship planner's link recompression (ship.py ROUTE_RECOMPRESS)
+        materializes a compressed COPY of each value stream alongside the
+        decompressed original; the copy must fit the budget at its peak
+        (raise-don't-OOM contract) but is handed to the stager and released
+        as the originals are, so holding it registered would double-count
+        the chunk for the rest of the row-group window.
+        """
+        if self.max_size <= 0:
+            return
+        self.register(nbytes)
+        self.release(nbytes)
+
     def release(self, nbytes: int) -> None:
         if self.max_size <= 0:
             return

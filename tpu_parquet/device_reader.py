@@ -27,7 +27,8 @@ from __future__ import annotations
 import functools
 import os
 import threading
-from dataclasses import dataclass
+from contextlib import nullcontext as _noop_ctx
+from dataclasses import dataclass, field
 from typing import Optional
 
 import numpy as np
@@ -51,6 +52,11 @@ from .jax_decode import (
     host_decode_dictionary, parse_data_page, parse_hybrid_meta, parse_delta_meta,
 )
 from .schema.core import SchemaNode
+from .ship import (
+    ChunkFacts, ROUTE_DEVICE_SNAPPY, ROUTE_NARROW, ROUTE_NARROW_SNAPPY,
+    ROUTE_PLAIN, ROUTE_RECOMPRESS, SNAPPY_WORTH_RATIO, ShipPlanner,
+    default_planner,
+)
 
 __all__ = ["DeviceFileReader", "ReaderStats", "decode_chunk_batched",
            "DeviceDictColumn", "scan_files"]
@@ -197,28 +203,18 @@ def _plain_bytes_staged_jit(buf, lens_base, tbase, *, count_pad, heap_pad,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("count_pad", "heap_pad"))
-def _plain_bytes_pages_jit(buf, lens_base, page_byte_base, page_val_start,
-                           *, count_pad, heap_pad):
-    """PLAIN BYTE_ARRAY decode on device: lengths → offsets → heap compaction.
-
-    The host walks ONLY the u32 length prefixes (native
-    tpq_bytearray_lengths — O(values), no copies) and stages the RAW value
-    streams plus the lengths; this kernel does everything that touches the
-    value bytes (SURVEY §7.4.2's "sequential" length walk is sequential only
-    in *finding* the lengths — once they are known, offsets are one cumsum
-    and the heap compaction is data-parallel):
+def _bytes_heap_src(buf, lens_base, page_base, page_val_start, *, count_pad,
+                    heap_pad):
+    """Shared front half of the BYTE_ARRAY routes: staged lengths → offsets
+    and each heap byte's source position in PAGE-STREAM coordinates.
 
       offsets  = cumsum(lens)                              (int64[count+1])
       value r of heap byte j via a scatter-of-run-ends + cumsum
       src[j]   = page_base[p] + within-page data offset + 4*(prefixes so far)
 
-    ``lens_base`` points at the staged uint32 lengths (zero-filled past the
-    real count, so pad values are empty).  ``page_val_start`` int32[P+1]
-    cumulative value counts; ``page_byte_base`` int64[P] staged byte base of
-    each page's raw stream.  Returns (offsets int64[count_pad+1],
-    heap uint8[heap_pad]) — callers slice by the real counts.
-    """
+    ``page_base`` is staged-buffer coords on the plain route and
+    OUTPUT-SPACE coords on the compressed-shipping routes (the caller picks
+    the final indirection).  Returns (offsets, src)."""
     lens_raw = jax.lax.dynamic_slice(buf, (lens_base,), (count_pad * 4,))
     lens = jax.lax.bitcast_convert_type(
         lens_raw.reshape(count_pad, 4), jnp.uint32
@@ -234,29 +230,77 @@ def _plain_bytes_pages_jit(buf, lens_base, page_byte_base, page_val_start,
     r = jnp.cumsum(marks[:heap_pad])  # value index of each heap byte
     r = jnp.clip(r, 0, count_pad - 1)
     p = jnp.searchsorted(page_val_start, r, side="right").astype(jnp.int32) - 1
-    p = jnp.clip(p, 0, page_byte_base.shape[0] - 1)
+    p = jnp.clip(p, 0, page_base.shape[0] - 1)
     pvs = page_val_start[p].astype(jnp.int64)
     j = jnp.arange(heap_pad, dtype=jnp.int64)
-    src = (page_byte_base[p]
+    src = (page_base[p]
            + (offsets[r] - offsets[pvs])        # data bytes before r in page
            + 4 * (r.astype(jnp.int64) - pvs + 1)  # prefixes up to & incl. r
            + (j - offsets[r]))                  # byte within value r
+    return offsets, src
+
+
+@functools.partial(jax.jit, static_argnames=("count_pad", "heap_pad"))
+def _plain_bytes_pages_jit(buf, lens_base, page_byte_base, page_val_start,
+                           *, count_pad, heap_pad):
+    """PLAIN BYTE_ARRAY decode on device: lengths → offsets → heap compaction.
+
+    The host walks ONLY the u32 length prefixes (native
+    tpq_bytearray_lengths — O(values), no copies) and stages the RAW value
+    streams plus the lengths; this kernel does everything that touches the
+    value bytes (SURVEY §7.4.2's "sequential" length walk is sequential only
+    in *finding* the lengths — once they are known, offsets are one cumsum
+    and the heap compaction is data-parallel; see _bytes_heap_src).
+
+    ``lens_base`` points at the staged uint32 lengths (zero-filled past the
+    real count, so pad values are empty).  ``page_val_start`` int32[P+1]
+    cumulative value counts; ``page_byte_base`` int64[P] staged byte base of
+    each page's raw stream.  Returns (offsets int64[count_pad+1],
+    heap uint8[heap_pad]) — callers slice by the real counts.
+    """
+    offsets, src = _bytes_heap_src(
+        buf, lens_base, page_byte_base, page_val_start,
+        count_pad=count_pad, heap_pad=heap_pad,
+    )
     heap = buf[jnp.clip(src, 0, buf.shape[0] - 1)]
     return offsets, heap
 
 
-@functools.partial(jax.jit, static_argnames=("k", "dtype", "count"))
-def _plain_narrow_jit(buf, base, bias, *, k, dtype, count):
-    """Reconstruct a narrow-transcoded PLAIN INT column.
+@functools.partial(
+    jax.jit,
+    static_argnames=("count_pad", "heap_pad", "n_ops", "out_pad", "iters",
+                     "n_pages"),
+)
+def _snappy_bytes_staged_jit(buf, lens_base, tbase, *, count_pad, heap_pad,
+                             n_ops, out_pad, iters, n_pages):
+    """BYTE_ARRAY heap compaction with the value streams shipped COMPRESSED
+    (ship.py ROUTE_DEVICE_SNAPPY / ROUTE_RECOMPRESS — byte-array heaps are
+    the lineitem16 byte mover the round-5 VERDICT named).  Identical to
+    _plain_bytes_pages_jit except each heap byte's page-stream position is
+    an OUTPUT-SPACE coordinate resolved through the snappy source map — one
+    extra gather composes the two routes.
 
-    The host shipped ``(v - min)`` truncated to ``k`` little-endian bytes per
-    value (see _ChunkAssembler._plan_narrow_ints); this widens and re-biases:
-    ``v = min + zero_extend(bytes)``.  All arithmetic is modular, so the
-    reconstruction is exact for any int range whose *span* fits ``k`` bytes,
-    including negative minima.  ``bias`` is traced (per-chunk data); only
-    (k, dtype, count) key the executable.
+    Layout at ``tbase``: op tables (_SNAPPY_OPS_BYTES * n_ops) |
+    page_out_base i64[P] | page_val_start i32[P+1].
     """
-    raw = jax.lax.dynamic_slice(buf, (base,), (count * k,)).reshape(count, k)
+    S = _resolve_snappy_staged(buf, tbase, n_ops=n_ops, out_pad=out_pad,
+                               iters=iters)
+    o = _SNAPPY_OPS_BYTES * n_ops
+    page_out = _tslice(buf, tbase, o, n_pages, jnp.int64); o += 8 * n_pages
+    pvs = _tslice(buf, tbase, o, n_pages + 1, jnp.int32)
+    offsets, src = _bytes_heap_src(
+        buf, lens_base, page_out, pvs, count_pad=count_pad, heap_pad=heap_pad,
+    )
+    src32 = jnp.clip(src, 0, out_pad - 1).astype(jnp.int32)
+    heap = buf[jnp.clip(S[src32], 0, buf.shape[0] - 1)]
+    return offsets, heap
+
+
+def _narrow_widen(raw, bias, *, k, dtype, count):
+    """Widen ``k``-byte little-endian rows and re-bias: ``v = min +
+    zero_extend(bytes)`` (the shared back half of both narrow routes).  All
+    arithmetic is modular, so the reconstruction is exact for any int range
+    whose *span* fits ``k`` bytes, including negative minima."""
     lo = jnp.zeros((count,), jnp.uint32)
     for i in range(min(k, 4)):
         lo = lo | (raw[:, i].astype(jnp.uint32) << (8 * i))
@@ -271,6 +315,38 @@ def _plain_narrow_jit(buf, base, bias, *, k, dtype, count):
     return jax.lax.bitcast_convert_type(bias.astype(jnp.uint64) + u, jnp.int64)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "dtype", "count"))
+def _plain_narrow_jit(buf, base, bias, *, k, dtype, count):
+    """Reconstruct a narrow-transcoded PLAIN INT column.
+
+    The host shipped ``(v - min)`` truncated to ``k`` little-endian bytes per
+    value (see _ChunkAssembler._plan_narrow_ints); this widens and re-biases
+    (_narrow_widen).  ``bias`` is traced (per-chunk data); only
+    (k, dtype, count) key the executable.
+    """
+    raw = jax.lax.dynamic_slice(buf, (base,), (count * k,)).reshape(count, k)
+    return _narrow_widen(raw, bias, k=k, dtype=dtype, count=count)
+
+
+# packed op-table bytes per op slot: ends/asrc/offs int32 + islit uint8.
+# Route tables packed behind the ops start at tbase + _SNAPPY_OPS_BYTES*n_ops.
+_SNAPPY_OPS_BYTES = 13
+
+
+def _resolve_snappy_staged(buf, tbase, *, n_ops, out_pad, iters):
+    """Slice the packed op tables at ``tbase`` back out of the staged buffer
+    and resolve the output-space source map (jax_kernels.snappy_resolve —
+    the shared device half of every compressed-shipping route).  Trace-time
+    helper; statics (n_ops, out_pad, iters) ride the consuming jit's key."""
+    o = 0
+    ends = _tslice(buf, tbase, o, n_ops, jnp.int32); o += 4 * n_ops
+    asrc = _tslice(buf, tbase, o, n_ops, jnp.int32); o += 4 * n_ops
+    offs = _tslice(buf, tbase, o, n_ops, jnp.int32); o += 4 * n_ops
+    islit = _tslice(buf, tbase, o, n_ops, jnp.uint8)
+    return K.snappy_resolve(ends, asrc, offs, islit, out_pad=out_pad,
+                            iters=iters)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("n_ops", "out_pad", "iters", "dtype", "count", "n_pages"),
@@ -281,43 +357,19 @@ def _snappy_plain_staged_jit(buf, tbase, *, n_ops, out_pad, iters, dtype,
 
     The host shipped the COMPRESSED page payloads plus tag-walk op tables
     (native tpq_snappy_plan; see _plan_device_snappy).  Byte movement — the
-    actual decompression — happens here as gathers:
-
-    1. per output byte, find its op (one searchsorted) and compute a source:
-       literal bytes point into the staged compressed stream (>= 0), copy
-       bytes encode their *output-space* source as -(pos)-1 using the
-       periodic form ``dst_start - offset + (i mod offset)``, which maps
-       overlapping (RLE-style) copies straight past their own op;
-    2. resolve copy chains by pointer doubling: ``iters`` rounds of
-       ``S = where(S >= 0, S, S[-S-1])`` — after ceil(log2(depth)) rounds
-       every byte points at a literal (the host computed the exact max chain
-       depth during the tag walk, so ``iters`` is a static bound, no syncs);
-    3. gather each value's bytes through S and bitcast (plain_decode_fixed).
+    actual decompression — happens in ``snappy_resolve`` as gathers; this
+    kernel then gathers each value's bytes through the source map and
+    bitcasts (plain_decode_fixed).
 
     Output positions past the real total resolve through padded literal ops
     (src 0) and are never selected by the value gather.  All math is int32 —
     the planner falls back to host decompression beyond 2 GiB arenas.
     """
-    o = 0
-    ends = _tslice(buf, tbase, o, n_ops, jnp.int32); o += 4 * n_ops
-    asrc = _tslice(buf, tbase, o, n_ops, jnp.int32); o += 4 * n_ops
-    offs = _tslice(buf, tbase, o, n_ops, jnp.int32); o += 4 * n_ops
-    islit = _tslice(buf, tbase, o, n_ops, jnp.uint8); o += n_ops
+    S = _resolve_snappy_staged(buf, tbase, n_ops=n_ops, out_pad=out_pad,
+                               iters=iters)
+    o = _SNAPPY_OPS_BYTES * n_ops
     vbase = _tslice(buf, tbase, o, n_pages, jnp.int32); o += 4 * n_pages
     vstart = _tslice(buf, tbase, o, n_pages + 1, jnp.int32)
-    j = jnp.arange(out_pad, dtype=jnp.int32)
-    op = jnp.clip(jnp.searchsorted(ends, j, side="right").astype(jnp.int32),
-                  0, n_ops - 1)
-    start = jnp.where(op > 0, ends[jnp.maximum(op - 1, 0)], 0)
-    within = j - start
-    S = jnp.where(
-        islit[op] != 0,
-        asrc[op] + within,
-        -(asrc[op] + within % jnp.maximum(offs[op], 1)) - 1,
-    )
-    for _ in range(iters):
-        t = jnp.clip(-S - 1, 0, out_pad - 1)
-        S = jnp.where(S >= 0, S, S[t])
     width = 8 if dtype in ("int64", "float64") else 4
     i = jnp.arange(count, dtype=jnp.int32)
     p = jnp.clip(
@@ -330,6 +382,44 @@ def _snappy_plain_staged_jit(buf, tbase, *, n_ops, out_pad, iters, dtype,
     src = S[jnp.clip(byte_idx, 0, out_pad - 1)]
     bts = buf[jnp.clip(src, 0, buf.shape[0] - 1)]
     return K.plain_decode_fixed(bts, dtype, count)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_ops", "out_pad", "iters", "k", "dtype",
+                              "count"),
+)
+def _snappy_narrow_staged_jit(buf, tbase, bias, *, n_ops, out_pad, iters, k,
+                              dtype, count):
+    """The narrow+snappy composition: the host shipped SNAPPY over the
+    ``k``-byte narrow transcode (ship.py ROUTE_NARROW_SNAPPY), so the two
+    transfer cuts multiply — narrow residuals are low-entropy and compress
+    far below their already-truncated width.  Resolve the stream's output
+    space, gather the rows, widen and re-bias (_narrow_widen).  Rows past
+    the real count resolve through padded ops — callers slice by
+    ``n_values``."""
+    S = _resolve_snappy_staged(buf, tbase, n_ops=n_ops, out_pad=out_pad,
+                               iters=iters)
+    idx = jnp.arange(count * k, dtype=jnp.int32)
+    src = S[jnp.clip(idx, 0, out_pad - 1)]
+    raw = buf[jnp.clip(src, 0, buf.shape[0] - 1)].reshape(count, k)
+    return _narrow_widen(raw, bias, k=k, dtype=dtype, count=count)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_ops", "out_pad", "iters", "nbytes"),
+)
+def _snappy_gather_staged_jit(buf, tbase, *, n_ops, out_pad, iters, nbytes):
+    """Materialize the first ``nbytes`` of a snappy stream's output space
+    (dictionary value tables, ragged dictionary heaps).  Positions past the
+    real output resolve through padded literal ops to staged byte 0 —
+    consumers never index them (every valid dictionary index is <
+    dict_len; the deferred-check path raises at finalize before clamped
+    garbage can escape)."""
+    S = _resolve_snappy_staged(buf, tbase, n_ops=n_ops, out_pad=out_pad,
+                               iters=iters)
+    idx = jnp.arange(nbytes, dtype=jnp.int32)
+    src = S[jnp.clip(idx, 0, out_pad - 1)]
+    return buf[jnp.clip(src, 0, buf.shape[0] - 1)]
 
 
 # pointer-doubling round buckets (static arg: executable sharing); 24 covers
@@ -850,6 +940,146 @@ def _merge_run_tables(ends_l, rle_l, vals_l, starts_l, fill_end,
     return ends, is_rle, rvals, starts
 
 
+class _SnappyShipInfo:
+    """Statics + staged table base of one planned compressed shipment."""
+
+    __slots__ = ("tbase", "n_ops", "out_pad", "iters", "shipped", "total_out")
+
+    def __init__(self, tbase, n_ops, out_pad, iters, shipped, total_out):
+        self.tbase = tbase
+        self.n_ops = n_ops
+        self.out_pad = out_pad
+        self.iters = iters
+        self.shipped = shipped
+        self.total_out = total_out
+
+
+def _plan_snappy_ops(stager: _RowGroupStager, specs, extra_tables=()):
+    """Register snappy/raw payloads and pack the op tables the device
+    resolver (jax_kernels.snappy_resolve) consumes — the shared host half
+    of every compressed-shipping route (ship.py).
+
+    ``specs``: per stream, ``('comp', payload, out_len[, plan])`` — a
+    raw-snappy payload whose uncompressed length is ``out_len`` (``plan``
+    optionally carries a pre-run ``native.snappy_plan`` result) — or
+    ``('raw', buf, pos, out_len)`` — host bytes shipped as one synthetic
+    literal op.  Output spaces concatenate in spec order; callers compute
+    out-space bases as the exclusive cumsum of out_lens.  ``extra_tables``
+    pack behind the op tables at the same ``tbase`` (consuming jits slice
+    them at ``_SNAPPY_OPS_BYTES * n_ops_pad``).
+
+    Returns ``_SnappyShipInfo`` or None when infeasible (native library
+    absent, stream rejected by the tag walk, op-table cap, i32 arena
+    ceiling).  Infeasibility leaves the stager UNTOUCHED, so callers fall
+    through to another route with no dead staged bytes.
+    """
+    from . import native
+
+    if not native.available():
+        return None
+    plans = []
+    n_ops_total = 0
+    total_out = 0
+    for spec in specs:
+        if spec[0] == "comp":
+            payload, out_len = spec[1], spec[2]
+            r = spec[3] if len(spec) > 3 and spec[3] is not None else (
+                native.snappy_plan(payload, out_len))
+            if r is None or isinstance(r, int):
+                return None
+            plans.append((spec, r, out_len))
+            n_ops_total += len(r[0])
+        else:
+            out_len = spec[3]
+            plans.append((spec, None, out_len))
+            n_ops_total += 1
+        total_out += out_len
+    if n_ops_total == 0 or n_ops_total > _SNAPPY_MAX_OPS:
+        return None
+    out_pad = _bucket_bytes(total_out + 8, 8)
+    segs = [
+        (spec[1], 0, len(spec[1])) if r is not None
+        else (spec[1], spec[2], out_len)
+        for spec, r, out_len in plans
+    ]
+    shipped = sum(s[2] for s in segs)
+    n_ops_pad = _bucket(n_ops_total)
+    extra_bytes = sum(np.ascontiguousarray(t).nbytes for t in extra_tables)
+    if (stager.total + shipped + _SNAPPY_OPS_BYTES * n_ops_pad + extra_bytes
+            + out_pad > (np.iinfo(np.int32).max >> 1)):
+        return None  # i32 source/table math would overflow
+    bases = stager.add_segments(segs)
+    ends = np.empty(n_ops_total, np.int64)
+    asrc = np.empty(n_ops_total, np.int64)
+    offs = np.zeros(n_ops_total, np.int32)
+    islit = np.empty(n_ops_total, np.uint8)
+    at = 0
+    out_base = 0
+    max_depth = 0
+    for (spec, r, out_len), base in zip(plans, bases):
+        if r is None:
+            ends[at] = out_base + out_len
+            asrc[at] = base
+            islit[at] = 1
+            at += 1
+        else:
+            dst_end, op_src, is_lit_p, depth = r
+            n = len(dst_end)
+            if n:
+                ends[at : at + n] = dst_end + out_base
+                # literal: absolute staged position of the run's payload;
+                # copy: chunk-out source base  dst_start - offset
+                starts = np.empty(n, np.int64)
+                starts[0] = 0
+                starts[1:] = dst_end[:-1]
+                asrc[at : at + n] = np.where(
+                    is_lit_p != 0, op_src + base,
+                    out_base + starts - op_src,
+                )
+                offs[at : at + n] = np.where(is_lit_p != 0, 1, op_src)
+                islit[at : at + n] = is_lit_p
+                at += n
+                max_depth = max(max_depth, depth)
+        out_base += out_len
+    # `at` always lands on n_ops_total: raw specs write one op each and
+    # comp specs exactly len(plan) (counted above)
+    assert at == n_ops_total, (at, n_ops_total)
+    iters = next(
+        (b for b in _SNAPPY_ITER_BUCKETS
+         if (1 << b) >= max_depth + 1), _SNAPPY_ITER_BUCKETS[-1]
+    ) if max_depth > 0 else 0
+    ends_t = np.full(n_ops_pad, out_pad, np.int32)
+    ends_t[:n_ops_total] = ends
+    asrc_t = np.zeros(n_ops_pad, np.int32)
+    asrc_t[:n_ops_total] = asrc
+    offs_t = np.ones(n_ops_pad, np.int32)
+    offs_t[:n_ops_total] = offs
+    islit_t = np.ones(n_ops_pad, np.uint8)
+    islit_t[:n_ops_total] = islit
+    tbase = _pack_tables(
+        stager, [ends_t, asrc_t, offs_t, islit_t, *extra_tables]
+    )
+    return _SnappyShipInfo(tbase, n_ops_pad, out_pad, iters, shipped,
+                           total_out)
+
+
+def _fixed_value_tables(sizes, counts):
+    """Bucket-padded (vbase, vstart) page tables for the fixed-width snappy
+    routes: per-page OUT-SPACE byte bases (exclusive cumsum of ``sizes``)
+    and cumulative defined ``counts``.  Layout twin of what
+    _snappy_plain_staged_jit slices back out — one builder so its call
+    sites (_plan_device_snappy, _plan_recompress_fixed) can never
+    desynchronize.  Returns (vbase_t, vstart_t, pages_pad, defined)."""
+    out_bases = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int64)
+    vstart = np.concatenate([[0], np.cumsum(counts)])
+    pages_pad = _bucket(len(sizes))
+    vbase_t = np.zeros(pages_pad, np.int32)
+    vbase_t[: len(sizes)] = out_bases
+    vstart_t = np.full(pages_pad + 1, vstart[-1], np.int32)
+    vstart_t[: len(sizes) + 1] = vstart
+    return vbase_t, vstart_t, pages_pad, int(vstart[-1])
+
+
 class _Plan:
     """A planned device computation: ``fn(buf_dev, *dyn) -> pytree``.
 
@@ -1072,6 +1302,32 @@ class _ChunkAssembler:
         self.stats_span: "tuple[int, int] | None" = None
         self.pages_kept_compressed = 0
         self.pages_pruned = 0  # page-level predicate pushdown skips
+        # ship planner state (see preship / tpu_parquet.ship): the ordered
+        # route preference, host-built artifacts keyed by route family, and
+        # the per-stream (route, logical, shipped) decisions for stats
+        self.dict_comp: "tuple | None" = None  # (snappy payload, ulen)
+        self.alloc = None  # AllocTracker: recompression copies count too
+        self._ship_pref: "list | None" = None
+        self._ship: dict = {}
+        self._dict_ship: "tuple | None" = None  # (route, payload, out_len)
+        self._bytes_walk: "tuple | None" = None  # (lens_l, span_l)
+        self._narrow_compress = False
+        self.ship_records: list = []
+
+    def _record_ship(self, route: str, logical: int, shipped: int) -> None:
+        self.ship_records.append((route, int(logical), int(shipped)))
+
+    def _route_enabled(self, route: str) -> bool:
+        """Whether the planner ranked ``route`` ahead of the plain tail
+        (True when no preship ran — legacy chain semantics)."""
+        if self._ship_pref is None:
+            return True
+        for r in self._ship_pref:
+            if r == route:
+                return True
+            if r == ROUTE_PLAIN:
+                return False
+        return False
 
     # -- dictionary ----------------------------------------------------------
 
@@ -1083,6 +1339,279 @@ class _ChunkAssembler:
             self.dict_len = len(decoded)
         else:
             self.dict_u8, self.dict_dtype, self.dict_len = decoded
+
+    # -- ship planning (host half; see tpu_parquet.ship) ----------------------
+
+    def _try_snappy(self, stream, pipe_stats=None):
+        """snappy over one host stream (buffer-protocol, no copies); returns
+        the payload only when it beats SNAPPY_WORTH_RATIO — thin wins lose
+        to the op tables + device resolve."""
+        from . import native
+
+        if not native.available():
+            return None
+        nbytes = len(stream) if isinstance(stream, (bytes, bytearray)) \
+            else stream.nbytes
+        if nbytes == 0:
+            return None
+        if self.alloc is not None:
+            # register the worst-case compressed size BEFORE materializing
+            # it (raise-don't-OOM: the guard must fire before the peak)
+            self.alloc.register_transient(nbytes + nbytes // 6 + 32)
+        ctx = (pipe_stats.timed("recompress") if pipe_stats is not None
+               else _noop_ctx())
+        with ctx:
+            comp = native.snappy_compress(stream)
+        if len(comp) > SNAPPY_WORTH_RATIO * nbytes:
+            return None
+        return comp
+
+    def _recompress_streams(self, streams, pipe_stats=None):
+        """Link recompression (ship.py ROUTE_RECOMPRESS): snappy over each
+        page's value stream.  ``streams``: [(buf, pos, size)].  Returns the
+        per-page payloads, or None when the whole chunk didn't compress
+        past SNAPPY_WORTH_RATIO (the builder then falls through)."""
+        from . import native
+
+        if not native.available():
+            return None
+        total = sum(s[2] for s in streams)
+        if total == 0:
+            return None
+        if self.alloc is not None:
+            # the compressed copies coexist with the decompressed originals
+            # at their peak — register the worst-case bound BEFORE the
+            # copies exist (raise-don't-OOM), per-stream snappy worst case
+            # being n + n/6 + 32
+            self.alloc.register_transient(
+                total + total // 6 + 32 * len(streams))
+        ctx = (pipe_stats.timed("recompress") if pipe_stats is not None
+               else _noop_ctx())
+        payloads = []
+        with ctx:
+            for buf, pos, size in streams:
+                payloads.append(native.snappy_compress(
+                    np.frombuffer(buf, np.uint8, size, pos)))
+        if sum(len(c) for c in payloads) > SNAPPY_WORTH_RATIO * total:
+            return None
+        return payloads
+
+    def _narrow_host_transcode(self, width: int):
+        """Host half of the narrow routes: span probe, exact min/max, and
+        the k-byte truncating transcode into one dense buffer.  Returns
+        (k, min, uint8 buffer) or None when the span is too wide (full-range
+        data pays only a 64k-value probe, never a full scan).  Pages are
+        peeked, not materialized, so a later route can still ship the
+        file's compressed payload."""
+        from . import native
+
+        if not native.available():
+            return None
+        max_k = _narrow_max_k(width)
+        defined = sum(p.defined for p in self.pages)
+        if defined == 0:
+            return None
+        for p in self.pages:
+            p.peek()
+        if any(len(p.raw) - p.value_pos < p.defined * width
+               for p in self.pages):
+            return None  # truncated: the plain path raises with diagnostics
+        probe = next(p for p in self.pages if p.defined)
+        head = native.int_minmax(
+            probe.raw, probe.value_pos, min(probe.defined, _NARROW_PROBE),
+            width,
+        )
+        if _span_bytes(*head) > max_k:
+            return None
+        mms = [native.int_minmax(p.raw, p.value_pos, p.defined, width)
+               for p in self.pages if p.defined]
+        mn = min(m[0] for m in mms)
+        mx = max(m[1] for m in mms)
+        k = _span_bytes(mn, mx)
+        if k > max_k:
+            return None
+        # one truncating pass per page, written straight into a single dense
+        # buffer: (v - min) mod 2^width wraps to a value that fits k bytes by
+        # construction (negative minima included)
+        out = np.empty(defined * k, dtype=np.uint8)
+        at = 0
+        for p in self.pages:
+            native.int_truncate(p.raw, p.value_pos, p.defined, width, mn, k,
+                                out[at:])
+            at += p.defined * k
+        return k, mn, out
+
+    def preship(self, planner: "ShipPlanner | None" = None,
+                pipe_stats=None) -> None:
+        """Route choice + link-byte host work for this chunk (ship.py).
+
+        Runs on the prefetch pool's worker threads when prefetch > 0 — the
+        same threads that decompress, so ROUTE_RECOMPRESS's snappy pass and
+        the narrow transcode overlap the consumer thread's stage/dispatch —
+        and inline on the sequential path.  Stores the ordered route
+        preference plus any host-built artifacts; ``finish`` executes the
+        routes in order, falling through on infeasibility.  Compression
+        seconds land in PipelineStats' ``recompress`` stage.
+        """
+        if planner is None:
+            planner = default_planner()
+        self._preship_dict(planner, pipe_stats)
+        if not self.pages:
+            return
+        encs = {parse_encoding(p.encoding) for p in self.pages}
+        if encs != {Encoding.PLAIN}:
+            return
+        leaf = self.leaf
+        if leaf.physical_type in _PTYPE_TO_NAME:
+            self._preship_fixed(planner, pipe_stats)
+        elif leaf.physical_type == Type.BYTE_ARRAY:
+            self._preship_bytes(planner, pipe_stats)
+
+    def _preship_fixed(self, planner, pipe_stats) -> None:
+        from . import native
+
+        leaf = self.leaf
+        name = _PTYPE_TO_NAME[leaf.physical_type]
+        width = np.dtype(name).itemsize
+        defined = sum(p.defined for p in self.pages)
+        logical = defined * width
+        comp_bytes = sum(len(p.comp[0]) for p in self.pages
+                         if p.comp is not None)
+        is_int = leaf.physical_type in (Type.INT32, Type.INT64)
+        narrow_k = 0
+        if is_int and self.stats_span is not None:
+            k = _span_bytes(*self.stats_span)
+            if k <= _narrow_max_k(width):
+                narrow_k = k
+        self._ship_pref = planner.routes(ChunkFacts(
+            logical=logical, width=width, narrow_k=narrow_k,
+            narrow_possible=is_int and native.available(),
+            comp_bytes=comp_bytes, native=native.available(),
+        ))
+        # failed host work is memoized as a None sentinel so the finish
+        # builders (and a later pref entry naming the same family) never
+        # repeat a full-chunk scan that already failed — preship exists to
+        # keep that work OFF the consumer thread
+        for route in self._ship_pref:
+            if route in (ROUTE_NARROW, ROUTE_NARROW_SNAPPY):
+                if not is_int or defined == 0:
+                    continue
+                if "narrow" in self._ship:  # earlier pref entry failed
+                    continue
+                art = self._narrow_host_transcode(width)
+                if art is None:
+                    self._ship["narrow"] = None
+                    continue
+                k, mn, out = art
+                comp = (self._try_snappy(out, pipe_stats)
+                        if route == ROUTE_NARROW_SNAPPY else None)
+                self._ship["narrow"] = (k, mn, out, comp)
+                return
+            if route == ROUTE_DEVICE_SNAPPY:
+                if comp_bytes:
+                    return  # planned at finish (needs the stager)
+                continue
+            if route == ROUTE_RECOMPRESS:
+                if comp_bytes or defined == 0:
+                    continue
+                if any(len(p.raw) - p.value_pos < p.defined * width
+                       for p in self.pages):
+                    continue  # truncated: plain path raises diagnostics
+                payloads = self._recompress_streams(
+                    [(p.raw, p.value_pos, p.defined * width)
+                     for p in self.pages], pipe_stats)
+                if payloads is None:
+                    self._ship["recompress"] = None
+                    continue
+                self._ship["recompress"] = payloads
+                return
+            if route == ROUTE_PLAIN:
+                return
+
+    def _preship_bytes(self, planner, pipe_stats) -> None:
+        from . import native
+
+        if not native.available():
+            return
+        lens_l, span_l = [], []
+        for p in self.pages:
+            p.peek()
+            res = native.bytearray_lengths(p.raw, p.defined, pos=p.value_pos)
+            if res is None or isinstance(res, int):
+                return  # finish raises (or falls back) with diagnostics
+            lens, end = res
+            lens_l.append(lens)
+            span_l.append(end - p.value_pos)
+        self._bytes_walk = (lens_l, span_l)
+        logical = sum(span_l)
+        comp_bytes = sum(len(p.comp[0]) for p in self.pages
+                         if p.comp is not None)
+        self._ship_pref = planner.routes(ChunkFacts(
+            logical=logical, width=0, comp_bytes=comp_bytes, native=True,
+        ))
+        for route in self._ship_pref:
+            if route == ROUTE_DEVICE_SNAPPY:
+                if comp_bytes:
+                    return  # planned at finish
+                continue
+            if route == ROUTE_RECOMPRESS:
+                if comp_bytes or logical == 0:
+                    continue
+                payloads = self._recompress_streams(
+                    [(p.raw, p.value_pos, s)
+                     for p, s in zip(self.pages, span_l)], pipe_stats)
+                # failure memoized (None): _plan_snappy_bytes must not
+                # repeat the compression on the consumer thread
+                self._ship["recompress_bytes"] = payloads
+                if payloads is None:
+                    continue
+                return
+            if route == ROUTE_PLAIN:
+                return
+
+    def _preship_dict(self, planner, pipe_stats) -> None:
+        """Dictionary VALUE TABLE shipping: fixed-width dictionaries whose
+        page payload is exactly the rows (PLAIN) can keep the file's snappy
+        payload; ragged heaps (and non-snappy files) recompress.  The
+        decoded host copy is dropped after staging either way — only the
+        link bytes change."""
+        from . import native
+
+        if self.dict_len == 0:
+            return
+        if self.dict_u8 is not None:
+            nbytes = self.dict_u8.nbytes
+            src = self.dict_u8
+        elif self.dict_ragged is not None:
+            nbytes = int(self.dict_ragged.heap.nbytes)
+            src = self.dict_ragged.heap
+        else:
+            return
+        # the snappy page payload covers the rows only for fixed-width
+        # dictionaries (ragged payloads interleave u32 length prefixes)
+        comp0 = None
+        if (self.dict_u8 is not None and self.dict_comp is not None
+                and self.dict_comp[1] >= nbytes):
+            comp0 = self.dict_comp
+        facts = ChunkFacts(
+            logical=nbytes, width=0,
+            comp_bytes=len(comp0[0]) if comp0 is not None else 0,
+            native=native.available(),
+            host_bytes_ready=True,  # dict pages always decompress on host
+        )
+        for route in planner.routes(facts):
+            if route == ROUTE_DEVICE_SNAPPY and comp0 is not None:
+                self._dict_ship = (route, comp0[0], comp0[1])
+                return
+            if route == ROUTE_RECOMPRESS and comp0 is None:
+                comp = self._try_snappy(np.ascontiguousarray(src),
+                                        pipe_stats)
+                if comp is None:
+                    continue
+                self._dict_ship = (route, comp, nbytes)
+                return
+            if route == ROUTE_PLAIN:
+                return
 
     # -- finish: fused decode -------------------------------------------------
 
@@ -1100,11 +1629,14 @@ class _ChunkAssembler:
             Encoding.RLE_DICTIONARY if e == Encoding.PLAIN_DICTIONARY else e
             for e in encs
         }
-        # lazily-compressed pages are only consumed by the PLAIN fixed-width
-        # route (_plan_device_snappy); every other route gets host bytes
-        if any(p.comp is not None for p in self.pages) and not (
-            encs == {Encoding.PLAIN} and leaf.physical_type in _PTYPE_TO_NAME
-        ):
+        # lazily-compressed pages are only consumed by the compressed-ship
+        # routes (PLAIN fixed-width and PLAIN BYTE_ARRAY — see ship.py);
+        # every other route gets host bytes
+        lazy_ok = encs == {Encoding.PLAIN} and (
+            leaf.physical_type in _PTYPE_TO_NAME
+            or leaf.physical_type == Type.BYTE_ARRAY
+        )
+        if any(p.comp is not None for p in self.pages) and not lazy_ok:
             for p in self.pages:
                 p.materialize()
         slots_pad = _bucket_count(slots)
@@ -1259,25 +1791,85 @@ class _ChunkAssembler:
         return base, defined, count
 
     def _finish_plain_fixed(self, common, stager):
+        """PLAIN fixed-width dispatcher: execute the ship planner's route
+        preference in order (ship.py), falling through on infeasibility —
+        the ``plain`` tail can never fail.  Without a preship pass (direct
+        decode_chunk_batched callers) the legacy chain applies:
+        device-snappy, then narrow, then plain."""
         name = _PTYPE_TO_NAME[self.leaf.physical_type]
-        if any(p.comp is not None for p in self.pages):
-            plan = self._plan_device_snappy(common, stager, name)
+        pref = self._ship_pref
+        if pref is None:
+            pref = [ROUTE_DEVICE_SNAPPY, ROUTE_NARROW, ROUTE_PLAIN]
+        for route in pref:
+            plan = None
+            if route == ROUTE_PLAIN:
+                break  # the infallible tail below; later entries are dead
+            if route == ROUTE_DEVICE_SNAPPY:
+                if any(p.comp is not None for p in self.pages):
+                    plan = self._plan_device_snappy(common, stager, name)
+            elif route in (ROUTE_NARROW, ROUTE_NARROW_SNAPPY):
+                if name in ("int32", "int64"):
+                    self._narrow_compress = route == ROUTE_NARROW_SNAPPY
+                    plan = self._plan_narrow_ints(common, stager, name)
+            elif route == ROUTE_RECOMPRESS:
+                plan = self._plan_recompress_fixed(common, stager, name)
             if plan is not None:
                 return plan
-            for p in self.pages:
-                p.materialize()
-        if name in ("int32", "int64"):
-            plan = self._plan_narrow_ints(common, stager, name)
-            if plan is not None:
-                return plan
+        for p in self.pages:
+            p.materialize()
         base, defined, count = self._stage_fixed_width(
             stager, np.dtype(name).itemsize
         )
+        logical = defined * np.dtype(name).itemsize
+        self._record_ship(ROUTE_PLAIN, logical, logical)
         return _Plan(
             ("plain", name, count),
             lambda buf, base_d: _plain_jit(buf, base_d, dtype=name,
                                            count=count),
             (np.int64(base),),
+            lambda v: DeviceColumnData(values=v, n_values=defined, **common),
+        )
+
+    def _plan_recompress_fixed(self, common, stager, name: str):
+        """Link recompression for PLAIN fixed-width chunks stored GZIP/ZSTD/
+        uncompressed (ship.py ROUTE_RECOMPRESS): the host decompressed these
+        bytes anyway, so one more snappy pass trades cheap host cycles for
+        link bytes, and the device expands through the same resolver as
+        native snappy files.  Normally prepared by preship on the prefetch
+        pool; compresses inline when reached without one."""
+        width = np.dtype(name).itemsize
+        if any(p.comp is not None for p in self.pages):
+            return None  # the file's own payload is the better ship
+        defined = sum(p.defined for p in self.pages)
+        if defined == 0:
+            return None
+        _check_plain_sizes(self.pages, width)
+        if "recompress" in self._ship:
+            payloads = self._ship["recompress"]  # None: preship declined
+        else:
+            payloads = self._recompress_streams(
+                [(p.raw, p.value_pos, p.defined * width) for p in self.pages])
+        if payloads is None:
+            return None
+        sizes = [p.defined * width for p in self.pages]
+        specs = [("comp", c, n, None) for c, n in zip(payloads, sizes)]
+        vbase_t, vstart_t, pages_pad, _ = _fixed_value_tables(
+            sizes, [p.defined for p in self.pages])
+        count = _bucket_count(defined)
+        info = _plan_snappy_ops(stager, specs,
+                                extra_tables=[vbase_t, vstart_t])
+        if info is None:
+            return None
+        self.pages_kept_compressed = len(specs)
+        self._record_ship(ROUTE_RECOMPRESS, defined * width, info.shipped)
+        n_ops, out_pad, iters = info.n_ops, info.out_pad, info.iters
+        return _Plan(
+            ("snappy", n_ops, out_pad, iters, name, count, pages_pad),
+            lambda buf, tbase_d: _snappy_plain_staged_jit(
+                buf, tbase_d, n_ops=n_ops, out_pad=out_pad,
+                iters=iters, dtype=name, count=count, n_pages=pages_pad,
+            ),
+            (np.int64(info.tbase),),
             lambda v: DeviceColumnData(values=v, n_values=defined, **common),
         )
 
@@ -1295,18 +1887,22 @@ class _ChunkAssembler:
         from . import native
 
         width = np.dtype(name).itemsize
-        # stats hint: a narrow int span means host decompress + narrow
-        # transcode ships FEWER bytes than the compressed stream — prefer
-        # it.  The transcode recomputes real min/max, so lying stats cost
-        # only the decompress, never correctness.
-        if name in ("int32", "int64") and self.stats_span is not None:
+        # legacy stats hint (pre-planner chain only): a narrow int span
+        # means host decompress + narrow transcode ships FEWER bytes than
+        # the compressed stream — decline so the chain's next step claims
+        # it.  With a planner preference the hint already routed via
+        # ChunkFacts.narrow_k, and declining HERE would fight it: narrow
+        # may rank after plain, have already failed (lying stats), or be
+        # absent entirely under TPQ_FORCE_ROUTE=device_snappy.
+        if (self._ship_pref is None and name in ("int32", "int64")
+                and self.stats_span is not None):
             lo, hi = self.stats_span
             if _span_bytes(lo, hi) <= _narrow_max_k(width):
                 return None
         _check_plain_sizes(self.pages, width)
-        total_out = 0
-        n_ops_total = 0
-        plans = []
+        specs = []
+        sizes = []
+        lazy_out = comp_bytes = 0
         for p in self.pages:
             if p.comp is not None:
                 payload, _codec, ulen = p.comp
@@ -1318,103 +1914,42 @@ class _ChunkAssembler:
                     # diagnostics raise (same reject set as the planner)
                     p.materialize()
                     return None
-                plans.append((p, r, ulen))
-                n_ops_total += len(r[0])
-                total_out += ulen
+                specs.append(("comp", payload, ulen, r))
+                sizes.append(ulen)
+                lazy_out += ulen
+                comp_bytes += len(payload)
             else:
                 nbytes = len(p.raw) - p.value_pos
-                plans.append((p, None, nbytes))
-                n_ops_total += 1
-                total_out += nbytes
-        if n_ops_total == 0 or n_ops_total > _SNAPPY_MAX_OPS:
-            return None
+                # staged segment: the raw value bytes for already-
+                # materialized pages (one synthetic literal op each)
+                specs.append(("raw", p.raw, p.value_pos, nbytes))
+                sizes.append(nbytes)
         # worth-it gate (measured on v5e): shipping compressed pays for the
         # device-side resolve whenever the stream actually compressed; at
         # ratio ~1 the only win is the skipped host decompress, which beats
         # the resolve cost on small chunks but loses on multi-strip ones
-        lazy_out = sum(size for _, r, size in plans if r is not None)
-        comp_bytes = sum(len(p.comp[0]) for p, r, _ in plans if r is not None)
-        if (lazy_out > 0 and comp_bytes > (1 - 0.08) * lazy_out
+        if (lazy_out > 0 and comp_bytes > SNAPPY_WORTH_RATIO * lazy_out
                 and lazy_out > _SNAPPY_SMALL_OUT):
             return None
-        out_pad = _bucket_bytes(total_out + 8, 8)
-        # staged segments: the COMPRESSED payload for lazy pages (that is the
-        # whole point), the raw value bytes for already-materialized ones
-        segs = [
-            (p.comp[0], 0, len(p.comp[0])) if r is not None
-            else (p.raw, p.value_pos, size)
-            for p, r, size in plans
-        ]
-        if (stager.total + sum(s[2] for s in segs) + 13 * n_ops_total
-                + out_pad > (np.iinfo(np.int32).max >> 1)):
-            return None  # i32 source/table math would overflow
-        bases = stager.add_segments(segs)
-        ends = np.empty(n_ops_total, np.int64)
-        asrc = np.empty(n_ops_total, np.int64)
-        offs = np.zeros(n_ops_total, np.int32)
-        islit = np.empty(n_ops_total, np.uint8)
-        vbase = np.zeros(len(plans), np.int64)
-        vstart = np.zeros(len(plans) + 1, np.int64)
-        at = 0
-        out_base = 0
-        max_depth = 0
-        for i, (p, r, size) in enumerate(plans):
-            vbase[i] = out_base  # value_pos == 0 on every lazy-eligible page
-            vstart[i + 1] = vstart[i] + p.defined
-            if r is None:
-                ends[at] = out_base + size
-                asrc[at] = bases[i]
-                islit[at] = 1
-                at += 1
-            else:
-                dst_end, op_src, is_lit_p, depth = r
-                n = len(dst_end)
-                ends[at : at + n] = dst_end + out_base
-                # literal: absolute staged position of the run's payload;
-                # copy: chunk-out source base  dst_start - offset
-                starts = np.empty(n, np.int64)
-                starts[0] = 0
-                starts[1:] = dst_end[:-1]
-                asrc[at : at + n] = np.where(
-                    is_lit_p != 0, op_src + bases[i],
-                    out_base + starts - op_src,
-                )
-                offs[at : at + n] = np.where(is_lit_p != 0, 1, op_src)
-                islit[at : at + n] = is_lit_p
-                at += n
-                max_depth = max(max_depth, depth)
-            out_base += size
-        iters = next(
-            (b for b in _SNAPPY_ITER_BUCKETS
-             if (1 << b) >= max_depth + 1), _SNAPPY_ITER_BUCKETS[-1]
-        ) if max_depth > 0 else 0
-        n_ops_pad = _bucket(n_ops_total)
-        pages_pad = _bucket(len(plans))
-        ends_t = np.full(n_ops_pad, out_pad, np.int32)
-        ends_t[:n_ops_total] = ends
-        asrc_t = np.zeros(n_ops_pad, np.int32)
-        asrc_t[:n_ops_total] = asrc
-        offs_t = np.ones(n_ops_pad, np.int32)
-        offs_t[:n_ops_total] = offs
-        islit_t = np.ones(n_ops_pad, np.uint8)
-        islit_t[:n_ops_total] = islit
-        vbase_t = np.zeros(pages_pad, np.int32)
-        vbase_t[: len(plans)] = vbase
-        vstart_t = np.full(pages_pad + 1, vstart[-1], np.int32)
-        vstart_t[: len(plans) + 1] = vstart
-        tbase = _pack_tables(
-            stager, [ends_t, asrc_t, offs_t, islit_t, vbase_t, vstart_t]
-        )
-        defined = int(vstart[-1])
+        # out-space bases: value_pos == 0 on lazy pages (parse contract)
+        vbase_t, vstart_t, pages_pad, defined = _fixed_value_tables(
+            sizes, [p.defined for p in self.pages])
+        info = _plan_snappy_ops(stager, specs,
+                                extra_tables=[vbase_t, vstart_t])
+        if info is None:
+            return None
         count = _bucket_count(defined)
-        self.pages_kept_compressed = len([1 for _, r, _ in plans if r])
+        self.pages_kept_compressed = len(
+            [1 for s in specs if s[0] == "comp"])
+        self._record_ship(ROUTE_DEVICE_SNAPPY, defined * width, info.shipped)
+        n_ops, out_pad, iters = info.n_ops, info.out_pad, info.iters
         return _Plan(
-            ("snappy", n_ops_pad, out_pad, iters, name, count, pages_pad),
+            ("snappy", n_ops, out_pad, iters, name, count, pages_pad),
             lambda buf, tbase_d: _snappy_plain_staged_jit(
-                buf, tbase_d, n_ops=n_ops_pad, out_pad=out_pad,
+                buf, tbase_d, n_ops=n_ops, out_pad=out_pad,
                 iters=iters, dtype=name, count=count, n_pages=pages_pad,
             ),
-            (np.int64(tbase),),
+            (np.int64(info.tbase),),
             lambda v: DeviceColumnData(values=v, n_values=defined, **common),
         )
 
@@ -1429,7 +1964,10 @@ class _ChunkAssembler:
         touching these bytes (decompress), so one extra vectorized pass
         (min/max + truncating copy) buys a (width-k)/width transfer cut; the
         device widens and re-biases in one fused kernel (_plain_narrow_jit).
-        Returns None (caller takes the plain path) when the span probe shows
+        Under ship.py's ROUTE_NARROW_SNAPPY the truncated buffer is
+        additionally snappy-compressed — narrow residuals are low-entropy,
+        so the two transfer cuts multiply (_snappy_narrow_staged_jit).
+        Returns None (caller takes the next route) when the span probe shows
         < _NARROW_SAVE_BYTES savings, so full-range data pays only a 64k-value
         probe, not a full scan.
         """
@@ -1440,36 +1978,40 @@ class _ChunkAssembler:
         defined = sum(p.defined for p in self.pages)
         if defined == 0 or not native.available():
             return None
-
-        # int64 must save >= 3 bytes/value, int32 >= 2 (half the width)
-        max_k = _narrow_max_k(width)
-        probe = next(p for p in self.pages if p.defined)
-        head = native.int_minmax(
-            probe.raw, probe.value_pos, min(probe.defined, _NARROW_PROBE),
-            width,
-        )
-        if _span_bytes(*head) > max_k:
-            return None
-        mms = [native.int_minmax(p.raw, p.value_pos, p.defined, width)
-               for p in self.pages if p.defined]
-        mn = min(m[0] for m in mms)
-        mx = max(m[1] for m in mms)
-        k = _span_bytes(mn, mx)
-        if k > max_k:
-            return None
-        # one truncating pass per page, written straight into a single dense
-        # buffer: (v - min) mod 2^width wraps to a value that fits k bytes by
-        # construction (negative minima included)
-        out = np.empty(defined * k, dtype=np.uint8)
-        at = 0
-        for p in self.pages:
-            native.int_truncate(p.raw, p.value_pos, p.defined, width, mn, k,
-                                out[at:])
-            at += p.defined * k
+        if "narrow" in self._ship:
+            art = self._ship["narrow"]
+            if art is None:
+                return None  # preship already scanned and declined
+            k, mn, out, comp = art
+        else:
+            trans = self._narrow_host_transcode(width)
+            if trans is None:
+                return None
+            k, mn, out = trans
+            comp = (self._try_snappy(out) if self._narrow_compress else None)
         count = _bucket_count(defined)
+        bias = np.int32(mn) if name == "int32" else np.int64(mn)
+        if comp is not None:
+            info = _plan_snappy_ops(
+                stager, [("comp", comp, out.nbytes, None)])
+            if info is not None:
+                self.pages_kept_compressed = len(self.pages)
+                self._record_ship(ROUTE_NARROW_SNAPPY, defined * width,
+                                  info.shipped)
+                n_ops, out_pad, iters = info.n_ops, info.out_pad, info.iters
+                return _Plan(
+                    ("narrows", k, name, count, n_ops, out_pad, iters),
+                    lambda buf, tb_d, bias_d: _snappy_narrow_staged_jit(
+                        buf, tb_d, bias_d, n_ops=n_ops, out_pad=out_pad,
+                        iters=iters, k=k, dtype=name, count=count),
+                    (np.int64(info.tbase), bias),
+                    lambda v: DeviceColumnData(values=v, n_values=defined,
+                                               **common),
+                )
+            # op planning fell through: ship the narrow bytes uncompressed
         base = stager.add(out)
         stager.note_read_extent(base, count * k)
-        bias = np.int32(mn) if name == "int32" else np.int64(mn)
+        self._record_ship(ROUTE_NARROW, defined * width, out.nbytes)
         return _Plan(
             ("narrow", k, name, count),
             lambda buf, base_d, bias_d: _plain_narrow_jit(
@@ -1529,57 +2071,131 @@ class _ChunkAssembler:
 
     def _finish_plain_bytes(self, common, stager):
         """PLAIN BYTE_ARRAY chunk: host walks only the length prefixes
-        (native, no copies); the raw streams + lengths stage and the heap
+        (native, no copies); the streams + lengths stage and the heap
         compaction/offset cumsum run on device (_plain_bytes_pages_jit).
-        Falls back to the round-2 host-decode staging when the native
-        library is unavailable."""
+
+        Value streams ship by the planner's route (ship.py): the file's own
+        snappy payloads (ROUTE_DEVICE_SNAPPY), a host snappy re-compression
+        of the walked spans (ROUTE_RECOMPRESS, prepared by preship on the
+        decompress pool), or the raw spans (plain).  Byte-array heaps are
+        the dominant mover on string-heavy schemas (lineitem16), so this is
+        where compressed shipping pays most.  Falls back to the round-2
+        host-decode staging when the native library is unavailable."""
         from . import native
 
-        lens_l, span_l = [], []
-        for p in self.pages:
-            # whole page buffer + offset: no host copy of the value stream
-            res = native.bytearray_lengths(p.raw, p.defined, pos=p.value_pos)
-            if res is None:
-                return self._finish_plain_bytes_host(common, stager)
-            if isinstance(res, int):
-                if res == -20:
-                    raise ParquetError("byte array: truncated length prefix")
-                raise ParquetError("byte array: length exceeds buffer")
-            lens, end = res
-            lens_l.append(lens)
-            span_l.append(end - p.value_pos)
+        if self._bytes_walk is not None:
+            lens_l, span_l = self._bytes_walk
+        else:
+            lens_l, span_l = [], []
+            for p in self.pages:
+                # whole page buffer + offset: no host copy of the stream
+                p.peek()
+                res = native.bytearray_lengths(p.raw, p.defined,
+                                               pos=p.value_pos)
+                if res is None:
+                    return self._finish_plain_bytes_host(common, stager)
+                if isinstance(res, int):
+                    if res == -20:
+                        raise ParquetError(
+                            "byte array: truncated length prefix")
+                    raise ParquetError("byte array: length exceeds buffer")
+                lens, end = res
+                lens_l.append(lens)
+                span_l.append(end - p.value_pos)
         n = sum(p.defined for p in self.pages)
-        # stage exactly the walked stream spans, back to back
-        bases = stager.add_segments([
-            (p.raw, p.value_pos, c) for p, c in zip(self.pages, span_l)
-        ])
+        logical = sum(span_l)
         count_pad = _bucket_count(n)
         lens_all = (np.concatenate(lens_l) if lens_l
                     else np.zeros(0, np.uint32))
         total_heap = int(lens_all.astype(np.int64).sum())
-        # zero-filled reserve: pad values past n must read length 0
-        lens_base = stager.add(lens_all, reserve=count_pad * 4)
         heap_pad = _bucket_bytes(max(total_heap, 1), 64)
         n_pages = _bucket(len(self.pages))
-        page_base = np.zeros(n_pages, dtype=np.int64)
-        page_base[: len(bases)] = bases
         pvs = np.full(n_pages + 1, n, dtype=np.int32)
         pvs[0] = 0
         np.cumsum([p.defined for p in self.pages],
                   out=pvs[1 : len(self.pages) + 1])
-        tbase = _pack_tables(stager, [page_base, pvs])
 
         def build(res):
             offsets, heap = res
             return DeviceColumnData(offsets=offsets, heap=heap, n_values=n,
                                     **common)
 
+        plan = self._plan_snappy_bytes(
+            stager, span_l, pvs, count_pad, heap_pad, n_pages, lens_all,
+            logical, build)
+        if plan is not None:
+            return plan
+        # plain route: stage exactly the walked stream spans, back to back
+        for p in self.pages:
+            p.materialize()
+        bases = stager.add_segments([
+            (p.raw, p.value_pos, c) for p, c in zip(self.pages, span_l)
+        ])
+        # zero-filled reserve: pad values past n must read length 0
+        lens_base = stager.add(lens_all, reserve=count_pad * 4)
+        page_base = np.zeros(n_pages, dtype=np.int64)
+        page_base[: len(bases)] = bases
+        tbase = _pack_tables(stager, [page_base, pvs])
+        self._record_ship(ROUTE_PLAIN, logical, logical)
         return _Plan(
             ("bytes", count_pad, heap_pad, n_pages),
             lambda buf, lb_d, tb_d: _plain_bytes_staged_jit(
                 buf, lb_d, tb_d, count_pad=count_pad, heap_pad=heap_pad,
                 n_pages=n_pages),
             (np.int64(lens_base), np.int64(tbase)),
+            build,
+        )
+
+    def _plan_snappy_bytes(self, stager, span_l, pvs, count_pad, heap_pad,
+                           n_pages, lens_all, logical, build):
+        """Compressed-shipping half of _finish_plain_bytes: build the op
+        tables for whichever compressed payloads exist (the file's own, or
+        preship's re-compression) and wire _snappy_bytes_staged_jit.
+        Returns None when no compressed route applies or planning falls
+        through — the caller stages the raw spans."""
+        route = None
+        specs = None
+        if (any(p.comp is not None for p in self.pages)
+                and self._route_enabled(ROUTE_DEVICE_SNAPPY)):
+            comp_total = sum(len(p.comp[0]) for p in self.pages
+                             if p.comp is not None)
+            # ratio ~1: the op tables + resolve buy nothing — ship raw
+            if comp_total <= SNAPPY_WORTH_RATIO * max(logical, 1):
+                route = ROUTE_DEVICE_SNAPPY
+                specs = [
+                    ("comp", p.comp[0], p.comp[2], None)
+                    if p.comp is not None
+                    else ("raw", p.raw, p.value_pos, span)
+                    for p, span in zip(self.pages, span_l)
+                ]
+        elif self._ship.get("recompress_bytes") is not None:
+            route = ROUTE_RECOMPRESS
+            specs = [
+                ("comp", c, span, None)
+                for c, span in zip(self._ship["recompress_bytes"], span_l)
+            ]
+        if specs is None:
+            return None
+        out_lens = [s[2] if s[0] == "comp" else s[3] for s in specs]
+        page_out = np.zeros(n_pages, dtype=np.int64)
+        page_out[: len(specs)] = np.concatenate(
+            [[0], np.cumsum(out_lens)[:-1]])
+        info = _plan_snappy_ops(stager, specs,
+                                extra_tables=[page_out, pvs])
+        if info is None:
+            return None
+        # zero-filled reserve: pad values past n must read length 0
+        lens_base = stager.add(lens_all, reserve=count_pad * 4)
+        self.pages_kept_compressed = len(
+            [1 for s in specs if s[0] == "comp"])
+        self._record_ship(route, logical, info.shipped)
+        n_ops, out_pad, iters = info.n_ops, info.out_pad, info.iters
+        return _Plan(
+            ("bytess", count_pad, heap_pad, n_pages, n_ops, out_pad, iters),
+            lambda buf, lb_d, tb_d: _snappy_bytes_staged_jit(
+                buf, lb_d, tb_d, count_pad=count_pad, heap_pad=heap_pad,
+                n_ops=n_ops, out_pad=out_pad, iters=iters, n_pages=n_pages),
+            (np.int64(lens_base), np.int64(info.tbase)),
             build,
         )
 
@@ -1764,19 +2380,48 @@ class _ChunkAssembler:
         # fused call's outputs, one sync at finalize); bucketing tail lanes
         # are zeroed by n_valid, so the max reflects only real indices
         need_max = bool(prefix) and host_max is None
+        ship = self._dict_ship  # (route, payload, out_len) or None: ship.py
         if has_u8:
             # dictionary bytes ride the row-group buffer (no extra transfer);
             # the row count is bucketed so the slice/gather executables are
             # shared across chunks with different dict sizes
             dict_kp = _bucket(max(self.dict_len, 1))
             dict_itemsize = int(dict_u8.shape[1])
-            # zero-filled reserve (NOT a read-extent overlap): clamped
-            # out-of-range gathers on the deferred-check path must see zeros,
-            # never a neighboring chunk's staged bytes
-            dict_base = stager.add(np.ascontiguousarray(dict_u8),
-                                   reserve=dict_kp * dict_itemsize)
-            dyn.append(np.int64(dict_base))
-            dkey = ("du8", dict_kp, dict_itemsize)
+            du8_fn = None
+            if ship is not None:
+                info = _plan_snappy_ops(
+                    stager, [("comp", ship[1], ship[2], None)])
+                if info is not None:
+                    # value table shipped compressed; the device gathers the
+                    # bucketed rows out of the stream's output space.  Rows
+                    # past dict_len resolve through padded ops (staged byte
+                    # 0) — unlike the plain route's zero reserve they are
+                    # garbage, but the deferred range check raises at
+                    # finalize before a clamped gather can escape.
+                    self._record_ship(ship[0], dict_u8.nbytes, info.shipped)
+                    dyn.append(np.int64(info.tbase))
+                    dkey = ("du8s", dict_kp, dict_itemsize, info.n_ops,
+                            info.out_pad, info.iters)
+                    _i = info
+
+                    def du8_fn(buf, tb):
+                        return _snappy_gather_staged_jit(
+                            buf, tb, n_ops=_i.n_ops, out_pad=_i.out_pad,
+                            iters=_i.iters,
+                            nbytes=dict_kp * dict_itemsize,
+                        ).reshape(dict_kp, dict_itemsize)
+            if du8_fn is None:
+                # zero-filled reserve (NOT a read-extent overlap): clamped
+                # out-of-range gathers on the deferred-check path must see
+                # zeros, never a neighboring chunk's staged bytes
+                dict_base = stager.add(np.ascontiguousarray(dict_u8),
+                                       reserve=dict_kp * dict_itemsize)
+                dyn.append(np.int64(dict_base))
+                dkey = ("du8", dict_kp, dict_itemsize)
+
+                def du8_fn(buf, tb):
+                    return _dict_rows_jit(buf, tb, k=dict_kp,
+                                          itemsize=dict_itemsize)
         else:
             # ragged (string) dictionaries ride the buffer too — two
             # jnp.asarray transfers per chunk otherwise dominate dict-heavy
@@ -1787,24 +2432,45 @@ class _ChunkAssembler:
             roff_base = stager.add(roff, reserve=roff_n * 8)
             rheap = np.ascontiguousarray(self.dict_ragged.heap)
             rheap_room = _bucket_bytes(max(rheap.nbytes, 1), 64)
-            rheap_base = stager.add(rheap, reserve=rheap_room)
-            dyn.extend((np.int64(roff_base), np.int64(rheap_base)))
-            dkey = ("drag", roff_n, rheap_room)
+            dheap_fn = None
+            if ship is not None:
+                info = _plan_snappy_ops(
+                    stager, [("comp", ship[1], ship[2], None)])
+                if info is not None:
+                    # heap shipped compressed (offsets stay plain — tiny);
+                    # bytes past the real heap resolve through padded ops,
+                    # same garbage contract as the plain route's padding
+                    self._record_ship(ship[0], rheap.nbytes, info.shipped)
+                    dyn.extend((np.int64(roff_base), np.int64(info.tbase)))
+                    dkey = ("drags", roff_n, rheap_room, info.n_ops,
+                            info.out_pad, info.iters)
+                    _i = info
+
+                    def dheap_fn(buf, hb):
+                        return _snappy_gather_staged_jit(
+                            buf, hb, n_ops=_i.n_ops, out_pad=_i.out_pad,
+                            iters=_i.iters, nbytes=rheap_room,
+                        )
+            if dheap_fn is None:
+                rheap_base = stager.add(rheap, reserve=rheap_room)
+                dyn.extend((np.int64(roff_base), np.int64(rheap_base)))
+                dkey = ("drag", roff_n, rheap_room)
+
+                def dheap_fn(buf, hb):
+                    return _dynslice_jit(buf, hb, size=rheap_room)
 
         def fn(buf, *d):
             idx = idx_fn(buf, *d[:idx_arity])
             outs = {"idx": idx}
             if has_u8:
-                outs["du8"] = _dict_rows_jit(buf, d[idx_arity], k=dict_kp,
-                                             itemsize=dict_itemsize)
+                outs["du8"] = du8_fn(buf, d[idx_arity])
             else:
                 # device slices of the staged ragged dictionary (padding
                 # past the real offsets is garbage consumers never index:
                 # every valid dict index is < dict_len)
                 outs["doff"] = _plain_jit(buf, d[idx_arity], dtype="int64",
                                           count=roff_n)
-                outs["dheap"] = _dynslice_jit(buf, d[idx_arity + 1],
-                                              size=rheap_room)
+                outs["dheap"] = dheap_fn(buf, d[idx_arity + 1])
             if need_max:
                 outs["max"] = _max_jit(idx)
             return outs
@@ -2103,13 +2769,15 @@ def _collect_chunk(
 
     asm = _ChunkAssembler(leaf, deferred_checks)
     asm.stats_span = _int_stats_span(statistics, leaf)
+    asm.alloc = alloc
     data_ordinal = 0
-    # fixed-width PLAIN SNAPPY chunks can skip host decompression entirely
-    # (device-side expansion, _plan_device_snappy); parse_data_page applies
-    # the per-page structural conditions (PLAIN encoding, levels outside the
-    # compressed region)
+    # PLAIN SNAPPY chunks (fixed-width AND byte-array) can skip host
+    # decompression entirely (device-side expansion — _plan_device_snappy /
+    # _plan_snappy_bytes); parse_data_page applies the per-page structural
+    # conditions (PLAIN encoding, levels outside the compressed region)
     lazy = (codec == CompressionCodec.SNAPPY
-            and leaf.physical_type in _PTYPE_TO_NAME
+            and (leaf.physical_type in _PTYPE_TO_NAME
+                 or leaf.physical_type == Type.BYTE_ARRAY)
             and os.environ.get("TPQ_DEVICE_SNAPPY", "1") != "0")
     if lazy:
         from . import native
@@ -2126,6 +2794,12 @@ def _collect_chunk(
             raw = decompress_block(payload, codec, header.uncompressed_page_size)
             dh = header.dictionary_page_header
             asm.set_dictionary(raw, dh.encoding, dh.num_values or 0)
+            if codec == CompressionCodec.SNAPPY:
+                # keep the compressed payload: the ship planner may send the
+                # dictionary VALUE TABLE over the link compressed and expand
+                # it on device (_preship_dict / _finish_dict)
+                asm.dict_comp = (payload,
+                                 max(header.uncompressed_page_size or 0, 0))
             continue
         if pt in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
             if skip_pages and data_ordinal in skip_pages:
@@ -2189,6 +2863,7 @@ def decode_chunk_batched(
             values=jnp.asarray(np.zeros(0, dtype=np.int64)),
             max_def=leaf.max_def, max_rep=leaf.max_rep, num_leaf_slots=0,
         )
+    asm.preship()
     stager = _RowGroupStager()
     plan = asm.finish(stager)
     return _run_plans([("c", plan)], stager.stage())["c"]
@@ -2211,6 +2886,28 @@ class ReaderStats:
     host_seconds: float = 0.0      # decompress + structure parse + assembly
     device_seconds: float = 0.0    # stage + dispatch (not queue drain)
     wall_seconds: float = 0.0
+    # ship-planner accounting (ship.py): per-route stream counts and byte
+    # totals.  `logical` is what plain shipping would have moved; `shipped`
+    # what the chosen route actually registered for transfer — the
+    # difference IS the link-byte win the round-5 VERDICT prescribed.
+    route_streams: dict = field(default_factory=dict)
+    route_bytes_logical: dict = field(default_factory=dict)
+    route_bytes_shipped: dict = field(default_factory=dict)
+
+    def count_route(self, route: str, logical: int, shipped: int) -> None:
+        self.route_streams[route] = self.route_streams.get(route, 0) + 1
+        self.route_bytes_logical[route] = (
+            self.route_bytes_logical.get(route, 0) + logical)
+        self.route_bytes_shipped[route] = (
+            self.route_bytes_shipped.get(route, 0) + shipped)
+
+    @property
+    def link_bytes_logical(self) -> int:
+        return sum(self.route_bytes_logical.values())
+
+    @property
+    def link_bytes_shipped(self) -> int:
+        return sum(self.route_bytes_shipped.values())
 
     @property
     def rows_per_sec(self) -> float:
@@ -2234,6 +2931,14 @@ class ReaderStats:
             "rows": self.rows,
             "compressed_bytes": self.compressed_bytes,
             "staged_bytes": self.staged_bytes,
+            "link_bytes_logical": self.link_bytes_logical,
+            "link_bytes_shipped": self.link_bytes_shipped,
+            "ship_routes": {
+                r: {"streams": self.route_streams[r],
+                    "logical": self.route_bytes_logical.get(r, 0),
+                    "shipped": self.route_bytes_shipped.get(r, 0)}
+                for r in sorted(self.route_streams)
+            },
             "host_seconds": round(self.host_seconds, 6),
             "device_seconds": round(self.device_seconds, 6),
             "wall_seconds": round(self.wall_seconds, 6),
@@ -2305,6 +3010,9 @@ class DeviceFileReader:
         self._stats = ReaderStats()
         self._stats_lock = __import__("threading").Lock()
         self._t0: float | None = None
+        # link-byte ship planner (ship.py): per-reader so env overrides
+        # (TPQ_FORCE_ROUTE, TPQ_LINK_MBPS) bind at open time
+        self._ship_planner = ShipPlanner()
 
     def close(self):
         self._host.close()
@@ -2557,6 +3265,8 @@ class DeviceFileReader:
                     statistics=md.statistics,
                     skip_pages=(skip_pages or {}).get(path),
                 )
+                if asm is not None:
+                    asm.preship(self._ship_planner, self._pipe_stats)
             if asm is not None:
                 self._stats.pages += len(asm.pages)
                 self._stats.pages_pruned += asm.pages_pruned
@@ -2573,6 +3283,8 @@ class DeviceFileReader:
                 continue
             plans.append((name, asm.finish(stager)))
             self._stats.pages_device_expanded += asm.pages_kept_compressed
+            for route, logical, shipped in asm.ship_records:
+                self._stats.count_route(route, logical, shipped)
         # every selected leaf must have a chunk in the row group (host
         # FileReader parity — reader.py read_row_group's missing check)
         seen = set(out) | {name for name, _ in plans}
@@ -2942,6 +3654,11 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0):
                 validate_crc=r.validate_crc, alloc=tracker,
                 statistics=md.statistics, skip_pages=skip,
             )
+        # ship planning on the SAME worker thread (outside the decompress
+        # timer: its compression seconds land in the `recompress` stage) —
+        # the link-recompression work overlaps the consumer's stage/dispatch
+        if asm is not None:
+            asm.preship(r._ship_planner, stats)
         stats.count_chunk()
         return (id(r), i), p, (md, asm)
 

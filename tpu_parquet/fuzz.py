@@ -357,6 +357,76 @@ def fuzz_snappy_plan(data: bytes) -> None:
         raise AssertionError("plan resolution diverges from decompress")
 
 
+def fuzz_snappy_ops(data: bytes) -> None:
+    """Fuzz target #13: hostile compressed streams against the op-table ship
+    planner (the surface every compressed-shipping route trusts — ship.py).
+
+    Beyond fuzz_snappy_plan's host-resolver output differential, this target
+    asserts the STRUCTURAL invariants the device resolver
+    (jax_kernels.snappy_resolve) assumes of every ACCEPTED plan:
+
+    - ``dst_end`` strictly increasing, ending exactly at the stream's
+      declared output size (monotonicity is what searchsorted needs);
+    - literal sources within the compressed payload;
+    - copy offsets ``1 <= off <= dst_start`` (overlapping RLE-style copies
+      included — the mod-form source math relies on it);
+    - chain depth within [0, n_ops] and op count within the n/2+2 bound
+      (the cap-retry path in native.snappy_plan);
+    - a DECLARED-SIZE LIE (first fuzz byte perturbs the expect argument)
+      must be rejected exactly like the decompressor's bomb guard.
+
+    Any violated invariant would make the device expansion read garbage
+    silently — the resolver has no bounds it can raise from.
+    """
+    from . import native
+
+    if not native.available() or len(data) < 1:
+        return
+    bias = data[0] % 5 - 2  # perturb the declared size by -2..+2
+    payload = data[1:]
+    try:
+        out = native.snappy_decompress(payload, max_size=1 << 20)
+        ulen = len(out)
+        dec_ok = True
+    except (ValueError, RuntimeError):
+        ulen = 1 << 10
+        dec_ok = False
+    expect = max(ulen + bias, 0)  # clamped: what the planner is actually told
+    plan = native.snappy_plan(payload, expect)
+    plan_ok = not isinstance(plan, int) and plan is not None
+    # a negative bias on an empty stream clamps back to the true size — the
+    # planner legitimately accepts that call, so the oracle must too
+    want_ok = dec_ok and expect == ulen
+    if plan_ok != want_ok:
+        raise AssertionError(
+            f"plan acceptance mismatch: plan_ok={plan_ok} dec_ok={dec_ok} "
+            f"bias={bias}")
+    if not plan_ok:
+        return
+    dst_end, op_src, is_lit, depth = plan
+    n_ops = len(dst_end)
+    if n_ops > len(payload) // 2 + 2:
+        raise AssertionError(f"op count {n_ops} above the n/2+2 bound")
+    if not 0 <= depth <= max(n_ops, 1):
+        raise AssertionError(f"chain depth {depth} outside [0, {n_ops}]")
+    pos = 0
+    for e, s, lit in zip(dst_end, op_src, is_lit):
+        e, s = int(e), int(s)
+        if e <= pos:
+            raise AssertionError(f"dst_end not increasing at {pos}: {e}")
+        run = e - pos
+        if lit:
+            if s < 0 or s + run > len(payload):
+                raise AssertionError(
+                    f"literal source [{s}, {s + run}) outside payload")
+        else:
+            if not 1 <= s <= pos:
+                raise AssertionError(f"copy offset {s} at pos {pos}")
+        pos = e
+    if pos != ulen:
+        raise AssertionError(f"plan output {pos} != declared {ulen}")
+
+
 def fuzz_narrow(data: bytes) -> None:
     """Narrow-int transcode differential (the round-4 transfer-cut path):
     minmax + k-byte truncate + widen-and-rebias must reconstruct the source
@@ -468,6 +538,7 @@ TARGETS = {
     "page_header": fuzz_page_header,
     "snappy": fuzz_snappy,
     "snappy_plan": fuzz_snappy_plan,
+    "snappy_ops": fuzz_snappy_ops,
     "narrow": fuzz_narrow,
     "loader_state": fuzz_loader_state,
 }
@@ -476,6 +547,44 @@ TARGETS = {
 # ---------------------------------------------------------------------------
 # seeds + mutation
 # ---------------------------------------------------------------------------
+
+def _uvarint(v: int) -> bytes:
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def crafted_snappy_streams() -> "list[bytes]":
+    """Hand-crafted raw-snappy streams for the snappy_ops target (and its
+    checked-in corpus blobs): the hostile shapes the op-table planner must
+    survive — no compressor in this repo emits them, so only crafting
+    covers them."""
+    # deep offset-1 overlap chain: 1 literal byte then 50 copies each
+    # reading the bytes the PREVIOUS copy just wrote (max chain depth ~50,
+    # the pointer-doubling resolver's worst shape per op count)
+    deep = bytearray(_uvarint(1 + 50 * 60))
+    deep += b"\x00x"  # literal len 1: 'x'
+    for _ in range(50):
+        deep += bytes([((60 - 1) << 2) | 2, 1, 0])  # kind-2 copy len 60 off 1
+    # out-of-range copy: offset 5 with only 1 output byte written — the
+    # decompressor rejects; the planner must reject identically
+    oor = _uvarint(5) + b"\x00x" + bytes([((4 - 1) << 2) | 2, 5, 0])
+    # kind-3 copy (4-byte little-endian offset, > 64 KiB back): a tag no
+    # in-tree compressor emits
+    lit = (bytes(range(256)) * 274)[:70000]
+    big = bytearray(_uvarint(70064))
+    big += bytes([62 << 2]) + (70000 - 1).to_bytes(3, "little") + lit
+    big += bytes([((64 - 1) << 2) | 3]) + (65540).to_bytes(4, "little")
+    # op-count pressure: 2000 one-byte literals — far past the planner's
+    # starting table cap (max(n/32, 64)), forcing the ERR_CAP retry path
+    many = bytearray(_uvarint(2000))
+    for i in range(2000):
+        many += bytes([0x00, i & 0xFF])
+    return [bytes(deep), oor, bytes(big), bytes(many)]
+
 
 def _seed_inputs(target: str) -> list[bytes]:
     """Valid inputs for the target, built in-process (corpus seeds)."""
@@ -596,6 +705,11 @@ def _seed_inputs(target: str) -> list[bytes]:
             b"ab" * 2000,                            # offset-2 overlap copies
             b"",
         )]
+    if target == "snappy_ops":
+        return [b"\x02" + s for s in crafted_snappy_streams()] + [
+            # declared-size lie: bias +1 on a valid stream must reject
+            b"\x03" + crafted_snappy_streams()[0],
+        ]
     if target == "loader_state":
         from .data import checkpoint as ck
 

@@ -407,9 +407,22 @@ class ParsedDataPage:
 
     def materialize(self) -> bytes:
         if self.comp is not None:
+            self.peek()
+            self.comp = None
+        return self.raw
+
+    def peek(self) -> bytes:
+        """Decompressed bytes WITHOUT dropping the compressed payload.
+
+        The byte-array ship routes need both: the host walks length
+        prefixes over the decompressed stream, but the LINK still carries
+        the compressed payload (device-side expansion).  ``materialize()``
+        keeps its drop-the-payload semantics for routes that commit to
+        host bytes.
+        """
+        if self.comp is not None and len(self.raw) == 0:
             payload, codec, ulen = self.comp
             self.raw = decompress_block(payload, codec, ulen)
-            self.comp = None
         return self.raw
 
 

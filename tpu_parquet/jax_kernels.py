@@ -79,6 +79,7 @@ __all__ = [
     "row_starts_from_rep",
     "plain_decode_fixed",
     "byte_stream_split_decode",
+    "snappy_resolve",
 ]
 
 
@@ -466,3 +467,47 @@ def byte_stream_split_decode(buf: jax.Array, dtype: str, count: int):
     nbytes = jnp.dtype(dt).itemsize
     mat = buf[: count * nbytes].reshape(nbytes, count).T
     return jax.lax.bitcast_convert_type(mat, dt).reshape(count)
+
+
+def snappy_resolve(ends, asrc, offs, islit, *, out_pad: int, iters: int):
+    """Resolve snappy op tables into a per-output-byte SOURCE MAP.
+
+    The shared device half of every compressed-shipping route (PLAIN
+    fixed-width, narrow+snappy, byte-array heaps, dictionary tables — see
+    ``ship.py``): the host's tag walk (``native.snappy_plan``, packed by
+    ``device_reader._plan_snappy_ops``) describes each op's output extent;
+    this maps every position of the decompressed OUTPUT SPACE to the staged
+    buffer index holding its byte, without materializing the output:
+
+    1. per output byte, find its op (one searchsorted over ``ends``) and
+       compute a source: literal bytes point into the staged compressed
+       stream (>= 0); copy bytes encode their output-space source as
+       ``-(pos)-1`` using the periodic form
+       ``dst_start - offset + (i mod offset)``, which maps overlapping
+       (RLE-style) copies straight past their own op;
+    2. resolve copy chains by pointer doubling: ``iters`` rounds of
+       ``S = where(S >= 0, S, S[-S-1])`` — after ceil(log2(depth)) rounds
+       every byte points at a literal (the host computed the exact max
+       chain depth during the tag walk, so ``iters`` is a static bound,
+       no syncs).
+
+    All math is int32 (planners enforce the 2 GiB ceiling); positions past
+    the real output resolve through padded literal ops (source 0).  Returns
+    int32[out_pad] of staged-buffer byte indices.  Traced inside consuming
+    jits — not jitted here.
+    """
+    n_ops = ends.shape[0]
+    j = jnp.arange(out_pad, dtype=jnp.int32)
+    op = jnp.clip(jnp.searchsorted(ends, j, side="right").astype(jnp.int32),
+                  0, n_ops - 1)
+    start = jnp.where(op > 0, ends[jnp.maximum(op - 1, 0)], 0)
+    within = j - start
+    S = jnp.where(
+        islit[op] != 0,
+        asrc[op] + within,
+        -(asrc[op] + within % jnp.maximum(offs[op], 1)) - 1,
+    )
+    for _ in range(iters):
+        t = jnp.clip(-S - 1, 0, out_pad - 1)
+        S = jnp.where(S >= 0, S, S[t])
+    return S

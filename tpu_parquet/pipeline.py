@@ -52,7 +52,7 @@ from .alloc import InFlightBudget
 T = TypeVar("T")
 R = TypeVar("R")
 
-STAGES = ("io", "decompress", "stage", "dispatch", "finalize")
+STAGES = ("io", "decompress", "recompress", "stage", "dispatch", "finalize")
 
 
 class PipelineStats:
@@ -62,6 +62,10 @@ class PipelineStats:
 
     - ``io``          chunk byte reads from the source
     - ``decompress``  page decompress + CRC + structure parse + host decode
+    - ``recompress``  link recompression: snappy over hot streams so GZIP/
+                      ZSTD/uncompressed files still ship compressed
+                      (ship.py ROUTE_RECOMPRESS; runs on the same worker
+                      threads as decompress when prefetch > 0)
     - ``stage``       host→device staging (buffer assembly + transfer)
     - ``dispatch``    issuing the fused XLA calls
     - ``finalize``    deferred validity syncs

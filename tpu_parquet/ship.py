@@ -1,0 +1,226 @@
+"""Cost-based link-byte ship planner: choose HOW a chunk's bytes reach HBM.
+
+The whole device reader is engineered around one scarce resource — the
+host→device link (~hundreds of MB/s over the tunneled backend, vs GB/s for
+every host-side pass that could shrink the payload).  Until this module the
+"ship fewer bytes" decisions were scattered route gates inside
+``device_reader._ChunkAssembler``: device-snappy only for PLAIN fixed-width
+SNAPPY pages, narrow transcode only as its fallback, everything else shipped
+fully decompressed.  This module centralizes the decision as an explicit
+cost model over the five routes a chunk's value stream can take:
+
+===============  ============================================================
+route            what ships over the link
+===============  ============================================================
+plain            the decompressed host bytes, as-is
+narrow           ``(v - min)`` truncated to k bytes/value (PLAIN INT only)
+narrow_snappy    the narrow transcode, then snappy over the truncated bytes
+device_snappy    the file's own snappy page payloads, decompressed on device
+recompress       host re-compresses the stream to snappy, ships compressed
+===============  ============================================================
+
+Cost per route = host prep time + link time + device resolve time, each a
+bytes/throughput term.  Link bandwidth comes from ``TPQ_LINK_MBPS`` when set
+(bench.py exports its measured probe there); the host/device terms are
+calibrated constants, overridable for experiments.  The model only ROUTES —
+every route decodes bit-identically, so a mis-ranked route costs time, never
+correctness.
+
+``TPQ_FORCE_ROUTE=<route>`` pins the choice for deterministic CI and A/B
+debugging; infeasible forces (narrow on a float column, device_snappy on a
+gzip file) fall back to ``plain``.
+
+Per-route decisions and shipped-byte counters surface in
+``device_reader.ReaderStats`` (``ship_routes``, ``link_bytes_shipped``,
+``link_bytes_logical``) and ride the bench artifact.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+
+ROUTE_PLAIN = "plain"
+ROUTE_NARROW = "narrow"
+ROUTE_NARROW_SNAPPY = "narrow_snappy"
+ROUTE_DEVICE_SNAPPY = "device_snappy"
+ROUTE_RECOMPRESS = "recompress"
+ROUTES = (ROUTE_PLAIN, ROUTE_NARROW, ROUTE_NARROW_SNAPPY,
+          ROUTE_DEVICE_SNAPPY, ROUTE_RECOMPRESS)
+
+# link bandwidth the model assumes when TPQ_LINK_MBPS is absent: the tunneled
+# TPU link's typical mid-weather rate from the bench probes (BENCH_r05 logs
+# swing 93-1500 MB/s; 350 is the planning point the round-5 VERDICT used)
+DEFAULT_LINK_MBPS = 350.0
+# host-side throughputs (vectorized native passes; absolute values matter
+# less than their RATIO to the link — every term here is GB/s-class while
+# the link is hundreds of MB/s, which is the whole reason shrinking the
+# payload wins)
+HOST_TRANSCODE_MBPS = 2500.0   # min/max + truncating copy (native)
+HOST_COMPRESS_MBPS = 1500.0    # native snappy_compress
+HOST_DECOMPRESS_MBPS = 1400.0  # native snappy_decompress (lazy pages only)
+# device-side op-table resolve (searchsorted + pointer-doubling gathers over
+# the output space); HBM-bandwidth bound, charged per OUTPUT byte
+DEVICE_RESOLVE_MBPS = 3000.0
+# a compressed route must beat plain shipping by at least this ratio or the
+# builder falls through (the op tables + resolve cost eat thin wins)
+SNAPPY_WORTH_RATIO = 0.92
+# streams smaller than this never pay a recompression attempt: the op-table
+# fixed overhead rivals the payload
+MIN_COMPRESS_BYTES = 1 << 16
+# assumed compression ratios used only for RANKING (the builder measures the
+# real ratio and falls back when the estimate was wrong — a wrong guess
+# costs one GB/s-class host pass on the overlapped pool, never link bytes)
+EST_NARROW_SNAPPY_RATIO = 0.6  # narrow output: low-entropy residuals
+EST_RECOMPRESS_RATIO = 0.5     # strings/dates/ids under snappy
+
+
+@dataclass(frozen=True)
+class ChunkFacts:
+    """Everything the cost model needs to rank routes for one chunk.
+
+    ``logical`` is the decompressed value-stream byte count (what ``plain``
+    would ship); ``width`` the fixed value width (0 for byte-array/heap
+    streams); ``narrow_k`` the stats-hinted narrow byte width when chunk
+    Statistics prove the span fits (0 = unknown or infeasible);
+    ``narrow_possible`` whether a narrow PROBE is allowed when no hint
+    exists (int column + native library); ``comp_bytes`` the file's own
+    snappy payload bytes available to ship as-is (0 = none);
+    ``host_bytes_ready`` whether the decompressed host bytes already exist
+    (dictionary tables, level-carrying pages) — when False and
+    ``comp_bytes`` > 0, every host-bytes route additionally pays the
+    decompress the lazy pages skipped.
+    """
+
+    logical: int
+    width: int = 0
+    narrow_k: int = 0
+    narrow_possible: bool = False
+    comp_bytes: int = 0
+    native: bool = True
+    host_bytes_ready: bool = False
+
+
+class ShipPlanner:
+    """Ranks ship routes by modeled wall cost; builders execute in order.
+
+    One instance per reader (reads env at construction, so tests can flip
+    ``TPQ_FORCE_ROUTE``/``TPQ_LINK_MBPS`` per reader); stateless after
+    construction and safe to share across the prefetch pool's threads.
+    """
+
+    def __init__(self, link_mbps: "float | None" = None,
+                 force: "str | None" = None):
+        if link_mbps is None:
+            env = os.environ.get("TPQ_LINK_MBPS", "")
+            try:
+                link_mbps = float(env) if env else DEFAULT_LINK_MBPS
+            except ValueError:
+                link_mbps = DEFAULT_LINK_MBPS
+        self.link_mbps = max(float(link_mbps), 1.0)
+        if force is None:
+            force = os.environ.get("TPQ_FORCE_ROUTE", "").strip() or None
+        if force is not None and force not in ROUTES:
+            raise ValueError(
+                f"TPQ_FORCE_ROUTE={force!r} not one of {ROUTES}")
+        self.force = force
+
+    # -- cost terms (seconds) -------------------------------------------------
+
+    @staticmethod
+    def _t(nbytes: float, mbps: float) -> float:
+        return nbytes / (mbps * 1e6)
+
+    def _link(self, nbytes: float) -> float:
+        return self._t(nbytes, self.link_mbps)
+
+    def costs(self, f: ChunkFacts) -> dict:
+        """Modeled seconds per FEASIBLE route (infeasible routes absent).
+
+        Each route costs ``max(host lane, link lane, device lane)`` — the
+        overlapped pipeline (prefetch pool + staging worker + async
+        dispatch) runs host passes, transfers, and device resolves
+        CONCURRENTLY, so steady-state cost is the bottleneck lane, not
+        the sum.  The device lane (op-table resolve at HBM bandwidth) is
+        almost never the bottleneck but keeps pathological op-heavy
+        routes honest.
+
+        ``plain`` is always present, so ``min(costs, key=costs.get)`` is
+        total.  The narrow guess (no stats hint) only enters when no
+        compressed payload exists — with one, the legacy hint contract
+        applies: narrow claims the chunk only when Statistics prove the
+        span, so a lying-stats file costs at most a wasted decompress.
+        """
+        L = float(f.logical)
+        # every host-bytes route on a lazily-compressed chunk pays the
+        # decompress the lazy parse skipped (the device_snappy route's
+        # built-in win)
+        mat = (self._t(L, HOST_DECOMPRESS_MBPS)
+               if f.comp_bytes and not f.host_bytes_ready else 0.0)
+        resolve = self._t(L, DEVICE_RESOLVE_MBPS)
+        out = {ROUTE_PLAIN: max(mat, self._link(L))}
+        if L <= 0:
+            return out
+        k = f.narrow_k
+        if not k and f.narrow_possible and not f.comp_bytes:
+            k = max(f.width // 2, 1)  # optimistic probe guess
+        if k and f.width in (4, 8) and k < f.width:
+            narrowed = L * k / f.width
+            out[ROUTE_NARROW] = max(
+                mat + self._t(L, HOST_TRANSCODE_MBPS),
+                self._link(narrowed),
+            )
+            if f.native and narrowed >= MIN_COMPRESS_BYTES:
+                out[ROUTE_NARROW_SNAPPY] = max(
+                    mat + self._t(L, HOST_TRANSCODE_MBPS)
+                    + self._t(narrowed, HOST_COMPRESS_MBPS),
+                    self._link(narrowed * EST_NARROW_SNAPPY_RATIO),
+                    self._t(narrowed, DEVICE_RESOLVE_MBPS),
+                )
+        if f.comp_bytes and f.native:
+            out[ROUTE_DEVICE_SNAPPY] = max(
+                self._link(float(f.comp_bytes)), resolve)
+        if (not f.comp_bytes and f.native and L >= MIN_COMPRESS_BYTES):
+            out[ROUTE_RECOMPRESS] = max(
+                self._t(L, HOST_COMPRESS_MBPS),
+                self._link(L * EST_RECOMPRESS_RATIO),
+                resolve,
+            )
+        return out
+
+    def routes(self, f: ChunkFacts) -> list:
+        """Ordered candidate routes, cheapest modeled cost first.
+
+        Builders try them in order and fall through on infeasibility (op
+        caps, i32 ceilings, a ratio the estimate got wrong); ``plain`` —
+        the route that cannot fail — terminates the walk wherever it
+        ranks, so entries after it are dead fallbacks.
+        """
+        if self.force is not None:
+            return ([self.force, ROUTE_PLAIN] if self.force != ROUTE_PLAIN
+                    else [ROUTE_PLAIN])
+        c = self.costs(f)
+        return sorted(c, key=lambda r: (c[r], ROUTES.index(r)))
+
+    def decision_table(self, f: ChunkFacts) -> dict:
+        """Route → modeled milliseconds (README/debug surface)."""
+        return {r: round(t * 1e3, 3) for r, t in self.costs(f).items()}
+
+
+_default: "ShipPlanner | None" = None
+_default_lock = threading.Lock()
+
+
+def default_planner() -> ShipPlanner:
+    """Process-wide planner for callers without a reader (decode_chunk_batched
+    and the page-at-a-time paths).  Rebuilt when the routing env knobs change
+    so monkeypatched tests see their override."""
+    global _default
+    key = (os.environ.get("TPQ_LINK_MBPS", ""),
+           os.environ.get("TPQ_FORCE_ROUTE", ""))
+    with _default_lock:
+        if _default is None or getattr(_default, "_env_key", None) != key:
+            _default = ShipPlanner()
+            _default._env_key = key
+        return _default
