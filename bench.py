@@ -384,24 +384,34 @@ def probe_link(mb=64):
     return round(rate, 1)
 
 
-def bench_device(path, rows):
+def bench_device(path, rows, name=""):
     from tpu_parquet.device_reader import DeviceFileReader
+    from tpu_parquet.obs import StatsRegistry, Tracer
 
     _device_run(path)  # warm: XLA executables cached after this
     samples = device_reps(path, rows, REPS)
-    # observability counters from one instrumented pass (SURVEY.md §5.5),
-    # accumulated over every file of the config (multi-file nested scan).
+    # observability from one instrumented pass (SURVEY.md §5.5), accumulated
+    # over every file of the config (multi-file nested scan) into ONE
+    # obs.StatsRegistry tree (histograms + ship feedback included — the
+    # artifact carries the planner's predicted-vs-measured lane seconds).
     # The ship-planner counters (per-route link bytes — ship.py) prove the
     # link-byte cut from the artifact alone: `link_bytes_shipped` vs
     # `link_bytes_logical` is the transfer the planner removed.
+    # With TPQ_TRACE=<base> set, the instrumented pass additionally writes a
+    # Perfetto-loadable trace artifact per config at <base>.<config>.json.
     ship = {"link_bytes_shipped": 0, "link_bytes_logical": 0,
             "ship_routes": {}}
+    reg = StatsRegistry()
+    trace_base = (_TRACE_BASE if _TRACE_BASE is not None
+                  else os.environ.get("TPQ_TRACE", ""))
+    tracer = Tracer(path=f"{trace_base}.{name}.json") if trace_base else None
     for p in _bench_paths(path):
-        with DeviceFileReader(p) as r:
+        with DeviceFileReader(p, trace=tracer) as r:
             for cols in r.iter_row_groups():
                 pass
             d = r.stats().as_dict()
             log(f"  reader stats[{os.path.basename(p)}]: {d}")
+            reg.merge_from(r.obs_registry())
             ship["link_bytes_shipped"] += d["link_bytes_shipped"]
             ship["link_bytes_logical"] += d["link_bytes_logical"]
             for route, c in d["ship_routes"].items():
@@ -412,6 +422,9 @@ def bench_device(path, rows):
     if ship["link_bytes_logical"]:
         ship["link_bytes_ratio"] = round(
             ship["link_bytes_shipped"] / ship["link_bytes_logical"], 4)
+    if tracer is not None:
+        log(f"  trace artifact: {tracer.write(registry=reg)}")
+    ship["obs"] = reg.as_dict()
     return samples, ship
 
 
@@ -668,6 +681,7 @@ def bench_loader(path, rows, reps=None):
             times[k].append(dt)
             if k:
                 last_stats = loader.stats().as_dict()
+                last_obs = loader.obs_registry().as_dict()
     # MEDIAN of the interleaved reps on BOTH sides (the repo's symmetric-
     # estimator rule): best-of would hand the ratio to whichever depth got
     # the one quiet window on this weather-prone VM
@@ -677,6 +691,7 @@ def bench_loader(path, rows, reps=None):
         out[f"prefetch{k}_rows_per_sec"] = round(emitted / _median(times[k]), 1)
     out["decode_wait_seconds"] = last_stats["decode_wait_seconds"]
     out["window_peak_rows"] = last_stats["window_peak_rows"]
+    out["obs"] = last_obs  # registry tree (histograms incl.) for the artifact
     out["rows_emitted"] = emitted
     out["loader_speedup"] = round(out["prefetch0_s"] / out["prefetch4_s"], 3)
     # raw device scan of the identical columns: what the loader's shuffle +
@@ -813,8 +828,19 @@ def emit_results(record):
     print(line)
 
 
+_TRACE_BASE: "str | None" = None  # main() moves TPQ_TRACE here (see below)
+
+
 def main():
+    global _TRACE_BASE
     import jax
+
+    # Claim TPQ_TRACE for the per-config artifacts and UNSET it: left in the
+    # env it would enable the process-global tracer inside every TIMED rep —
+    # live span recording perturbing the samples the benchmark reports, and
+    # every rep's events buffering until exit.  Only bench_device's
+    # instrumented pass (its own per-config Tracer) records.
+    _TRACE_BASE = os.environ.pop("TPQ_TRACE", "")
 
     _enable_compile_cache()
     log(f"jax devices: {jax.devices()}")
@@ -875,7 +901,7 @@ def main():
         mb = _uncompressed_mb(path)
         log(f"config {key} {name}: {rows} rows, {mb:.0f} MB uncompressed")
         try:
-            samples, ship = bench_device(path, rows)
+            samples, ship = bench_device(path, rows, name=name)
         except Exception as e:  # noqa: BLE001 — one bad config (or a tunnel
             # hiccup mid-compile) must not cost the driver its JSON line
             log(f"config {key} {name} FAILED: {e!r}; continuing")

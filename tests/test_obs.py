@@ -1,0 +1,783 @@
+"""Unified trace/metrics layer tests (ISSUE 4).
+
+Covers the obs.py tentpole end to end: tracer span/instant/counter recording
+and Chrome trace-event export (field + nesting validation, the format
+Perfetto loads), log-bucketed latency histograms (quantiles, thread/process
+merge), the versioned StatsRegistry tree (golden keys — bench parsers and
+the driver key on them), the PipelineStats unknown-stage guard, the
+disabled-tracer overhead guard, and the full wiring: FileReader /
+DeviceFileReader / DataLoader ``trace=`` runs whose artifacts ``pq_tool
+trace`` summarizes with overlap efficiency matching ``pipeline_stats()``
+within 5%.
+"""
+
+import io
+import itertools
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_parquet.obs import (
+    OBS_VERSION, LatencyHistogram, StatsRegistry, Tracer, current_tracer,
+    resolve_tracer, trace_summary,
+)
+from tpu_parquet.pipeline import STAGES, PipelineStats
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _write_ints(path, rows=200_000, groups=4, seed=0):
+    from tpu_parquet.format import FieldRepetitionType as FRT, Type
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.writer import FileWriter
+
+    rng = np.random.default_rng(seed)
+    schema = build_schema([
+        data_column("v", Type.INT64, FRT.REQUIRED),
+        data_column("w", Type.INT32, FRT.REQUIRED),
+    ])
+    per = rows // groups
+    with FileWriter(path, schema, row_group_size=1) as w:
+        for _ in range(groups):
+            w.write_columns({
+                "v": rng.integers(0, 1 << 40, per),
+                "w": rng.integers(0, 1000, per).astype(np.int32),
+            })
+            w.flush_row_group()
+    return path
+
+
+def _assert_event_fields(events):
+    """The acceptance criterion's format validation: every event carries
+    pid/tid/ts/ph (X spans additionally dur), all ints, json-serializable."""
+    assert events, "no events recorded"
+    json.dumps(events)  # round-trippable
+    for ev in events:
+        assert isinstance(ev.get("ph"), str)
+        assert isinstance(ev.get("pid"), int)
+        assert isinstance(ev.get("tid"), int)
+        if ev["ph"] == "M":
+            continue
+        assert isinstance(ev.get("ts"), int), ev
+        if ev["ph"] == "X":
+            assert isinstance(ev.get("dur"), int) and ev["dur"] >= 0, ev
+
+
+def _assert_nesting(events):
+    """Monotonically consistent nesting: on one thread any two spans are
+    disjoint or contained (2 µs tolerance for the int-microsecond floor)."""
+    by_tid = {}
+    for ev in events:
+        if ev.get("ph") == "X":
+            by_tid.setdefault((ev["pid"], ev["tid"]), []).append(
+                (ev["ts"], ev["ts"] + ev["dur"]))
+    for spans in by_tid.values():
+        for (a0, a1), (b0, b1) in itertools.combinations(spans, 2):
+            disjoint = a1 <= b0 + 2 or b1 <= a0 + 2
+            a_in_b = b0 <= a0 + 2 and a1 <= b1 + 2
+            b_in_a = a0 <= b0 + 2 and b1 <= a1 + 2
+            assert disjoint or a_in_b or b_in_a, ((a0, a1), (b0, b1))
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+def test_disabled_tracer_is_noop():
+    tr = Tracer(enabled=False)
+    s1 = tr.span("a", x=1)
+    s2 = tr.span("b")
+    assert s1 is s2  # the shared no-op singleton: zero allocation per span
+    with s1:
+        pass
+    tr.instant("i", y=2)
+    tr.counter("c", v=3)
+    tr.complete("x", 0.0, 1.0)
+    assert tr.events() == []
+
+
+def test_span_nesting_and_export_fields():
+    tr = Tracer()
+    with tr.span("outer", rg=0):
+        with tr.span("inner"):
+            time.sleep(0.002)
+        with tr.span("inner"):
+            pass
+    tr.instant("mark", k="v")
+    tr.counter("gauge", rows=7)
+    events = tr.events()
+    _assert_event_fields(events)
+    _assert_nesting(events)
+    xs = [e for e in events if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["inner", "inner", "outer"]
+    outer = xs[-1]
+    assert outer["args"] == {"rg": 0}
+    # children are contained in the parent
+    for child in xs[:2]:
+        assert outer["ts"] <= child["ts"]
+        assert child["ts"] + child["dur"] <= outer["ts"] + outer["dur"]
+    doc = tr.export()
+    assert set(doc) == {"traceEvents", "displayTimeUnit", "otherData"}
+    assert doc["otherData"]["obs_version"] == OBS_VERSION
+    # thread metadata names the recording thread
+    meta = [e for e in events if e["ph"] == "M"]
+    assert meta and meta[0]["name"] == "thread_name"
+
+
+def test_tracer_write_and_registry_embed(tmp_path):
+    tr = Tracer(path=str(tmp_path / "t.json"))
+    with tr.span("io"):
+        pass
+    reg = StatsRegistry()
+    reg.histogram("x").record(0.001)
+    out = tr.write(registry=reg)
+    doc = json.loads((tmp_path / "t.json").read_text())
+    assert out == str(tmp_path / "t.json")
+    assert doc["otherData"]["registry"]["obs_version"] == OBS_VERSION
+    assert doc["otherData"]["registry"]["histograms"]["x"]["count"] == 1
+
+
+def test_current_tracer_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPQ_TRACE", raising=False)
+    assert not current_tracer().enabled
+    p = str(tmp_path / "env.json")
+    monkeypatch.setenv("TPQ_TRACE", p)
+    tr = current_tracer()
+    assert tr.enabled and tr.path == p
+    assert current_tracer() is tr  # stable while the env is stable
+    monkeypatch.delenv("TPQ_TRACE", raising=False)
+    assert not current_tracer().enabled
+
+
+def test_resolve_tracer_forms(tmp_path):
+    tr, owned = resolve_tracer(str(tmp_path / "a.json"))
+    assert owned and tr.enabled
+    tr2, owned2 = resolve_tracer(tr)
+    assert tr2 is tr and not owned2
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantiles_log_buckets():
+    h = LatencyHistogram()
+    for _ in range(90):
+        h.record(1e-3)
+    for _ in range(10):
+        h.record(1e-1)
+    assert h.count == 100
+    # log2 buckets: <2x relative error around the true value
+    assert 0.5e-3 <= h.quantile(0.5) <= 2e-3
+    assert 0.05 <= h.quantile(0.95) <= 0.2
+    assert h.max_seconds == pytest.approx(0.1)
+    assert h.quantile(0.5) <= h.quantile(0.95)
+
+
+def test_histogram_merge_and_dict_roundtrip():
+    a, b = LatencyHistogram(), LatencyHistogram()
+    for _ in range(10):
+        a.record(1e-4)
+        b.record(1e-2)
+    b.merge_from(a)
+    assert b.count == 20
+    c = LatencyHistogram.from_dict(b.as_dict())
+    assert c.count == 20 and c.as_dict() == b.as_dict()
+    c.merge_dict(b.as_dict())
+    assert c.count == 40
+    assert c.sum_seconds == pytest.approx(2 * b.sum_seconds)
+
+
+def test_histogram_zero_and_empty():
+    h = LatencyHistogram()
+    assert h.quantile(0.5) == 0.0
+    h.record(0.0)
+    assert h.count == 1 and h.quantile(0.5) == 0.0
+    assert h.as_dict()["buckets"] == {"0": 1}
+
+
+# ---------------------------------------------------------------------------
+# PipelineStats: stage guard + histograms (satellite)
+# ---------------------------------------------------------------------------
+
+def test_pipeline_add_unknown_stage_raises():
+    ps = PipelineStats()
+    with pytest.raises(ValueError) as e:
+        ps.add("upload", 0.1)
+    msg = str(e.value)
+    assert "upload" in msg
+    for s in STAGES:  # the error NAMES the valid stages
+        assert s in msg
+    # every documented stage still accumulates
+    for s in STAGES:
+        ps.add(s, 0.001)
+        assert ps.stage_seconds(s) == pytest.approx(0.001)
+
+
+def test_pipeline_timed_unknown_stage_raises():
+    ps = PipelineStats()
+    with pytest.raises(ValueError):
+        with ps.timed("warp"):
+            pass
+
+
+def test_pipeline_stage_histograms_and_merge():
+    a, b = PipelineStats(), PipelineStats()
+    for _ in range(5):
+        a.add("io", 0.001)
+        b.add("io", 0.01)
+    b.merge_from(a)
+    d = b.as_dict()
+    hist = d["stage_histograms"]
+    assert list(hist) == ["io"]  # silent stages carry no histogram
+    assert hist["io"]["count"] == 10
+    assert d["io_seconds"] == pytest.approx(0.055)
+
+
+# ---------------------------------------------------------------------------
+# registry (golden keys: the schema-stability satellite)
+# ---------------------------------------------------------------------------
+
+def _full_registry():
+    from tpu_parquet.alloc import AllocTracker
+    from tpu_parquet.data.loader import LoaderStats
+    from tpu_parquet.device_reader import ReaderStats
+
+    reg = StatsRegistry()
+    ps = PipelineStats(prefetch=2, budget_bytes=1 << 20)
+    ps.add("io", 0.01)
+    ps.add("stage", 0.02)
+    ps.count_chunk()
+    ps.touch_wall()
+    rs = ReaderStats()
+    rs.count_route("plain", 100, 100, 0.001)
+    rs.count_route("recompress", 200, 120, 0.002)
+    rs.staged_bytes = 220
+    ls = LoaderStats(PipelineStats())
+    ls.batches = 3
+    al = AllocTracker(1 << 20)
+    al.register(4096)
+    al.release(4096)
+    reg.add_pipeline(ps)
+    reg.add_reader(rs)
+    reg.add_loader(ls)
+    reg.note_alloc_peak(al)
+    return reg
+
+
+def test_registry_tree_golden_keys():
+    tree = _full_registry().as_dict()
+    assert set(tree) == {"obs_version", "pipeline", "reader", "loader",
+                         "alloc", "histograms"}
+    assert tree["obs_version"] == OBS_VERSION
+    assert tree["alloc"] == {"peak_bytes": 4096}
+    assert set(tree["histograms"]) == {"stage.io", "stage.stage"}
+    fb = tree["reader"]["ship_feedback"]
+    assert set(fb) == {"link_bytes_per_sec", "routes"}
+    assert set(fb["routes"]) == {"plain", "recompress"}
+    r = fb["routes"]["recompress"]
+    assert {"streams", "shipped_bytes", "predicted_seconds",
+            "measured_seconds", "error_ratio"} == set(r)
+    # measured = shipped / (staged/stage_seconds); stage=0.02s over 220 bytes
+    assert r["measured_seconds"] == pytest.approx(120 / (220 / 0.02), rel=1e-3)
+    json.dumps(tree)  # artifact-ready
+
+
+def test_registry_merge_from_and_dict():
+    a, b = _full_registry(), _full_registry()
+    one = a.as_dict()
+    a.merge_from(b)
+    t = a.as_dict()
+    assert t["pipeline"]["chunks"] == 2
+    assert t["reader"]["ship_routes"]["plain"]["streams"] == 2
+    assert t["loader"]["batches"] == 6
+    assert t["histograms"]["stage.io"]["count"] == 2
+    # config and ratio keys must NOT sum across merged sources: prefetch /
+    # budget compose by max, and derived rates are recomputed from the
+    # merged flows (merging two identical registries leaves them unchanged)
+    assert t["pipeline"]["prefetch"] == one["pipeline"]["prefetch"]
+    assert t["pipeline"]["budget_bytes"] == one["pipeline"]["budget_bytes"]
+    for sect in ("pipeline", "reader", "loader"):
+        for k in ("overlap_efficiency", "rows_per_sec", "bytes_per_sec",
+                  "pages_per_chunk", "batches_per_sec"):
+            if k in (one[sect] or {}):
+                assert t[sect][k] == one[sect][k], (sect, k)
+    # serialized (cross-process) merge stacks on top
+    a.merge_dict(b.as_dict())
+    assert a.as_dict()["pipeline"]["chunks"] == 3
+    with pytest.raises(ValueError):
+        a.merge_dict({"obs_version": 99})
+
+
+def test_registry_merge_recomputes_derived_ratios():
+    """bench_device merges one registry per FILE of a config: the composed
+    tree's ratios must come from the merged flows, not a sum of per-file
+    ratios (4 files at overlap 1.5 is still overlap 1.5, not 6.0)."""
+    from tpu_parquet.device_reader import ReaderStats
+
+    def one_file():
+        reg = StatsRegistry()
+        rs = ReaderStats()
+        rs.rows = 1000
+        rs.compressed_bytes = 8000
+        rs.pages = 6
+        rs.chunks = 2
+        rs.wall_seconds = 2.0
+        reg.add_reader(rs)
+        ps = PipelineStats()
+        ps.add("io", 1.0)
+        ps.add("stage", 0.5)
+        ps.wall_seconds = 1.0
+        reg.add_pipeline(ps)
+        return reg
+
+    merged = one_file()
+    for _ in range(3):
+        merged.merge_from(one_file())
+    t = merged.as_dict()
+    assert t["pipeline"]["wall_seconds"] == pytest.approx(4.0)
+    assert t["pipeline"]["overlap_efficiency"] == pytest.approx(1.5)
+    assert t["reader"]["rows_per_sec"] == pytest.approx(4000 / 8.0)
+    assert t["reader"]["bytes_per_sec"] == pytest.approx(32000 / 8.0)
+    assert t["reader"]["pages_per_chunk"] == pytest.approx(3.0)
+
+
+def test_alloc_peak_tracked_without_budget():
+    """The default max_memory=0 configuration must still report the alloc
+    high-water mark — that's the configuration the registry observes most."""
+    from tpu_parquet.alloc import AllocTracker
+
+    al = AllocTracker(0)
+    al.register(1000)
+    al.register(2000)
+    al.release(2000)
+    al.register(500)
+    assert al.peak == 3000
+    reg = StatsRegistry()
+    reg.note_alloc_peak(al)
+    assert reg.as_dict()["alloc"]["peak_bytes"] == 3000
+
+
+def test_trace_summary_sums_walls_across_pipelines():
+    """One trace often carries several PipelineStats (one per file of a
+    scan): the overlap denominator is the SUM of each pipeline's own max
+    wall, not the max across all of them."""
+    tr = Tracer()
+    for wall in (1.0, 3.0):
+        ps = PipelineStats(tracer=tr)
+        ps.add("io", wall / 2)
+        ps._t0 = time.perf_counter() - wall  # synthetic elapsed wall
+        ps.touch_wall()
+        # cumulative counters from one stats object: only its max counts
+        ps.touch_wall()
+    s = trace_summary(tr.export())
+    assert s["wall_seconds"] == pytest.approx(4.0, rel=0.05)
+
+
+def test_pipeline_as_dict_golden_keys():
+    d = PipelineStats().as_dict()
+    assert set(d) == {
+        "prefetch", "budget_bytes", "chunks", "row_groups",
+        "io_seconds", "decompress_seconds", "recompress_seconds",
+        "stage_seconds", "dispatch_seconds", "finalize_seconds",
+        "busy_seconds", "wall_seconds", "stall_seconds",
+        "peak_in_flight_bytes", "overlap_efficiency", "stage_histograms",
+    }
+
+
+def test_reader_stats_as_dict_golden_keys():
+    from tpu_parquet.device_reader import ReaderStats
+
+    rs = ReaderStats()
+    rs.count_route("plain", 10, 10, 0.5)
+    d = rs.as_dict()
+    assert set(d) == {
+        "row_groups", "chunks", "pages", "pages_device_expanded",
+        "pages_pruned", "rows", "compressed_bytes", "staged_bytes",
+        "link_bytes_logical", "link_bytes_shipped", "ship_routes",
+        "host_seconds", "device_seconds", "wall_seconds", "rows_per_sec",
+        "bytes_per_sec", "pages_per_chunk",
+    }
+    assert set(d["ship_routes"]["plain"]) == {"streams", "logical",
+                                             "shipped", "predicted_s"}
+    assert d["ship_routes"]["plain"]["predicted_s"] == 0.5
+
+
+def test_loader_stats_as_dict_golden_keys():
+    from tpu_parquet.data.loader import LoaderStats
+
+    d = LoaderStats(PipelineStats()).as_dict()
+    assert set(d) == {
+        "batches", "rows", "epochs_completed", "padded_batches",
+        "wall_seconds", "decode_wait_seconds", "window_peak_rows",
+        "rows_per_sec", "batches_per_sec", "pipeline",
+    }
+
+
+# ---------------------------------------------------------------------------
+# concurrency (satellite): >= 8 threads, then a 2-OS-process round trip
+# ---------------------------------------------------------------------------
+
+def test_tracer_histogram_hammer_8_threads():
+    tr = Tracer()
+    hist = LatencyHistogram()
+    ps = PipelineStats(tracer=tr)
+    N, T = 200, 8
+    barrier = threading.Barrier(T)
+
+    def worker(k):
+        barrier.wait()
+        for i in range(N):
+            with tr.span("work", thread=k):
+                hist.record(1e-6 * (i + 1))
+            tr.instant("tick")
+            ps.add("decompress", 1e-6)
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = tr.events()
+    xs = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert len(xs) == T * N          # no lost span
+    assert len(instants) == T * N    # no lost instant
+    assert len(metas) == T           # one thread_name per worker
+    assert hist.count == T * N       # no lost histogram update
+    assert ps.stage_seconds("decompress") == pytest.approx(T * N * 1e-6)
+    assert ps.as_dict()["stage_histograms"]["decompress"]["count"] == T * N
+    _assert_event_fields(events)
+    s = trace_summary(tr.export())
+    assert s["stages"]["work"]["count"] == T * N
+
+
+_CHILD = r"""
+import json, sys
+sys.path.insert(0, sys.argv[1])
+from tpu_parquet.obs import LatencyHistogram, StatsRegistry, Tracer
+from tpu_parquet.pipeline import PipelineStats
+
+tr = Tracer()
+ps = PipelineStats(tracer=tr)
+h = LatencyHistogram()
+for i in range(500):
+    with ps.timed("io"):
+        pass
+    h.record(2e-6)
+reg = StatsRegistry()
+reg.add_pipeline(ps)
+print(json.dumps({
+    "hist": h.as_dict(),
+    "events": tr.events(),
+    "registry": reg.as_dict(),
+}))
+"""
+
+
+def test_two_process_merge_roundtrip(tmp_path):
+    """The loader-resume-shaped 2-OS-process seam: each child records 500
+    spans + histogram samples, the parent merges both children through the
+    serialized forms — no lost updates, and the merged trace exports a
+    document trace_summary still parses."""
+    outs = []
+    for _ in range(2):
+        res = subprocess.run(
+            [sys.executable, "-c", _CHILD, REPO_ROOT],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert res.returncode == 0, res.stderr
+        outs.append(json.loads(res.stdout))
+    hist = LatencyHistogram()
+    reg = StatsRegistry()
+    tr = Tracer()
+    for o in outs:
+        hist.merge_dict(o["hist"])
+        reg.merge_dict(o["registry"])
+        tr.merge_events(o["events"])
+    assert hist.count == 1000
+    assert hist.sum_seconds == pytest.approx(1000 * 2e-6, rel=1e-6)
+    tree = reg.as_dict()
+    assert tree["pipeline"]["chunks"] == 0
+    assert tree["histograms"]["stage.io"]["count"] == 1000
+    events = tr.events()
+    assert len([e for e in events if e["ph"] == "X"]) == 1000
+    assert len({e["pid"] for e in events}) == 2  # two process tracks
+    s = trace_summary(tr.export(registry=reg))
+    assert s["stages"]["io"]["count"] == 1000
+    _assert_event_fields(events)
+
+
+# ---------------------------------------------------------------------------
+# overhead guard (satellite, tier-1): disabled spans are no-ops
+# ---------------------------------------------------------------------------
+
+def test_disabled_tracer_overhead_under_3_percent():
+    """The hot decode loop keeps its trace calls unconditionally; the
+    disabled-tracer path (spans compiled to no-ops, instants one ``if``)
+    must cost <3% against the identical loop with those calls absent.  Both
+    sides keep the pre-obs ``PipelineStats.timed`` counters — the "build
+    with obs calls absent" is the pre-obs build, which already paid them.
+    Interleaved min-of-reps: the minimum is the contention-free cost on a
+    noisy VM."""
+    import gc
+
+    # the span/ctx allocations trigger gc passes that scan whatever object
+    # graphs NEIGHBORING tests left alive — an environment artifact, not
+    # tracer cost; a microbenchmark pins the collector like it pins the CPU
+    gc.collect()
+    gc.disable()
+    tr = Tracer(enabled=False)
+    ps = PipelineStats(tracer=tr)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 1 << 40, 300_000)
+
+    def work():
+        return np.sort(data).sum()
+
+    def once(with_obs):
+        t0 = time.perf_counter()
+        if with_obs:
+            with tr.span("chunk", rg=0):
+                with ps.timed("decompress"):
+                    work()
+            tr.instant("ship", route="plain")
+        else:
+            with ps.timed("decompress"):
+                work()
+        return time.perf_counter() - t0
+
+    try:
+        for _ in range(3):  # warm caches / allocator
+            once(True), once(False)
+        base, obs = [], []
+        for _ in range(80):
+            obs.append(once(True))
+            base.append(once(False))
+    finally:
+        gc.enable()
+    assert tr.events() == []  # truly disabled
+    # Estimator: median of PAIRED adjacent differences over the interleaved
+    # iterations.  Suite-level contention (another test's leftover threads,
+    # a periodic scavenger) inflates both halves of an adjacent pair about
+    # equally, so the difference cancels the common-mode noise that made
+    # min-of-aggregates (and even min-of-iterations) flaky in-suite; the
+    # median then discards the pairs a context switch split.
+    diffs = sorted(o - b for o, b in zip(obs, base))
+    med_diff = diffs[len(diffs) // 2]
+    med_base = sorted(base)[len(base) // 2]
+    overhead = med_diff / med_base
+    assert overhead < 0.03, f"disabled-tracer overhead {overhead:.2%}"
+    # absolute backstop, independent of the work's size: a disabled span
+    # plus instant costs well under 10 µs even on a loaded VM
+    n = 10_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with tr.span("chunk"):
+            pass
+        tr.instant("ship")
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 10e-6, f"null span+instant {per_call * 1e6:.2f} us"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end wiring: readers, loader, pq_tool trace
+# ---------------------------------------------------------------------------
+
+def test_filereader_trace_end_to_end(tmp_path):
+    """FileReader(prefetch=4, trace=path): the close() artifact is a valid
+    trace-event document whose pq_tool-computed overlap efficiency matches
+    pipeline_stats() within 5% (the acceptance tolerance)."""
+    path = _write_ints(str(tmp_path / "f.parquet"))
+    tp = str(tmp_path / "trace.json")
+    from tpu_parquet.reader import FileReader
+
+    with FileReader(path, prefetch=4, trace=tp) as r:
+        r.read_all()
+        pd = r.pipeline_stats().as_dict()
+    doc = json.loads(open(tp).read())
+    events = doc["traceEvents"]
+    _assert_event_fields(events)
+    _assert_nesting(events)
+    names = {e["name"] for e in events if e["ph"] == "X"}
+    assert {"io", "decompress"} <= names
+    s = trace_summary(doc)
+    assert s["busy_seconds"] == pytest.approx(pd["busy_seconds"], rel=0.02)
+    assert s["overlap_efficiency"] == pytest.approx(
+        pd["overlap_efficiency"], rel=0.05)
+    # the registry rides the same artifact
+    reg = doc["otherData"]["registry"]
+    assert reg["obs_version"] == OBS_VERSION
+    assert reg["pipeline"]["chunks"] == pd["chunks"]
+
+
+def test_device_reader_trace_ship_feedback(tmp_path):
+    """DeviceFileReader(trace=path): stage/dispatch/finalize spans per row
+    group plus one `ship` instant per stream carrying the route and the
+    planner's predicted seconds — the pq_tool route table reports
+    predicted-vs-measured lane seconds from the artifact alone."""
+    path = _write_ints(str(tmp_path / "d.parquet"))
+    tp = str(tmp_path / "trace.json")
+    from tpu_parquet.device_reader import DeviceFileReader
+
+    with DeviceFileReader(path, trace=tp) as r:
+        for _ in r.iter_row_groups():
+            pass
+        st = r.stats().as_dict()
+        tree = r.obs_registry().as_dict()
+    doc = json.loads(open(tp).read())
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"prepare", "stage", "dispatch", "finalize"} <= names
+    ships = [e for e in doc["traceEvents"]
+             if e["ph"] == "i" and e["name"] == "ship"]
+    assert len(ships) == sum(c["streams"]
+                             for c in st["ship_routes"].values())
+    for ev in ships:
+        assert {"route", "column", "logical", "shipped",
+                "predicted_s"} <= set(ev["args"])
+    s = trace_summary(doc)
+    assert set(s["routes"]) == set(st["ship_routes"])
+    for route, rr in s["routes"].items():
+        assert rr["shipped_bytes"] == st["ship_routes"][route]["shipped"]
+        assert rr["measured_seconds"] > 0  # the stage spans carried bytes
+    # registry-side feedback agrees with the trace-side aggregation
+    fb = tree["reader"]["ship_feedback"]["routes"]
+    for route, rr in s["routes"].items():
+        assert fb[route]["predicted_seconds"] == pytest.approx(
+            rr["predicted_seconds"], abs=2e-5)
+
+
+def test_loader_trace_spans(tmp_path):
+    path = _write_ints(str(tmp_path / "l.parquet"), rows=40_000, groups=4)
+    tp = str(tmp_path / "trace.json")
+    from tpu_parquet.data import DataLoader
+
+    loader = DataLoader(path, 4096, shuffle=True, seed=3, prefetch=2,
+                        shuffle_window=8192, trace=tp)
+    n = sum(1 for _ in loader)
+    assert n == loader.num_batches
+    tr = loader._tracer
+    events = tr.events()
+    names = {e["name"] for e in events if e["ph"] == "X"}
+    assert {"batch", "decode_wait"} <= names
+    counters = [e for e in events
+                if e["ph"] == "C" and e["name"] == "shuffle_window_rows"]
+    assert counters and all(e["args"]["rows"] > 0 for e in counters)
+    batches = [e for e in events if e["ph"] == "X" and e["name"] == "batch"]
+    assert len(batches) == n
+    assert sum(e["args"]["rows"] for e in batches) == loader.num_rows
+    tree = loader.obs_registry().as_dict()
+    assert tree["loader"]["batches"] == n
+    assert tree["pipeline"]["chunks"] > 0  # decode pipeline composed in
+    # iteration end IS the loader's close: the artifact (with the registry
+    # embedded) must exist without waiting for interpreter exit
+    doc = json.loads(open(tp).read())
+    assert doc["traceEvents"]
+    assert doc["otherData"]["registry"]["loader"]["batches"] == n
+
+
+def test_tpq_trace_env_activates_readers(tmp_path, monkeypatch):
+    """TPQ_TRACE alone (no kwargs) routes every reader's spans to the
+    process tracer — the bench/driver activation path."""
+    path = _write_ints(str(tmp_path / "e.parquet"), rows=20_000, groups=2)
+    p = str(tmp_path / "env_trace.json")
+    monkeypatch.setenv("TPQ_TRACE", p)
+    from tpu_parquet.reader import FileReader
+
+    tr = current_tracer()
+    before = len(tr.events())
+    with FileReader(path, prefetch=2) as r:
+        r.read_all()
+    events = tr.events()[before:]
+    assert {e["name"] for e in events if e["ph"] == "X"} >= {"io",
+                                                             "decompress"}
+    tr.write()
+    assert json.loads(open(p).read())["traceEvents"]
+
+
+def test_pq_tool_trace_cli(tmp_path):
+    """`pq_tool trace` renders the per-stage table, overlap, stall and
+    route lines from the artifact alone."""
+    path = _write_ints(str(tmp_path / "c.parquet"))
+    tp = str(tmp_path / "trace.json")
+    from tpu_parquet.cli import pq_tool
+    from tpu_parquet.device_reader import DeviceFileReader
+
+    with DeviceFileReader(path, prefetch=2, trace=tp) as r:
+        for _ in r.iter_row_groups():
+            pass
+        pd = r.pipeline_stats().as_dict()
+    out = io.StringIO()
+    args = pq_tool.build_parser().parse_args(["trace", tp])
+    assert args.func(args, out=out) == 0
+    text = out.getvalue()
+    assert "overlap efficiency:" in text
+    assert "stall:" in text
+    assert "p50_ms" in text and "p95_ms" in text
+    assert "ship routes" in text and "predicted_s" in text
+    assert "embedded registry: obs_version=1" in text
+    # the printed overlap matches pipeline_stats() within the 5% acceptance
+    line = next(l for l in text.splitlines()
+                if l.startswith("overlap efficiency:"))
+    got = float(line.rsplit("= ", 1)[1])
+    assert got == pytest.approx(pd["overlap_efficiency"], rel=0.05)
+
+
+def test_pq_tool_trace_malformed(tmp_path):
+    from tpu_parquet.cli import pq_tool
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert pq_tool.main(["trace", str(bad)]) == 1
+    notrace = tmp_path / "no.json"
+    notrace.write_text('{"foo": 1}')
+    assert pq_tool.main(["trace", str(notrace)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# bench artifact (satellite): compact stdout line stays parseable
+# ---------------------------------------------------------------------------
+
+def test_bench_summary_line_under_2000_chars(tmp_path, monkeypatch, capsys):
+    """The r04/r05 `parsed: null` bug class: even with the obs registry
+    trees (histograms included) in every config, the stdout LAST line must
+    stay under the driver's 2000-char tail window and parse as JSON."""
+    import bench
+
+    monkeypatch.setenv("BENCH_JSON", str(tmp_path / "b.json"))
+    tree = _full_registry().as_dict()
+    record = {
+        "metric": "lineitem16_decode_rows_per_sec_device",
+        "value": 1.0e7, "unit": "rows/s", "vs_baseline": 9.9,
+        "configs": {
+            name: {
+                "rows": 5_000_000, "device_rows_per_sec": 1e7,
+                "device_vs_host": 9.9, "link_bytes_shipped": 12345,
+                "link_bytes_logical": 23456, "link_bytes_ratio": 0.52,
+                "obs": tree,
+                "device_windows_s": [[0.5] * 8] * 3,
+            }
+            for name in ("lineitem16", "plain_int64", "delta_ints",
+                         "dict_strings", "nested", "loader", "pipeline")
+        },
+    }
+    bench.emit_results(record)
+    outline = capsys.readouterr().out.strip().splitlines()[-1]
+    assert len(outline) < 2000
+    parsed = json.loads(outline)
+    assert parsed["metric"] == record["metric"]
+    assert "obs" not in json.dumps(parsed)  # trees live only in the artifact
+    # the artifact keeps the full trees, histograms included
+    art = json.loads((tmp_path / "b.json").read_text())
+    assert art["configs"]["lineitem16"]["obs"]["histograms"]

@@ -30,14 +30,18 @@ class AllocTracker:
     def __init__(self, max_size: int = 0):
         self.max_size = int(max_size)
         self.total = 0
+        self.peak = 0  # high-water mark (obs.StatsRegistry reports it)
         self._lock = threading.Lock()
 
     def register(self, nbytes: int) -> None:
-        if self.max_size <= 0:
-            return
+        # the high-water mark is tracked even without a cap — the default
+        # max_size=0 configuration is exactly the one obs.StatsRegistry
+        # reports peaks for; only the budget CHECK is conditional
         with self._lock:
             self.total += int(nbytes)
-            if self.total > self.max_size:
+            if self.total > self.peak:
+                self.peak = self.total
+            if 0 < self.max_size < self.total:
                 raise MemoryBudgetExceeded(int(nbytes), self.total, self.max_size)
 
     def register_transient(self, nbytes: int) -> None:
@@ -50,14 +54,10 @@ class AllocTracker:
         as the originals are, so holding it registered would double-count
         the chunk for the rest of the row-group window.
         """
-        if self.max_size <= 0:
-            return
         self.register(nbytes)
         self.release(nbytes)
 
     def release(self, nbytes: int) -> None:
-        if self.max_size <= 0:
-            return
         with self._lock:
             self.total -= int(nbytes)
 

@@ -9,6 +9,8 @@ Command parity with the reference's parquet-tool (cmd/parquet-tool/cmds/):
     rowcount  number of rows from the footer       (rowcount.go:16-37)
     stats     per-row-group column min/max/null_count (beyond the reference)
     split     re-shard into parts of at most a given size (split.go:31-117)
+    trace     summarize a TPQ_TRACE run (per-stage p50/p95, overlap
+              efficiency, stall attribution, ship-route prediction error)
 
 cat/head/rowcount take --filter "a > 5 and b == 'x'" for statistics-based
 row-group pruning (tpu_parquet.predicate).
@@ -160,6 +162,65 @@ def cmd_stats(args, out=sys.stdout) -> int:
     return 0
 
 
+def cmd_trace(args, out=sys.stdout) -> int:
+    """Render a Chrome trace-event JSON (a ``TPQ_TRACE`` run) as the
+    per-stage latency / overlap / stall / route-prediction report — the
+    trace made useful without a browser (obs.trace_summary does the math;
+    Perfetto / chrome://tracing load the same file for the timeline)."""
+    from ..obs import trace_summary
+
+    try:
+        with open(args.file) as f:
+            doc = json.load(f)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"{args.file}: not JSON ({e})") from None
+    s = trace_summary(doc)
+    out.write(f"trace: {args.file}\n")
+    out.write(f"events: {s['events']}  threads: {s['threads']}  "
+              f"wall: {s['wall_seconds']:.3f}s\n")
+    if s["stages"]:
+        name_w = max(max(len(n) for n in s["stages"]), 5)
+        out.write(f"{'stage':<{name_w}} {'count':>7} {'total_s':>9} "
+                  f"{'p50_ms':>9} {'p95_ms':>9} {'max_ms':>9}\n")
+        for name, st in s["stages"].items():
+            out.write(
+                f"{name:<{name_w}} {st['count']:>7} "
+                f"{st['total_seconds']:>9.3f} "
+                f"{st['p50_seconds'] * 1e3:>9.3f} "
+                f"{st['p95_seconds'] * 1e3:>9.3f} "
+                f"{st['max_seconds'] * 1e3:>9.3f}\n")
+    out.write(f"overlap efficiency: {s['busy_seconds']:.3f}s busy / "
+              f"{s['wall_seconds']:.3f}s wall = "
+              f"{s['overlap_efficiency']:.3f}\n")
+    out.write(f"stall: {s['stall_seconds']:.3f}s "
+              f"({100 * s['stall_share']:.1f}% of wall)\n")
+    if s["routes"]:
+        out.write(f"ship routes (measured link "
+                  f"{s['link_bytes_per_sec'] / 1e6:.1f} MB/s):\n")
+        name_w = max(max(len(n) for n in s["routes"]), 5)
+        out.write(f"  {'route':<{name_w}} {'streams':>7} {'shipped_mb':>11} "
+                  f"{'predicted_s':>12} {'measured_s':>11} {'error':>7}\n")
+        for name, r in s["routes"].items():
+            meas = r.get("measured_seconds")
+            err = r.get("error_ratio")
+            out.write(
+                f"  {name:<{name_w}} {r['streams']:>7} "
+                f"{r['shipped_bytes'] / 1e6:>11.2f} "
+                f"{r['predicted_seconds']:>12.4f} "
+                + (f"{meas:>11.4f} " if meas is not None else f"{'-':>11} ")
+                + (f"{err:>7.2f}" if err is not None else f"{'-':>7}")
+                + "\n")
+    reg = s.get("registry")
+    if reg:
+        pipe = reg.get("pipeline") or {}
+        out.write(
+            f"embedded registry: obs_version={reg.get('obs_version')} "
+            f"chunks={pipe.get('chunks')} "
+            f"busy={pipe.get('busy_seconds')}s "
+            f"stall={pipe.get('stall_seconds')}s\n")
+    return 0
+
+
 def parse_human_size(s: str) -> int:
     """'100MB', '1GiB', '4096' → bytes (helpers.go:10-40 parity)."""
     s = s.strip()
@@ -256,6 +317,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="per-row-group column min/max/null statistics")
     st.add_argument("file")
     st.set_defaults(func=cmd_stats)
+
+    tr = sub.add_parser(
+        "trace", help="summarize a TPQ_TRACE run (Chrome trace-event JSON)")
+    tr.add_argument("file")
+    tr.set_defaults(func=cmd_trace)
 
     sp = sub.add_parser("split", help="split into files of at most SIZE bytes")
     sp.add_argument("--size", required=True, help="max part size, e.g. 100MB")

@@ -149,7 +149,16 @@ class DataLoader:
         mask_key: str = "mask",
         max_memory: int = 0,
         validate_crc: bool = False,
+        trace=None,
     ):
+        from ..obs import resolve_tracer
+
+        # span tracer (obs.py): batch/decode-wait spans + window-occupancy
+        # counters; None = the TPQ_TRACE process tracer (no-op without the
+        # env), a path = per-loader tracer written (with the registry
+        # embedded) every time an epoch iterator finishes or is abandoned —
+        # the loader has no close(), so iteration end is its close
+        self._tracer, self._owns_tracer = resolve_tracer(trace)
         if isinstance(files, (str, os.PathLike)):
             files = [files]
         self._paths = [os.fspath(p) for p in files]
@@ -237,7 +246,8 @@ class DataLoader:
         self._epoch = 0
         self._rows_taken = 0
         self._pstats = PipelineStats(prefetch=self._prefetch,
-                                     budget_bytes=self._max_memory)
+                                     budget_bytes=self._max_memory,
+                                     tracer=self._tracer)
         self._stats = LoaderStats(self._pstats)
 
     # -- schema validation ----------------------------------------------------
@@ -315,6 +325,15 @@ class DataLoader:
 
     def stats(self) -> LoaderStats:
         return self._stats
+
+    def obs_registry(self):
+        """This loader's unified metrics tree (obs.StatsRegistry): loader
+        counters + the decode pipeline's per-stage sums and histograms."""
+        from ..obs import StatsRegistry
+
+        reg = StatsRegistry()
+        reg.add_loader(self._stats)
+        return reg
 
     # -- checkpoint ------------------------------------------------------------
 
@@ -445,6 +464,7 @@ class DataLoader:
         parts: dict[str, list] = {c: [] for c in names}
         buffered = 0
         bidx = first_block
+        tr = self._tracer
         try:
             while True:
                 t0 = time.perf_counter()
@@ -452,7 +472,12 @@ class DataLoader:
                     arrays = next(stream)
                 except StopIteration:
                     break
-                self._stats.decode_wait_seconds += time.perf_counter() - t0
+                t1 = time.perf_counter()
+                self._stats.decode_wait_seconds += t1 - t0
+                if tr.enabled:
+                    # consumer time blocked on the decode stream — the span
+                    # that shrinks toward zero as prefetch hides the decode
+                    tr.complete("decode_wait", t0, t1)
                 if skip_rows:
                     arrays = {c: a[skip_rows:] for c, a in arrays.items()}
                     skip_rows = 0
@@ -464,6 +489,8 @@ class DataLoader:
                 buffered += n
                 self._stats.window_peak_rows = max(
                     self._stats.window_peak_rows, buffered)
+                if tr.enabled:
+                    tr.counter("shuffle_window_rows", rows=buffered)
                 while buffered >= window:
                     cat = {c: (np.concatenate(parts[c])
                                if len(parts[c]) > 1 else parts[c][0])
@@ -574,16 +601,36 @@ class DataLoader:
         the epoch.  ``state()`` between batches is a valid resume point."""
         epoch = self._epoch
         stats = self._stats
-        for batch, consumed in self._batches(epoch, self._rows_taken):
-            self._rows_taken += consumed
-            stats.touch_wall()
-            self._pstats.touch_wall()
-            stats.batches += 1
-            stats.rows += consumed
-            if consumed < self._batch_size:
-                stats.padded_batches += 1
-            yield batch
-            stats.touch_wall()
+        tr = self._tracer
+        gen = self._batches(epoch, self._rows_taken)
+        try:
+            while True:
+                # time each batch's PRODUCTION (decode + shuffle + assembly,
+                # consumer wait excluded) as a "batch" span
+                t0 = time.perf_counter()
+                try:
+                    batch, consumed = next(gen)
+                except StopIteration:
+                    break
+                if tr.enabled:
+                    tr.complete("batch", t0, time.perf_counter(),
+                                rows=consumed)
+                self._rows_taken += consumed
+                stats.touch_wall()
+                self._pstats.touch_wall()
+                stats.batches += 1
+                stats.rows += consumed
+                if consumed < self._batch_size:
+                    stats.padded_batches += 1
+                yield batch
+                stats.touch_wall()
+        finally:
+            gen.close()
+            if self._owns_tracer:
+                # per-loader trace artifact: rewrite (cumulatively) at every
+                # epoch end or early abandon so the file exists without
+                # waiting for interpreter exit
+                self._tracer.write(registry=self.obs_registry())
         # epoch complete (also when resumed exactly at its end)
         self._epoch = epoch + 1
         self._rows_taken = 0

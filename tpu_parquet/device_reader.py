@@ -1309,13 +1309,23 @@ class _ChunkAssembler:
         self.alloc = None  # AllocTracker: recompression copies count too
         self._ship_pref: "list | None" = None
         self._ship: dict = {}
+        self._ship_costs: dict = {}  # route -> planner's modeled seconds
+        self._dict_costs: dict = {}  # same, for the dictionary value table
         self._dict_ship: "tuple | None" = None  # (route, payload, out_len)
         self._bytes_walk: "tuple | None" = None  # (lens_l, span_l)
         self._narrow_compress = False
         self.ship_records: list = []
 
-    def _record_ship(self, route: str, logical: int, shipped: int) -> None:
-        self.ship_records.append((route, int(logical), int(shipped)))
+    def _record_ship(self, route: str, logical: int, shipped: int,
+                     predicted: "float | None" = None) -> None:
+        # the planner's modeled seconds for the route that actually ran —
+        # obs.StatsRegistry.ship_feedback puts it next to the measured link
+        # lane (TPQ_LINK_MBPS calibration); value-stream records default to
+        # the preship plan's cost table, dict-table records pass their own
+        if predicted is None:
+            predicted = self._ship_costs.get(route, 0.0)
+        self.ship_records.append(
+            (route, int(logical), int(shipped), float(predicted)))
 
     def _route_enabled(self, route: str) -> bool:
         """Whether the planner ranked ``route`` ahead of the plain tail
@@ -1483,7 +1493,7 @@ class _ChunkAssembler:
             k = _span_bytes(*self.stats_span)
             if k <= _narrow_max_k(width):
                 narrow_k = k
-        self._ship_pref = planner.routes(ChunkFacts(
+        self._ship_pref, self._ship_costs = planner.plan(ChunkFacts(
             logical=logical, width=width, narrow_k=narrow_k,
             narrow_possible=is_int and native.available(),
             comp_bytes=comp_bytes, native=native.available(),
@@ -1546,7 +1556,7 @@ class _ChunkAssembler:
         logical = sum(span_l)
         comp_bytes = sum(len(p.comp[0]) for p in self.pages
                          if p.comp is not None)
-        self._ship_pref = planner.routes(ChunkFacts(
+        self._ship_pref, self._ship_costs = planner.plan(ChunkFacts(
             logical=logical, width=0, comp_bytes=comp_bytes, native=True,
         ))
         for route in self._ship_pref:
@@ -1599,7 +1609,8 @@ class _ChunkAssembler:
             native=native.available(),
             host_bytes_ready=True,  # dict pages always decompress on host
         )
-        for route in planner.routes(facts):
+        dict_routes, self._dict_costs = planner.plan(facts)
+        for route in dict_routes:
             if route == ROUTE_DEVICE_SNAPPY and comp0 is not None:
                 self._dict_ship = (route, comp0[0], comp0[1])
                 return
@@ -2398,7 +2409,9 @@ class _ChunkAssembler:
                     # 0) — unlike the plain route's zero reserve they are
                     # garbage, but the deferred range check raises at
                     # finalize before a clamped gather can escape.
-                    self._record_ship(ship[0], dict_u8.nbytes, info.shipped)
+                    self._record_ship(
+                        ship[0], dict_u8.nbytes, info.shipped,
+                        predicted=self._dict_costs.get(ship[0], 0.0))
                     dyn.append(np.int64(info.tbase))
                     dkey = ("du8s", dict_kp, dict_itemsize, info.n_ops,
                             info.out_pad, info.iters)
@@ -2440,7 +2453,9 @@ class _ChunkAssembler:
                     # heap shipped compressed (offsets stay plain — tiny);
                     # bytes past the real heap resolve through padded ops,
                     # same garbage contract as the plain route's padding
-                    self._record_ship(ship[0], rheap.nbytes, info.shipped)
+                    self._record_ship(
+                        ship[0], rheap.nbytes, info.shipped,
+                        predicted=self._dict_costs.get(ship[0], 0.0))
                     dyn.extend((np.int64(roff_base), np.int64(info.tbase)))
                     dkey = ("drags", roff_n, rheap_room, info.n_ops,
                             info.out_pad, info.iters)
@@ -2893,13 +2908,20 @@ class ReaderStats:
     route_streams: dict = field(default_factory=dict)
     route_bytes_logical: dict = field(default_factory=dict)
     route_bytes_shipped: dict = field(default_factory=dict)
+    # the cost model's modeled seconds for the routes that RAN, summed per
+    # route — obs.StatsRegistry.ship_feedback compares them to the measured
+    # link lane (staged bytes / stage seconds) for TPQ_LINK_MBPS calibration
+    route_pred_seconds: dict = field(default_factory=dict)
 
-    def count_route(self, route: str, logical: int, shipped: int) -> None:
+    def count_route(self, route: str, logical: int, shipped: int,
+                    predicted: float = 0.0) -> None:
         self.route_streams[route] = self.route_streams.get(route, 0) + 1
         self.route_bytes_logical[route] = (
             self.route_bytes_logical.get(route, 0) + logical)
         self.route_bytes_shipped[route] = (
             self.route_bytes_shipped.get(route, 0) + shipped)
+        self.route_pred_seconds[route] = (
+            self.route_pred_seconds.get(route, 0.0) + predicted)
 
     @property
     def link_bytes_logical(self) -> int:
@@ -2936,7 +2958,9 @@ class ReaderStats:
             "ship_routes": {
                 r: {"streams": self.route_streams[r],
                     "logical": self.route_bytes_logical.get(r, 0),
-                    "shipped": self.route_bytes_shipped.get(r, 0)}
+                    "shipped": self.route_bytes_shipped.get(r, 0),
+                    "predicted_s": round(
+                        self.route_pred_seconds.get(r, 0.0), 6)}
                 for r in sorted(self.route_streams)
             },
             "host_seconds": round(self.host_seconds, 6),
@@ -2981,22 +3005,29 @@ class DeviceFileReader:
 
     def __init__(self, source, columns=None, validate_crc: bool = False,
                  profile_dir: "str | None" = None, max_memory: int = 0,
-                 row_filter=None, prefetch: int = 0):
+                 row_filter=None, prefetch: int = 0, trace=None):
+        from .obs import resolve_tracer
         from .pipeline import PipelineStats
         from .reader import FileReader
 
         _enable_compile_cache()
 
+        # span tracer (obs.py): None = the TPQ_TRACE process tracer (a
+        # disabled no-op without the env); a path = per-reader tracer whose
+        # trace file (+ embedded registry) is written at close()
+        self._tracer, self._owns_tracer = resolve_tracer(trace)
         self._host = FileReader(source, columns=columns,
                                 validate_crc=validate_crc,
                                 max_memory=max_memory,
-                                row_filter=row_filter)
+                                row_filter=row_filter,
+                                trace=self._tracer)
         # chunk-granular host prefetch depth (IO + CRC + decompress + parse
         # of upcoming chunks on a bounded pool, spanning row-group
         # boundaries); 0 = the sequential host phase
         self._prefetch = int(prefetch)
         self._pipe_stats = PipelineStats(prefetch=self._prefetch,
-                                         budget_bytes=int(max_memory))
+                                         budget_bytes=int(max_memory),
+                                         tracer=self._tracer)
         self.metadata = self._host.metadata
         self.schema = self._host.schema
         self.validate_crc = validate_crc
@@ -3016,6 +3047,21 @@ class DeviceFileReader:
 
     def close(self):
         self._host.close()
+        if self._owns_tracer:
+            self._tracer.write(registry=self.obs_registry())
+            self._owns_tracer = False  # idempotent: scan_files double-closes
+
+    def obs_registry(self):
+        """This reader's unified metrics tree (obs.StatsRegistry): decode
+        counters + per-route ship decisions with the planner's predictions,
+        the pipeline's per-stage histograms, and the alloc high-water mark."""
+        from .obs import StatsRegistry
+
+        reg = StatsRegistry()
+        reg.add_reader(self._stats)
+        reg.add_pipeline(self._pipe_stats)
+        reg.note_alloc_peak(self.alloc)
+        return reg
 
     def __enter__(self):
         return self
@@ -3283,8 +3329,15 @@ class DeviceFileReader:
                 continue
             plans.append((name, asm.finish(stager)))
             self._stats.pages_device_expanded += asm.pages_kept_compressed
-            for route, logical, shipped in asm.ship_records:
-                self._stats.count_route(route, logical, shipped)
+            tr = self._pipe_stats.tracer
+            for route, logical, shipped, predicted in asm.ship_records:
+                self._stats.count_route(route, logical, shipped, predicted)
+                if tr is not None and tr.enabled:
+                    # one instant per shipped stream: pq_tool trace folds
+                    # these into the per-route predicted-vs-measured table
+                    tr.instant("ship", route=route, column=name,
+                               logical=logical, shipped=shipped,
+                               predicted_s=round(predicted, 6))
         # every selected leaf must have a chunk in the row group (host
         # FileReader parity — reader.py read_row_group's missing check)
         seen = set(out) | {name for name, _ in plans}
@@ -3299,6 +3352,9 @@ class DeviceFileReader:
         now = _time.perf_counter()
         self._stats.host_seconds += now - t0
         self._stats.wall_seconds = now - self._t0
+        tr = self._pipe_stats.tracer
+        if tr is not None and tr.enabled:
+            tr.complete("prepare", t0, now, rg=index, bytes=stager.total)
         return out, plans, stager
 
     @scoped_x64
@@ -3309,7 +3365,7 @@ class DeviceFileReader:
         out, plans, stager = prepared
         if plans:
             if buf_dev is None:
-                with self._pipe_stats.timed("stage"):
+                with self._pipe_stats.timed("stage", bytes=stager.total):
                     buf_dev = stager.stage()
             with self._pipe_stats.timed("dispatch"):
                 out.update(_run_plans(plans, buf_dev))
@@ -3487,7 +3543,8 @@ class DeviceFileReader:
         # between two scans on one reader (pipeline_stats() reports the
         # current/most recent scan)
         self._pipe_stats = PipelineStats(prefetch=self._prefetch,
-                                         budget_bytes=self.alloc.max_size)
+                                         budget_bytes=self.alloc.max_size,
+                                         tracer=self._tracer)
         indices = [i for i in range(self.num_row_groups)
                    if self._host.row_group_selected(i)]
         if not indices:
@@ -3534,11 +3591,11 @@ def _timed_stage(reader: DeviceFileReader, stager: _RowGroupStager):
     import time as _time
 
     t0 = _time.perf_counter()
-    buf_dev = stager.stage()
+    with reader._pipe_stats.timed("stage", bytes=stager.total):
+        buf_dev = stager.stage()
     dt = _time.perf_counter() - t0
     with reader._stats_lock:
         reader._stats.device_seconds += dt
-    reader._pipe_stats.add("stage", dt)
     return buf_dev
 
 
@@ -3585,10 +3642,10 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0):
         is paying the budget wait."""
 
         @staticmethod
-        def add_stall(seconds):
+        def add_stall(seconds, t0=None):
             st = current["stats"]
             if st is not None:
-                st.add_stall(seconds)
+                st.add_stall(seconds, t0)
 
         @staticmethod
         def note_peak(b):
@@ -3738,7 +3795,7 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
 
 def scan_files(paths, columns=None, validate_crc: bool = False,
                max_memory: int = 0, row_filter=None, with_path: bool = False,
-               prefetch: int = 0):
+               prefetch: int = 0, trace=None):
     """Scan several files' row groups through ONE continuous transfer pipeline.
 
     ``prefetch=K`` additionally runs chunk IO + decompression K-deep on a
@@ -3778,13 +3835,19 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
     """
     from concurrent.futures import ThreadPoolExecutor
 
+    from .obs import resolve_tracer
+
+    # one tracer spans the whole scan (per-file tracers would shred the
+    # timeline Perfetto is supposed to show); with a path, the trace + the
+    # merged registry of every reader are written when the scan ends
+    tracer, owns_tracer = resolve_tracer(trace)
     readers: list[DeviceFileReader] = []
 
     def work():
         for path in paths:
             r = DeviceFileReader(
                 path, columns=columns, validate_crc=validate_crc,
-                max_memory=max_memory, row_filter=row_filter,
+                max_memory=max_memory, row_filter=row_filter, trace=tracer,
             )
             readers.append(r)
             for i in range(r.num_row_groups):
@@ -3818,3 +3881,8 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
         finally:
             for r in readers:
                 r.close()
+            if owns_tracer and readers:
+                reg = readers[0].obs_registry()
+                for r in readers[1:]:
+                    reg.merge_from(r.obs_registry())
+                tracer.write(registry=reg)

@@ -1,0 +1,714 @@
+"""Unified trace/metrics layer: span tracer + one stats registry (SURVEY §5.5).
+
+The ROADMAP's two open perf items (the 1B ×-host re-bank, the plain_int64
+gap) are unattributable from per-stage *sums* alone: ``PipelineStats`` says
+how much total time decompression took, not WHEN each chunk was in which
+stage or where the pipeline actually stalled — and ``ship.py`` bets on a
+cost model whose predictions nothing ever checks against the measured lanes.
+This module is the instrument every later perf PR reads first.  Three
+pieces, all stdlib-only (imported by the innermost hot loops, so it must
+never pull numpy/jax):
+
+- :class:`Tracer` — a thread-safe structured span tracer (nestable spans,
+  instant events, counters) exporting **Chrome trace-event JSON** that
+  Perfetto / ``chrome://tracing`` load directly.  Near-zero overhead when
+  disabled: ``span()`` returns a shared no-op context manager after one
+  attribute check, and every other record call is a single ``if`` —
+  guaranteed by the tier-1 overhead guard in tests/test_obs.py.
+  Activation: ``TPQ_TRACE=<path>`` (process-global tracer, written at
+  interpreter exit) or ``trace=`` kwargs on ``FileReader`` /
+  ``DeviceFileReader`` / ``DataLoader`` / ``scan_files`` (per-object tracer,
+  written when the object closes).
+
+- :class:`LatencyHistogram` — log2-bucketed latency distribution,
+  mergeable across threads AND processes (``as_dict``/``from_dict``
+  round-trip), giving per-stage p50/p95 where the round-6 counters only
+  had sums.  ``PipelineStats.add`` feeds one per stage.
+
+- :class:`StatsRegistry` — the one versioned ``as_dict()`` tree composing
+  ``PipelineStats`` (+ its histograms), ``ReaderStats`` (per-route ship
+  decisions WITH the cost model's predicted lane seconds), ``LoaderStats``,
+  and ``AllocTracker`` peaks.  ``ship_feedback()`` puts the planner's
+  predicted seconds next to the measured link lane (staged bytes / stage
+  seconds) — the direct ``TPQ_LINK_MBPS`` calibration signal.
+
+``pq_tool trace <run.json>`` (cli/pq_tool.py) renders a trace into the
+per-stage p50/p95 table, overlap efficiency, stall attribution, and
+route-prediction error via :func:`trace_summary`, so a trace is useful
+without a browser.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "OBS_VERSION", "LatencyHistogram", "StatsRegistry", "Tracer",
+    "current_tracer", "resolve_tracer", "trace_summary",
+]
+
+# version of every schema this module emits (the registry tree, the trace
+# file's otherData, the histogram dict) — bench parsers and the driver key
+# on it, and the golden-key tests in tests/test_obs.py pin the key sets
+OBS_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# latency histograms
+# ---------------------------------------------------------------------------
+
+class LatencyHistogram:
+    """Log2-bucketed latency distribution; lock-protected, mergeable.
+
+    Bucket ``i`` holds durations whose nanosecond count has bit length ``i``
+    (i.e. ``[2^(i-1), 2^i)`` ns; bucket 0 is exactly 0 ns) — ~62 sparse
+    buckets cover 1 ns to minutes with <2x relative error, which is what a
+    p50/p95 over decode stages needs.  Quantiles interpolate at the bucket's
+    geometric midpoint.  ``merge_from`` folds another histogram in
+    (thread-safe on both sides); ``as_dict``/``from_dict`` round-trip across
+    process boundaries (the loader-resume shaped 2-process test).
+    """
+
+    __slots__ = ("_lock", "buckets", "count", "sum_seconds", "max_seconds")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.buckets: dict[int, int] = {}
+        self.count = 0
+        self.sum_seconds = 0.0
+        self.max_seconds = 0.0
+
+    def record(self, seconds: float) -> None:
+        ns = int(seconds * 1e9)
+        idx = ns.bit_length() if ns > 0 else 0
+        with self._lock:
+            self.buckets[idx] = self.buckets.get(idx, 0) + 1
+            self.count += 1
+            self.sum_seconds += seconds
+            if seconds > self.max_seconds:
+                self.max_seconds = seconds
+
+    def merge_from(self, other: "LatencyHistogram") -> None:
+        with other._lock:
+            snap = (dict(other.buckets), other.count, other.sum_seconds,
+                    other.max_seconds)
+        self._merge_snap(*snap)
+
+    def _merge_snap(self, buckets, count, sum_s, max_s) -> None:
+        with self._lock:
+            for i, n in buckets.items():
+                self.buckets[i] = self.buckets.get(i, 0) + n
+            self.count += count
+            self.sum_seconds += sum_s
+            self.max_seconds = max(self.max_seconds, max_s)
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile in seconds (geometric bucket midpoint)."""
+        with self._lock:
+            if not self.count:
+                return 0.0
+            target = q * self.count
+            seen = 0
+            for i in sorted(self.buckets):
+                seen += self.buckets[i]
+                if seen >= target:
+                    if i == 0:
+                        return 0.0
+                    # bucket spans [2^(i-1), 2^i) ns: geometric midpoint
+                    return (2.0 ** (i - 0.5)) / 1e9
+            return self.max_seconds
+
+    @property
+    def mean_seconds(self) -> float:
+        return self.sum_seconds / self.count if self.count else 0.0
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            buckets = {str(i): n for i, n in sorted(self.buckets.items())}
+            count, sum_s, max_s = self.count, self.sum_seconds, self.max_seconds
+        return {
+            "count": count,
+            "sum_seconds": round(sum_s, 6),
+            "max_seconds": round(max_s, 6),
+            "p50_seconds": round(self.quantile(0.50), 9),
+            "p95_seconds": round(self.quantile(0.95), 9),
+            "buckets": buckets,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LatencyHistogram":
+        h = cls()
+        h._merge_snap({int(i): int(n) for i, n in d.get("buckets", {}).items()},
+                      int(d.get("count", 0)), float(d.get("sum_seconds", 0.0)),
+                      float(d.get("max_seconds", 0.0)))
+        return h
+
+    def merge_dict(self, d: dict) -> None:
+        """Fold a serialized histogram (another process's) into this one."""
+        self._merge_snap(
+            {int(i): int(n) for i, n in d.get("buckets", {}).items()},
+            int(d.get("count", 0)), float(d.get("sum_seconds", 0.0)),
+            float(d.get("max_seconds", 0.0)))
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+class _NullSpan:
+    """The shared no-op context manager a disabled tracer hands out."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tr", "_name", "_args", "_t0")
+
+    def __init__(self, tr, name, args):
+        self._tr = tr
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._tr.complete(self._name, self._t0, time.perf_counter(),
+                          **self._args)
+        return False
+
+
+class Tracer:
+    """Thread-safe span tracer with Chrome trace-event JSON export.
+
+    Spans are recorded as complete events (``ph: "X"``: one event carrying
+    ``ts`` + ``dur`` in microseconds on the shared ``perf_counter`` clock),
+    so nesting is implied by containment — Perfetto and ``chrome://tracing``
+    rebuild the flame graph per (pid, tid) without begin/end pairing.
+    ``instant``/``counter`` events carry point-in-time facts (a chunk's
+    chosen ship route, the shuffle window's occupancy).
+
+    When ``enabled`` is False every record call is one ``if`` and ``span()``
+    returns a module-level no-op singleton — the hot loops keep their obs
+    calls unconditionally and pay <3% (tier-1 guarded).
+    """
+
+    def __init__(self, path: "str | None" = None, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self.path = path
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        self._named_tids: set[int] = set()
+        self._written = False
+        if path is not None and self.enabled:
+            atexit.register(self._atexit_write)
+
+    # -- recording ------------------------------------------------------------
+
+    def _tid(self) -> int:
+        t = threading.current_thread()
+        tid = t.ident or 0
+        if tid not in self._named_tids:
+            with self._lock:
+                if tid not in self._named_tids:  # re-check under the lock
+                    self._named_tids.add(tid)
+                    self._events.append({
+                        "name": "thread_name", "ph": "M",
+                        "pid": self._pid, "tid": tid,
+                        "args": {"name": t.name},
+                    })
+        return tid
+
+    def span(self, name: str, **args):
+        """Context manager timing a nested span (no-op when disabled)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args)
+
+    def complete(self, name: str, t0: float, t1: float, **args) -> None:
+        """Record an already-timed interval (perf_counter seconds)."""
+        if not self.enabled:
+            return
+        ev = {
+            "name": name, "ph": "X", "ts": int(t0 * 1e6),
+            "dur": max(int((t1 - t0) * 1e6), 0),
+            "pid": self._pid, "tid": self._tid(),
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def instant(self, name: str, **args) -> None:
+        if not self.enabled:
+            return
+        ev = {
+            "name": name, "ph": "i", "s": "t",
+            "ts": int(time.perf_counter() * 1e6),
+            "pid": self._pid, "tid": self._tid(),
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def counter(self, name: str, **values) -> None:
+        if not self.enabled:
+            return
+        ev = {
+            "name": name, "ph": "C",
+            "ts": int(time.perf_counter() * 1e6),
+            "pid": self._pid, "tid": self._tid(),
+            "args": values,
+        }
+        with self._lock:
+            self._events.append(ev)
+
+    # -- merge / export -------------------------------------------------------
+
+    def merge_events(self, events: list) -> None:
+        """Fold exported events (typically another process's) in verbatim —
+        pids differ, so Perfetto renders them as separate process tracks."""
+        with self._lock:
+            self._events.extend(events)
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def export(self, registry: "StatsRegistry | None" = None) -> dict:
+        """The Chrome trace-event *object form*: events plus ``otherData``
+        (obs version, and the registry tree when given — so one artifact
+        carries both the timeline and the aggregate metrics)."""
+        other: dict = {"obs_version": OBS_VERSION}
+        if registry is not None:
+            other["registry"] = registry.as_dict()
+        return {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+            "otherData": other,
+        }
+
+    def write(self, path: "str | None" = None,
+              registry: "StatsRegistry | None" = None) -> "str | None":
+        """Serialize to ``path`` (default: the construction path)."""
+        path = path or self.path
+        if path is None:
+            return None
+        with open(path, "w") as f:
+            json.dump(self.export(registry), f)
+            f.write("\n")
+        self._written = True
+        return path
+
+    def _atexit_write(self) -> None:
+        if self._written or not self._events:
+            return
+        try:
+            self.write()
+        except OSError:
+            pass  # interpreter teardown: a dead path must not mask the exit
+
+
+_DISABLED = Tracer(enabled=False)
+_global: "Tracer | None" = None
+_global_key: "str | None" = None
+_global_lock = threading.Lock()
+
+
+def current_tracer() -> Tracer:
+    """The process-wide tracer: enabled iff ``TPQ_TRACE=<path>`` is set
+    (rebuilt when the env changes, so monkeypatched tests see theirs); the
+    shared disabled singleton otherwise."""
+    global _global, _global_key
+    key = os.environ.get("TPQ_TRACE", "")
+    if not key:
+        return _DISABLED if _global_key in (None, "") else _refresh("")
+    with _global_lock:
+        if _global is None or _global_key != key:
+            _global = Tracer(path=key)
+            _global_key = key
+        return _global
+
+
+def _refresh(key: str) -> Tracer:
+    global _global, _global_key
+    with _global_lock:
+        _global, _global_key = None, key
+    return _DISABLED
+
+
+def resolve_tracer(trace) -> "tuple[Tracer, bool]":
+    """Resolve a ``trace=`` kwarg to ``(tracer, owned)``.
+
+    ``None`` → the process tracer (owned by the process, not the caller);
+    a path → a fresh enabled tracer the CALLER must ``write()`` (readers do
+    so in ``close()``); a :class:`Tracer` → itself, not owned.
+    """
+    if trace is None:
+        return current_tracer(), False
+    if isinstance(trace, Tracer):
+        return trace, False
+    return Tracer(path=os.fspath(trace)), True
+
+
+# ---------------------------------------------------------------------------
+# unified registry
+# ---------------------------------------------------------------------------
+
+# keys that are peaks/config, not flows: composition takes the max
+_MERGE_MAXED = frozenset((
+    "peak_in_flight_bytes", "window_peak_rows", "prefetch", "budget_bytes",
+))
+# ratios/rates derived from the flows: summing them is meaningless (four
+# files' overlap_efficiency is not their sum) — the merge drops them and
+# as_dict() recomputes each from the merged numerators/denominators
+_MERGE_DERIVED = frozenset((
+    "overlap_efficiency", "rows_per_sec", "bytes_per_sec", "pages_per_chunk",
+    "batches_per_sec",
+))
+
+
+def _merge_num_tree(dst: dict, src: dict) -> None:
+    """Fold one numeric tree into another: dicts recurse, flows add, peaks
+    and config take the max, derived ratios are dropped (recomputed at
+    ``as_dict``), anything else last-writer-wins."""
+    for k, v in src.items():
+        if k in _MERGE_DERIVED:
+            continue
+        if isinstance(v, dict):
+            _merge_num_tree(dst.setdefault(k, {}), v)
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            if k in _MERGE_MAXED:
+                dst[k] = max(dst.get(k, 0), v)
+            else:
+                dst[k] = dst.get(k, 0) + v
+        else:
+            dst[k] = v
+
+
+def _ratio(num, den, digits):
+    return round(num / den, digits) if den else 0.0
+
+
+def _recompute_derived(tree: dict) -> None:
+    """Rebuild the `_MERGE_DERIVED` ratios of a composed tree from its
+    merged flows, section by section (the formulas mirror PipelineStats /
+    ReaderStats / LoaderStats properties)."""
+    pipe, reader, loader = (tree.get("pipeline"), tree.get("reader"),
+                            tree.get("loader"))
+    if pipe:
+        pipe["overlap_efficiency"] = _ratio(
+            pipe.get("busy_seconds", 0.0), pipe.get("wall_seconds", 0.0), 3)
+    if reader:
+        wall = reader.get("wall_seconds", 0.0)
+        reader["rows_per_sec"] = _ratio(reader.get("rows", 0), wall, 1)
+        reader["bytes_per_sec"] = _ratio(
+            reader.get("compressed_bytes", 0), wall, 1)
+        reader["pages_per_chunk"] = _ratio(
+            reader.get("pages", 0), reader.get("chunks", 0), 3)
+    if loader:
+        wall = loader.get("wall_seconds", 0.0)
+        loader["rows_per_sec"] = _ratio(loader.get("rows", 0), wall, 1)
+        loader["batches_per_sec"] = _ratio(loader.get("batches", 0), wall, 3)
+
+
+class StatsRegistry:
+    """One versioned tree over every stats surface the engine already has.
+
+    Sources accumulate (``add_*`` may be called once per reader/file of a
+    multi-file scan); ``as_dict()`` snapshots the composition.  The tree is
+    versioned (``obs_version``) and golden-key-tested so bench parsers and
+    the driver can't silently break on key drift.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pipeline: "dict | None" = None
+        self._reader: "dict | None" = None
+        self._loader: "dict | None" = None
+        self._alloc_peak = 0
+        self._hists: dict[str, LatencyHistogram] = {}
+
+    # -- composition ----------------------------------------------------------
+
+    def histogram(self, name: str) -> LatencyHistogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = LatencyHistogram()
+            return h
+
+    def add_pipeline(self, pstats) -> None:
+        """Fold a :class:`~tpu_parquet.pipeline.PipelineStats` in (its
+        per-stage histograms become registry histograms ``stage.<name>``)."""
+        d = pstats.as_dict()
+        hists = d.pop("stage_histograms", {})
+        with self._lock:
+            if self._pipeline is None:
+                self._pipeline = {}
+            _merge_num_tree(self._pipeline, d)
+        for stage, hd in hists.items():
+            self.histogram(f"stage.{stage}").merge_dict(hd)
+
+    def add_reader(self, rstats) -> None:
+        """Fold a :class:`~tpu_parquet.device_reader.ReaderStats` in."""
+        with self._lock:
+            if self._reader is None:
+                self._reader = {}
+            _merge_num_tree(self._reader, rstats.as_dict())
+
+    def add_loader(self, lstats) -> None:
+        """Fold a :class:`~tpu_parquet.data.loader.LoaderStats` in (its
+        nested pipeline section routes to the pipeline composition)."""
+        d = lstats.as_dict()
+        pipe = d.pop("pipeline", None)
+        with self._lock:
+            if self._loader is None:
+                self._loader = {}
+            _merge_num_tree(self._loader, d)
+        if pipe is not None:
+            self.add_pipeline(lstats.pipeline)
+
+    def note_alloc_peak(self, tracker) -> None:
+        """Record an :class:`~tpu_parquet.alloc.AllocTracker`'s high-water
+        mark (its ``peak`` attribute; raw ints accepted for tests)."""
+        peak = int(getattr(tracker, "peak", tracker or 0))
+        with self._lock:
+            self._alloc_peak = max(self._alloc_peak, peak)
+
+    def merge_from(self, other: "StatsRegistry") -> None:
+        with other._lock:
+            pipeline = dict(other._pipeline) if other._pipeline else None
+            reader = dict(other._reader) if other._reader else None
+            loader = dict(other._loader) if other._loader else None
+            peak = other._alloc_peak
+            hists = dict(other._hists)
+        with self._lock:
+            for name, src in (("_pipeline", pipeline), ("_reader", reader),
+                              ("_loader", loader)):
+                if src is None:
+                    continue
+                dst = getattr(self, name)
+                if dst is None:
+                    setattr(self, name, dst := {})
+                _merge_num_tree(dst, src)
+            self._alloc_peak = max(self._alloc_peak, peak)
+        for name, h in hists.items():
+            self.histogram(name).merge_from(h)
+
+    def merge_dict(self, tree: dict) -> None:
+        """Fold a serialized registry tree (another process's) in."""
+        if tree.get("obs_version") != OBS_VERSION:
+            raise ValueError(
+                f"obs_version {tree.get('obs_version')!r} != {OBS_VERSION}")
+        for key, attr in (("pipeline", "_pipeline"), ("reader", "_reader"),
+                          ("loader", "_loader")):
+            src = tree.get(key)
+            if src is None:
+                continue
+            src = dict(src)
+            src.pop("ship_feedback", None)
+            with self._lock:
+                dst = getattr(self, attr)
+                if dst is None:
+                    setattr(self, attr, dst := {})
+                _merge_num_tree(dst, src)
+        with self._lock:
+            self._alloc_peak = max(self._alloc_peak,
+                                   int(tree.get("alloc", {})
+                                       .get("peak_bytes", 0)))
+        for name, hd in tree.get("histograms", {}).items():
+            self.histogram(name).merge_dict(hd)
+
+    # -- reporting ------------------------------------------------------------
+
+    def ship_feedback(self) -> dict:
+        """Per-route predicted vs measured link-lane seconds.
+
+        Predicted: the ship planner's modeled bottleneck-lane seconds for
+        each stream's CHOSEN route (summed per route — ReaderStats carries
+        them next to the byte counters).  Measured: the route's shipped
+        bytes through the link rate this run actually achieved
+        (staged bytes / stage-stage seconds — the staging span IS the link
+        lane).  ``error_ratio`` = measured/predicted: >1 means the model
+        was optimistic (raise ``TPQ_LINK_MBPS``'s denominator — i.e. the
+        link was slower than planned), <1 pessimistic.
+        """
+        with self._lock:
+            reader = dict(self._reader or {})
+            pipeline = dict(self._pipeline or {})
+        routes = reader.get("ship_routes") or {}
+        staged = reader.get("staged_bytes") or 0
+        stage_s = pipeline.get("stage_seconds") or 0.0
+        link_bps = staged / stage_s if staged and stage_s else 0.0
+        out = {}
+        for route, c in sorted(routes.items()):
+            entry = {
+                "streams": c.get("streams", 0),
+                "shipped_bytes": c.get("shipped", 0),
+                "predicted_seconds": round(c.get("predicted_s", 0.0), 6),
+            }
+            if link_bps:
+                measured = c.get("shipped", 0) / link_bps
+                entry["measured_seconds"] = round(measured, 6)
+                if entry["predicted_seconds"]:
+                    entry["error_ratio"] = round(
+                        measured / entry["predicted_seconds"], 3)
+            out[route] = entry
+        return {"link_bytes_per_sec": round(link_bps, 1), "routes": out}
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            tree = {
+                "obs_version": OBS_VERSION,
+                "pipeline": dict(self._pipeline) if self._pipeline else None,
+                "reader": dict(self._reader) if self._reader else None,
+                "loader": dict(self._loader) if self._loader else None,
+                "alloc": {"peak_bytes": self._alloc_peak},
+                "histograms": {n: h.as_dict()
+                               for n, h in sorted(self._hists.items())},
+            }
+        _recompute_derived(tree)
+        if tree["reader"] is not None:
+            tree["reader"]["ship_feedback"] = self.ship_feedback()
+        return tree
+
+
+# ---------------------------------------------------------------------------
+# trace summarization (the pq_tool backend)
+# ---------------------------------------------------------------------------
+
+# the span names PipelineStats.timed emits — the busy-seconds basis of
+# overlap efficiency, kept in lockstep with pipeline.STAGES by test_obs
+PIPELINE_SPAN_NAMES = ("io", "decompress", "recompress", "stage", "dispatch",
+                       "finalize")
+
+
+def _exact_quantile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def trace_summary(doc) -> dict:
+    """Aggregate a Chrome trace-event document (object or bare-array form)
+    into the per-stage/overlap/stall/route report ``pq_tool trace`` prints.
+
+    Works from the trace alone: stage stats come from the ``X`` spans
+    (exact p50/p95 over the recorded durations — the full population is in
+    hand, no histogram approximation needed), overlap efficiency is
+    busy/wall over the pipeline span names, stall attribution from the
+    ``stall`` spans, and route prediction error from the ``ship`` instants'
+    args against the measured link lane (staged bytes / stage seconds).
+    """
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents")
+        other = doc.get("otherData") or {}
+    else:
+        events, other = doc, {}
+    if not isinstance(events, list):
+        raise ValueError("not a trace-event document: no traceEvents array")
+    spans: dict[str, list[float]] = {}
+    ships: list[dict] = []
+    t_min, t_max = None, None
+    n_threads = set()
+    pipe_walls: dict = {}  # (pid, pipe-token) -> that pipeline's max wall
+    for ev in events:
+        if not isinstance(ev, dict):
+            raise ValueError("malformed trace event (not an object)")
+        ph = ev.get("ph")
+        if ph == "M":
+            continue
+        n_threads.add((ev.get("pid"), ev.get("tid")))
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        end = ts + ev.get("dur", 0)
+        t_min = ts if t_min is None else min(t_min, ts)
+        t_max = end if t_max is None else max(t_max, end)
+        if ph == "X":
+            spans.setdefault(ev.get("name", "?"), []).append(
+                ev.get("dur", 0) / 1e6)
+        elif ph == "i" and ev.get("name") == "ship":
+            ships.append(ev.get("args") or {})
+        elif ph == "C" and ev.get("name") == "pipeline_wall":
+            args = ev.get("args") or {}
+            key = (ev.get("pid"), args.get("pipe"))
+            pipe_walls[key] = max(pipe_walls.get(key, 0.0),
+                                  float(args.get("seconds", 0)))
+    # the overlap denominator: the PipelineStats wall clocks when they rode
+    # the trace — each stats object's counter is cumulative, so take its
+    # max, then SUM across objects (one per file of a scan: sequential
+    # segments whose busy spans the numerator also sums).  Falls back to
+    # the span extent for traces with no pipeline counters.
+    pipe_wall = sum(pipe_walls.values())
+    wall = pipe_wall or ((t_max - t_min) / 1e6 if t_min is not None else 0.0)
+    stages = {}
+    for name, durs in sorted(spans.items()):
+        durs.sort()
+        stages[name] = {
+            "count": len(durs),
+            "total_seconds": round(sum(durs), 6),
+            "p50_seconds": round(_exact_quantile(durs, 0.50), 9),
+            "p95_seconds": round(_exact_quantile(durs, 0.95), 9),
+            "max_seconds": round(durs[-1], 9),
+        }
+    busy = sum(stages[s]["total_seconds"] for s in PIPELINE_SPAN_NAMES
+               if s in stages)
+    stall = stages.get("stall", {}).get("total_seconds", 0.0)
+    # measured link lane: the stage spans carry their staged byte counts
+    stage_bytes = 0
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("name") == "stage":
+            stage_bytes += (ev.get("args") or {}).get("bytes", 0)
+    stage_s = stages.get("stage", {}).get("total_seconds", 0.0)
+    link_bps = stage_bytes / stage_s if stage_bytes and stage_s else 0.0
+    routes: dict[str, dict] = {}
+    for s in ships:
+        r = routes.setdefault(str(s.get("route", "?")), {
+            "streams": 0, "logical_bytes": 0, "shipped_bytes": 0,
+            "predicted_seconds": 0.0,
+        })
+        r["streams"] += 1
+        r["logical_bytes"] += int(s.get("logical", 0))
+        r["shipped_bytes"] += int(s.get("shipped", 0))
+        r["predicted_seconds"] += float(s.get("predicted_s", 0.0))
+    for r in routes.values():
+        r["predicted_seconds"] = round(r["predicted_seconds"], 6)
+        if link_bps:
+            r["measured_seconds"] = round(r["shipped_bytes"] / link_bps, 6)
+            if r["predicted_seconds"]:
+                r["error_ratio"] = round(
+                    r["measured_seconds"] / r["predicted_seconds"], 3)
+    return {
+        "obs_version": other.get("obs_version"),
+        "events": len(events),
+        "threads": len(n_threads),
+        "wall_seconds": round(wall, 6),
+        "busy_seconds": round(busy, 6),
+        "overlap_efficiency": round(busy / wall, 3) if wall else 0.0,
+        "stall_seconds": round(stall, 6),
+        "stall_share": round(stall / wall, 4) if wall else 0.0,
+        "stages": stages,
+        "link_bytes_per_sec": round(link_bps, 1),
+        "routes": dict(sorted(routes.items())),
+        "registry": other.get("registry"),
+    }

@@ -39,6 +39,7 @@ deadlock against itself.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
@@ -48,11 +49,17 @@ from contextlib import contextmanager
 from typing import Callable, Iterable, Iterator, Optional, TypeVar
 
 from .alloc import InFlightBudget
+from .obs import LatencyHistogram, current_tracer
 
 T = TypeVar("T")
 R = TypeVar("R")
 
 STAGES = ("io", "decompress", "recompress", "stage", "dispatch", "finalize")
+
+# per-PipelineStats token riding the pipeline_wall trace counter: one trace
+# often carries several stats objects (one per file of a scan), and the
+# summarizer must sum each pipeline's own wall, not max across all of them
+_pstats_ids = itertools.count(1)
 
 
 class PipelineStats:
@@ -75,9 +82,16 @@ class PipelineStats:
     for a perfectly serial run and >1 when stages genuinely overlap.
     ``stall_seconds`` counts submitter time blocked on the memory budget.
     Thread-safe: workers and the main thread add concurrently.
+
+    Each ``add``/``timed`` also feeds a per-stage log-bucketed
+    :class:`~tpu_parquet.obs.LatencyHistogram` (p50/p95 where the sums
+    alone can't attribute a stall — see obs.StatsRegistry), and ``timed``
+    emits a span on ``tracer`` (the ``TPQ_TRACE`` process tracer by
+    default; a disabled tracer costs one ``if``).
     """
 
-    def __init__(self, prefetch: int = 0, budget_bytes: int = 0):
+    def __init__(self, prefetch: int = 0, budget_bytes: int = 0,
+                 tracer=None):
         self.prefetch = int(prefetch)
         self.budget_bytes = int(budget_bytes)
         self.chunks = 0
@@ -86,26 +100,41 @@ class PipelineStats:
         self.wall_seconds = 0.0
         self.peak_in_flight_bytes = 0
         self._stage_seconds = {s: 0.0 for s in STAGES}
+        self._stage_hist = {s: LatencyHistogram() for s in STAGES}
+        self.tracer = tracer if tracer is not None else current_tracer()
+        self._obs_id = next(_pstats_ids)
         self._lock = threading.Lock()
         self._t0: Optional[float] = None
 
     # -- accumulation ---------------------------------------------------------
 
     def add(self, stage: str, seconds: float) -> None:
+        if stage not in self._stage_seconds:
+            raise ValueError(
+                f"unknown pipeline stage {stage!r}; valid stages: "
+                f"{', '.join(STAGES)}")
         with self._lock:
             self._stage_seconds[stage] += seconds
+        self._stage_hist[stage].record(seconds)
 
     @contextmanager
-    def timed(self, stage: str):
+    def timed(self, stage: str, **span_args):
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.add(stage, time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            self.add(stage, t1 - t0)
+            tr = self.tracer
+            if tr is not None and tr.enabled:
+                tr.complete(stage, t0, t1, **span_args)
 
-    def add_stall(self, seconds: float) -> None:
+    def add_stall(self, seconds: float, t0: Optional[float] = None) -> None:
         with self._lock:
             self.stall_seconds += seconds
+        tr = self.tracer
+        if tr is not None and tr.enabled and t0 is not None:
+            tr.complete("stall", t0, t0 + seconds)
 
     def count_chunk(self) -> None:
         with self._lock:
@@ -122,6 +151,15 @@ class PipelineStats:
             if self._t0 is None:
                 self._t0 = now
             self.wall_seconds = now - self._t0
+            wall = self.wall_seconds
+        tr = self.tracer
+        if tr is not None and tr.enabled and wall:
+            # the pipeline's own wall clock rides the trace as a counter so
+            # pq_tool trace reports the SAME overlap efficiency as this
+            # object (span extents alone include consumer tails the wall
+            # clock deliberately excludes)
+            tr.counter("pipeline_wall", seconds=round(wall, 6),
+                       pipe=self._obs_id)
 
     def note_peak(self, budget: InFlightBudget) -> None:
         with self._lock:
@@ -145,6 +183,8 @@ class PipelineStats:
             self.row_groups += row_groups
             self.stall_seconds += stall
             self.peak_in_flight_bytes = max(self.peak_in_flight_bytes, peak)
+        for s in STAGES:
+            self._stage_hist[s].merge_from(other._stage_hist[s])
 
     # -- reporting ------------------------------------------------------------
 
@@ -177,6 +217,11 @@ class PipelineStats:
             "stall_seconds": round(self.stall_seconds, 6),
             "peak_in_flight_bytes": self.peak_in_flight_bytes,
             "overlap_efficiency": round(self.overlap_efficiency, 3),
+            # only the stages that saw work: the empty ones carry no
+            # information and would triple the artifact's size
+            "stage_histograms": {s: h.as_dict()
+                                 for s, h in self._stage_hist.items()
+                                 if h.count},
         }
 
 
@@ -315,7 +360,7 @@ def prefetch_map(
                         t0 = time.perf_counter()
                         budget.acquire(c)
                         if stats is not None:
-                            stats.add_stall(time.perf_counter() - t0)
+                            stats.add_stall(time.perf_counter() - t0, t0)
                     if stats is not None:
                         stats.note_peak(budget)
                 carried = None

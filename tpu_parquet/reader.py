@@ -59,7 +59,13 @@ class FileReader:
         metadata: Optional[FileMetaData] = None,
         row_filter=None,
         prefetch: int = 0,
+        trace=None,
     ):
+        from .obs import resolve_tracer
+
+        # span tracer (obs.py): None = the TPQ_TRACE process tracer; a path
+        # = per-reader tracer written (with the registry) at close()
+        self._tracer, self._owns_tracer = resolve_tracer(trace)
         if isinstance(source, (str, os.PathLike)):
             self._f: BinaryIO = open(source, "rb")
             self._owns_file = True
@@ -80,7 +86,8 @@ class FileReader:
             self.alloc = AllocTracker(max_memory)
             self.prefetch = int(prefetch)
             self._pipe_stats = PipelineStats(prefetch=self.prefetch,
-                                             budget_bytes=int(max_memory))
+                                             budget_bytes=int(max_memory),
+                                             tracer=self._tracer)
             self._current_row_group = 0
             self._preloaded: Optional[dict[str, ColumnData]] = None
             # statistics-based row-group pruning (predicate pushdown): groups
@@ -143,6 +150,19 @@ class FileReader:
     def close(self):
         if self._owns_file:
             self._f.close()
+        if self._owns_tracer:
+            self._tracer.write(registry=self.obs_registry())
+            self._owns_tracer = False
+
+    def obs_registry(self):
+        """This reader's unified metrics tree (obs.StatsRegistry): the
+        pipeline's per-stage sums + histograms and the alloc peak."""
+        from .obs import StatsRegistry
+
+        reg = StatsRegistry()
+        reg.add_pipeline(self._pipe_stats)
+        reg.note_alloc_peak(self.alloc)
+        return reg
 
     def __enter__(self):
         return self
@@ -203,7 +223,8 @@ class FileReader:
         device_reader._chunk_feed mirrors this flatten/regroup protocol
         (different payloads); a change here should be checked against it.
         """
-        stats = PipelineStats(prefetch=k, budget_bytes=self.alloc.max_size)
+        stats = PipelineStats(prefetch=k, budget_bytes=self.alloc.max_size,
+                              tracer=self._tracer)
         self._pipe_stats = stats
         budget = InFlightBudget(self.alloc.max_size)
         sr = SharedReader(self._f)

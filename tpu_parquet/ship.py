@@ -197,11 +197,21 @@ class ShipPlanner:
         the route that cannot fail — terminates the walk wherever it
         ranks, so entries after it are dead fallbacks.
         """
-        if self.force is not None:
-            return ([self.force, ROUTE_PLAIN] if self.force != ROUTE_PLAIN
-                    else [ROUTE_PLAIN])
+        return self.plan(f)[0]
+
+    def plan(self, f: ChunkFacts) -> "tuple[list, dict]":
+        """``(routes, costs)``: the ordered candidates of :meth:`routes`
+        plus the modeled seconds per feasible route — builders keep the
+        costs so the chosen route's *prediction* can ride the obs layer
+        next to the measured lanes (TPQ_LINK_MBPS calibration feedback).
+        A forced route that the model never priced (infeasible) simply has
+        no entry; consumers treat a missing prediction as 0."""
         c = self.costs(f)
-        return sorted(c, key=lambda r: (c[r], ROUTES.index(r)))
+        if self.force is not None:
+            order = ([self.force, ROUTE_PLAIN] if self.force != ROUTE_PLAIN
+                     else [ROUTE_PLAIN])
+            return order, c
+        return sorted(c, key=lambda r: (c[r], ROUTES.index(r))), c
 
     def decision_table(self, f: ChunkFacts) -> dict:
         """Route → modeled milliseconds (README/debug surface)."""
