@@ -1,0 +1,579 @@
+"""Run ledger + noise-aware bench diff + doctor attribution tests (ISSUE 5).
+
+Covers tentpole pieces 2 and 3: the append-only versioned ledger
+(append -> read -> diff round-trip, env fingerprint, ``#N`` addressing),
+the noise model (an injected 2x stage regression is flagged OUTSIDE the
+rep-variance bounds and attributed to the stage that moved; a within-noise
+rerun is NOT flagged), the CI gate (``bench.py --check-against`` exit
+codes, unloadable baseline fails closed), ``doctor_registry``'s four
+bottleneck verdicts with golden CLI output and the ``TPQ_LINK_MBPS``
+recalibration band, and the end-to-end ``bench.py --smoke`` plumbing run
+the tier-1 suite gates on.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_parquet import ledger
+from tpu_parquet.obs import DOCTOR_VERDICTS, doctor_registry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO_ROOT, "bench.py")
+
+
+# ---------------------------------------------------------------------------
+# helpers: canned run records / registry trees
+# ---------------------------------------------------------------------------
+
+def _stages(io_s=0.0, dec=0.0, rec=0.0, stage=0.0, disp=0.0, fin=0.0,
+            stall=0.0):
+    return {
+        "io_seconds": io_s, "decompress_seconds": dec,
+        "recompress_seconds": rec, "stage_seconds": stage,
+        "dispatch_seconds": disp, "finalize_seconds": fin,
+        "stall_seconds": stall,
+    }
+
+
+def _cfg(device=1e7, host=1e6, device_reps=None, host_reps=None, rows=1000,
+         stages=None, **extra):
+    cfg = {
+        "rows": rows,
+        "device_rows_per_sec": device,
+        "host_rows_per_sec": host,
+        "device_windows_s": (device_reps if device_reps is not None
+                             else [[0.100, 0.101, 0.099, 0.100, 0.102]]),
+        "host_reps_s": (host_reps if host_reps is not None
+                        else [1.00, 1.01, 0.99, 1.00]),
+    }
+    if stages is not None:
+        cfg["obs"] = {"obs_version": 1, "pipeline": stages}
+    cfg.update(extra)
+    return cfg
+
+
+def _record(**cfgs):
+    return {"metric": "m", "value": 1.0, "unit": "rows/s",
+            "vs_baseline": 1.0, "configs": cfgs}
+
+
+# ---------------------------------------------------------------------------
+# ledger records
+# ---------------------------------------------------------------------------
+
+def test_append_read_roundtrip_creates_parent_dirs(tmp_path):
+    """The same contract as Tracer.write: a ledger path into a fresh tree
+    must not fail at append time with a late FileNotFoundError."""
+    path = str(tmp_path / "runs" / "today" / "ledger.jsonl")
+    r0 = ledger.make_record(_record(c=_cfg()))
+    r1 = ledger.make_record(_record(c=_cfg(device=2e7)))
+    assert ledger.append(path, r0) == 0
+    assert ledger.append(path, r1) == 1  # sequence numbers count lines
+    back = ledger.read(path)
+    assert back == [r0, r1]
+
+
+def test_read_corrupt_line_names_position(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    path.write_text('{"ok": 1}\n{broken\n')  # complete (newline'd) bad line
+    with pytest.raises(ValueError, match=r"ledger\.jsonl:2"):
+        ledger.read(str(path))
+
+
+def test_torn_tail_skipped_and_healed(tmp_path):
+    """A writer killed mid-append leaves a partial final line (no newline):
+    read() must skip it — the intact records stay usable — and the next
+    append() truncates it away so lines can never glue."""
+    path = str(tmp_path / "ledger.jsonl")
+    ledger.append(path, {"v": 1})
+    with open(path, "a") as f:
+        f.write('{"v": 2, "par')  # died mid-write
+    assert ledger.read(path) == [{"v": 1}]
+    assert ledger.load_side(path) == {"v": 1}
+    assert ledger.append(path, {"v": 3}) == 1  # torn record never counted
+    assert ledger.read(path) == [{"v": 1}, {"v": 3}]
+
+
+def test_make_record_fingerprint(monkeypatch):
+    monkeypatch.setenv("TPQ_LINK_MBPS", "350")
+    monkeypatch.setenv("TPQ_FORCE_ROUTE", "plain")
+    rec = ledger.make_record(_record(c=_cfg()), ts=123.456)
+    assert rec["ledger_version"] == ledger.LEDGER_VERSION
+    assert rec["ts"] == 123.456
+    # two runs with different TPQ_LINK_MBPS are different experiments —
+    # the fingerprint says so
+    assert rec["env"]["TPQ_LINK_MBPS"] == "350"
+    assert rec["env"]["TPQ_FORCE_ROUTE"] == "plain"
+    assert "python" in rec["env"]
+    # inside this repo the short revision resolves
+    rev = rec["git_rev"]
+    assert rev is None or (isinstance(rev, str) and len(rev) == 12)
+    assert rec["configs"]["c"]["rows"] == 1000  # the bench tree rides along
+
+
+def test_load_side_forms(tmp_path):
+    art = tmp_path / "run.json"
+    art.write_text(json.dumps(_record(c=_cfg())))
+    assert ledger.load_side(str(art))["metric"] == "m"
+    lpath = str(tmp_path / "ledger.jsonl")
+    for v in (1.0, 2.0, 3.0):
+        ledger.append(lpath, {"metric": "m", "value": v, "configs": {}})
+    assert ledger.load_side(lpath)["value"] == 3.0          # last by default
+    assert ledger.load_side(lpath + "#0")["value"] == 1.0   # absolute
+    assert ledger.load_side(lpath + "#-2")["value"] == 2.0  # from the end
+    with pytest.raises(ValueError, match="no record #7"):
+        ledger.load_side(lpath + "#7")
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    with pytest.raises(ValueError, match="empty ledger"):
+        ledger.load_side(str(empty))
+    notdict = tmp_path / "list.json"
+    notdict.write_text("[1, 2]")
+    with pytest.raises(ValueError, match="not a run record"):
+        ledger.load_side(str(notdict))
+
+
+def test_rel_noise_small_n_behavior():
+    assert ledger.rel_noise([]) == 0.0
+    assert ledger.rel_noise([1.0]) == 0.0  # no information
+    # n in {2,3}: half-range over median (MAD under-reads at tiny n)
+    assert ledger.rel_noise([1.0, 1.2]) == pytest.approx(0.1 / 1.1)
+    # n >= 4: normal-consistent relative MAD, robust to one eaten rep
+    tight = ledger.rel_noise([1.0, 1.01, 0.99, 1.0, 1.02, 5.0])
+    assert tight < 0.05  # the 5.0 outlier does not blow up the band
+
+
+# ---------------------------------------------------------------------------
+# diff: noise bounds, attribution, incomparability
+# ---------------------------------------------------------------------------
+
+def test_diff_within_noise_not_flagged():
+    """A rerun that moved 5% on metrics whose reps carry ~1% noise stays
+    under the 10% human floor: within_noise, nothing flagged."""
+    a = _record(c=_cfg(device=1.00e7, host=1.00e6))
+    b = _record(c=_cfg(device=1.05e7, host=0.96e6))
+    d = ledger.diff(a, b)
+    assert d["compared"] >= 2
+    assert d["regressions"] == [] and d["improvements"] == []
+    assert all(e["verdict"] == "within_noise" for e in d["metrics"].values())
+
+
+def test_diff_flags_injected_2x_regression_with_attribution():
+    """The acceptance scenario: a synthetic 2x device slowdown whose
+    registry shows the decompress lane growing 2.1x must be flagged
+    outside the noise bounds AND attributed to that stage."""
+    a = _record(c=_cfg(device=1e7, stages=_stages(
+        io_s=0.2, dec=1.0, stage=0.5, fin=0.1)))
+    b = _record(c=_cfg(device=5e6, stages=_stages(
+        io_s=0.2, dec=2.1, stage=0.5, fin=0.1)))
+    d = ledger.diff(a, b)
+    flagged = [e for e in d["regressions"]
+               if e["metric"] == "device_rows_per_sec"]
+    assert len(flagged) == 1
+    e = flagged[0]
+    assert e["ratio"] == pytest.approx(0.5)
+    assert e["noise_bound"] < 0.5  # the band did not swallow a 2x move
+    att = e["attribution"]
+    assert att["stage"] == "decompress"
+    assert att["ratio"] == pytest.approx(2.1)
+    assert att["moved_seconds"] == pytest.approx(1.1)
+    # the improvement direction never lands in regressions
+    up = _record(c=_cfg(device=2e7))
+    d2 = ledger.diff(a, up)
+    assert any(e["metric"] == "device_rows_per_sec"
+               for e in d2["improvements"])
+    assert not d2["regressions"]
+
+
+def test_diff_noisy_reps_widen_the_band():
+    """The same -33% move: flagged on tight reps, absorbed when the reps
+    themselves scatter 20% — the band comes from the records' variance."""
+    a_tight = _record(c=_cfg(device=1.0e7))
+    b_tight = _record(c=_cfg(device=0.67e7))
+    assert ledger.diff(a_tight, b_tight)["regressions"]
+    noisy = [[0.080, 0.120, 0.095, 0.140, 0.070]]
+    a_noisy = _record(c=_cfg(device=1.0e7, device_reps=noisy))
+    b_noisy = _record(c=_cfg(device=0.67e7, device_reps=noisy))
+    d = ledger.diff(a_noisy, b_noisy)
+    assert not [e for e in d["regressions"]
+                if e["metric"] == "device_rows_per_sec"]
+
+
+def test_diff_rows_mismatch_incomparable():
+    """A smoke run against a full-scale baseline is a different experiment
+    — 'incomparable', never a fake 100x regression."""
+    a = _record(c=_cfg(rows=5_000_000))
+    b = _record(c=_cfg(device=1e5, rows=20_000))
+    d = ledger.diff(a, b)
+    assert d["compared"] == 0 and not d["regressions"]
+    assert d["incomparable"][0]["config"] == "c"
+    assert "5000000" in d["incomparable"][0]["reason"]
+
+
+def test_diff_link_bytes_ratio_lower_is_better():
+    a = _record(c=_cfg(link_bytes_ratio=1.0))
+    down = _record(c=_cfg(link_bytes_ratio=0.7))
+    up = _record(c=_cfg(link_bytes_ratio=1.5))
+    assert any(e["metric"] == "link_bytes_ratio"
+               for e in ledger.diff(a, down)["improvements"])
+    assert any(e["metric"] == "link_bytes_ratio"
+               for e in ledger.diff(a, up)["regressions"])
+
+
+def test_check_gate_floor_wider_than_diff():
+    """-20% beyond tight noise: the 10% human diff flags it, the 30% CI
+    gate (2x-class regressions, not drift) does not."""
+    a = _record(c=_cfg(device=1.0e7))
+    b = _record(c=_cfg(device=0.8e7))
+    assert ledger.diff(a, b)["regressions"]
+    assert ledger.check(a, b) == []
+    big = _record(c=_cfg(device=0.4e7))
+    assert ledger.check(a, big)
+
+
+def test_format_diff_and_history_render():
+    a = _record(c=_cfg(device=1e7, stages=_stages(dec=1.0)))
+    b = _record(c=_cfg(device=5e6, stages=_stages(dec=2.1)))
+    text = ledger.format_diff(ledger.diff(a, b), "A", "B")
+    assert "REGRESSION" in text and "c.device_rows_per_sec" in text
+    assert "decompress stage moved 2.10x" in text
+    clean = ledger.format_diff(ledger.diff(a, a), "A", "A")
+    assert "within noise" in clean
+    recs = [ledger.make_record({"metric": "m", "value": 1e7,
+                                "unit": "rows/s", "vs_baseline": 2.0,
+                                "configs": {}}, ts=100.0)]
+    hist = ledger.format_history(recs, "ledger.jsonl")
+    assert "#0" in hist and "m=10,000,000 rows/s" in hist
+
+
+# ---------------------------------------------------------------------------
+# doctor: the four verdicts + recalibration band (golden CLI output)
+# ---------------------------------------------------------------------------
+
+_VERDICT_TREES = {
+    "link-bound": _stages(io_s=0.5, dec=0.5, stage=5.0, disp=0.2),
+    "host-decompress-bound": _stages(io_s=2.0, dec=3.0, stage=1.0, disp=0.2),
+    "stall-bound": _stages(io_s=0.5, dec=0.5, stage=1.0, stall=6.0),
+    "device-resolve-bound": _stages(io_s=0.5, dec=0.5, stage=1.0, disp=2.0,
+                                    fin=2.5),
+}
+
+
+@pytest.mark.parametrize("verdict", sorted(_VERDICT_TREES))
+def test_doctor_four_verdicts_golden_output(verdict, tmp_path):
+    tree = {"obs_version": 1, "pipeline": _VERDICT_TREES[verdict]}
+    rep = doctor_registry(tree)
+    assert rep["verdict"] == verdict
+    assert rep["verdict"] == DOCTOR_VERDICTS[rep["dominant_lane"]]
+    total = sum(rep["lanes"].values())
+    assert rep["dominant_share"] == pytest.approx(
+        rep["lanes"][rep["dominant_lane"]] / total, abs=1e-4)
+    # golden CLI rendering on the canned registry
+    from tpu_parquet.cli import pq_tool
+
+    p = str(tmp_path / "reg.json")
+    with open(p, "w") as f:
+        json.dump(tree, f)
+    out = io.StringIO()
+    args = pq_tool.build_parser().parse_args(["doctor", p])
+    assert args.func(args, out=out) == 0
+    text = out.getvalue()
+    assert (f"verdict: {verdict} ({100 * rep['dominant_share']:.0f}% of "
+            f"lane seconds)") in text
+    # lanes print sorted by seconds, dominant first
+    lanes_line = next(l for l in text.splitlines() if l.startswith("lanes:"))
+    assert lanes_line.split()[1].startswith(rep["dominant_lane"] + "=")
+
+
+def test_doctor_host_seconds_fallback():
+    """A prefetch=0 run that never routed through the chunk pool has no
+    io/decompress seconds — the reader's host_seconds is the host lane."""
+    tree = {"obs_version": 1, "pipeline": _stages(stage=0.5),
+            "reader": {"host_seconds": 4.0}}
+    rep = doctor_registry(tree)
+    assert rep["verdict"] == "host-decompress-bound"
+    assert rep["lanes"]["host_decompress"] == pytest.approx(4.0)
+
+
+def test_doctor_empty_and_malformed():
+    assert doctor_registry({}) is None
+    assert doctor_registry({"pipeline": _stages()}) is None  # all-zero lanes
+    assert doctor_registry(None) is None
+    assert doctor_registry({"pipeline": "nope"}) is None
+
+
+def _feedback_tree(predicted, measured, link_bps, stages=None):
+    routes = {"plain": {"streams": 1, "shipped_bytes": 1 << 20,
+                        "predicted_seconds": predicted,
+                        "measured_seconds": measured,
+                        "error_ratio": (round(measured / predicted, 3)
+                                        if measured and predicted else None)}}
+    return {
+        "obs_version": 1,
+        "pipeline": stages or _stages(io_s=0.5, dec=0.5, stage=2.0),
+        "reader": {"planner_link_mbps": 350.0,
+                   "ship_feedback": {"link_bytes_per_sec": link_bps,
+                                     "routes": routes}},
+    }
+
+
+def test_doctor_recalibration_band():
+    # model 2x optimistic (outside the band): prints the measured rate as
+    # the TPQ_LINK_MBPS to re-run with — the 1B re-measure procedure
+    rep = doctor_registry(_feedback_tree(1.0, 2.0, 2.0e8))
+    assert rep["route_model"]["error_ratio"] == pytest.approx(2.0)
+    assert rep["recalibrate_link_mbps"] == pytest.approx(200.0)
+    # within DOCTOR_ERROR_BAND: re-banking changes nothing worth chasing
+    rep = doctor_registry(_feedback_tree(1.0, 1.1, 2.0e8))
+    assert "recalibrate_link_mbps" not in rep
+    # unmeasured routes (null): explicitly no ratio, no recalibration guess
+    rep = doctor_registry(_feedback_tree(1.0, None, 0.0))
+    assert rep["route_model"]["error_ratio"] is None
+    assert "recalibrate_link_mbps" not in rep
+
+
+def test_doctor_cli_on_bench_artifact_and_errors(tmp_path):
+    from tpu_parquet.cli import pq_tool
+
+    art = tmp_path / "bench.json"
+    art.write_text(json.dumps(_record(
+        c=_cfg(stages=_stages(io_s=1.0, dec=2.0, stage=0.5)))))
+    out = io.StringIO()
+    args = pq_tool.build_parser().parse_args(["doctor", str(art)])
+    assert args.func(args, out=out) == 0
+    assert "host-decompress-bound" in out.getvalue()
+    # a registry-less artifact diagnoses instead of tracebacking
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps({"configs": {"c": {"rows": 1}}}))
+    out = io.StringIO()
+    args = pq_tool.build_parser().parse_args(["doctor", str(bare)])
+    assert args.func(args, out=out) == 1
+    assert "no config embeds" in out.getvalue()
+    assert pq_tool.main(["doctor", str(tmp_path / "missing.json")]) == 1
+
+
+def test_pq_tool_bench_diff_and_history_cli(tmp_path):
+    from tpu_parquet.cli import pq_tool
+
+    lpath = str(tmp_path / "ledger.jsonl")
+    ledger.append(lpath, ledger.make_record(
+        _record(c=_cfg(device=1e7)), ts=100.0))
+    ledger.append(lpath, ledger.make_record(
+        _record(c=_cfg(device=5e6, stages=_stages(dec=2.0))), ts=200.0))
+    out = io.StringIO()
+    args = pq_tool.build_parser().parse_args(
+        ["bench", "diff", lpath + "#0", lpath + "#-1"])
+    assert args.func(args, out=out) == 1  # regression -> nonzero
+    assert "REGRESSION" in out.getvalue()
+    out = io.StringIO()
+    args = pq_tool.build_parser().parse_args(
+        ["bench", "diff", lpath + "#0", lpath + "#0"])
+    assert args.func(args, out=out) == 0
+    assert "within noise" in out.getvalue()
+    out = io.StringIO()
+    args = pq_tool.build_parser().parse_args(["bench", "history", lpath])
+    assert args.func(args, out=out) == 0
+    text = out.getvalue()
+    assert "2 runs" in text and "#0" in text and "#1" in text
+
+
+# ---------------------------------------------------------------------------
+# bench gate plumbing (in-process: deterministic exit codes)
+# ---------------------------------------------------------------------------
+
+def test_bench_gate_exit_codes(tmp_path, monkeypatch, capsys):
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_record(
+        c=_cfg(device=1e7, stages=_stages(dec=1.0)))))
+    art = str(tmp_path / "art.json")
+
+    # within the gate floor: rc 0, the check summary rides the record
+    rec = _record(c=_cfg(device=0.95e7, stages=_stages(dec=1.05)))
+    args = bench.parse_args(["--check-against", str(base), "--no-ledger"])
+    assert bench._ledger_and_check(rec, args, art) == 0
+    assert rec["check"]["regressions"] == [] and rec["check"]["compared"] > 0
+    assert "ledger" not in rec  # --no-ledger
+
+    # a 2x-class regression: rc 2, attributed
+    rec = _record(c=_cfg(device=0.4e7, stages=_stages(dec=2.4)))
+    assert bench._ledger_and_check(rec, args, art) == 2
+    assert rec["check"]["regressions"][0]["attribution"]["stage"] == (
+        "decompress")
+
+    # an unloadable baseline fails CLOSED (a typo'd path silently passing
+    # CI is the worst failure mode a gate can have)
+    rec = _record(c=_cfg())
+    args = bench.parse_args(
+        ["--check-against", str(tmp_path / "nope.json"), "--no-ledger"])
+    assert bench._ledger_and_check(rec, args, art) == 2
+    assert rec["check"]["error"]
+
+    # a loadable but WRONG-SHAPE baseline (zero comparable metrics) fails
+    # just as loudly as a typo'd path — a gate that compared nothing
+    # checked nothing
+    empty_base = tmp_path / "wrong.json"
+    empty_base.write_text(json.dumps(_record(other=_cfg(rows=999))))
+    rec = _record(c=_cfg())
+    args = bench.parse_args(["--check-against", str(empty_base),
+                             "--no-ledger"])
+    assert bench._ledger_and_check(rec, args, art) == 2
+    assert rec["check"]["error"] == "no comparable metrics"
+    # and the compact line distinguishes it from a baseline that never
+    # loaded (different triage: config/rows mismatch vs typo'd path)
+    monkeypatch.setenv("BENCH_JSON", str(tmp_path / "b.json"))
+    bench.emit_results(dict(rec))
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(out)["check"] == "incomparable_baseline"
+
+    # a malformed BENCH_CHECK_FLOOR falls back instead of crashing before
+    # the compact line is emitted (the r04/r05 parsed:null failure class)
+    monkeypatch.setenv("BENCH_CHECK_FLOOR", "30%")
+    rec = _record(c=_cfg(device=0.95e7))
+    args = bench.parse_args(["--check-against", str(base), "--no-ledger"])
+    assert bench._ledger_and_check(rec, args, art) == 0
+    assert rec["check"]["floor"] == ledger.DEFAULT_CHECK_FLOOR
+    monkeypatch.delenv("BENCH_CHECK_FLOOR")
+
+    # the automatic ledger append (TPQ_LEDGER override)
+    lpath = str(tmp_path / "runs" / "ledger.jsonl")
+    monkeypatch.setenv("TPQ_LEDGER", lpath)
+    rec = _record(c=_cfg())
+    args = bench.parse_args([])
+    assert bench._ledger_and_check(rec, args, art) == 0
+    assert rec["ledger"] == {"path": lpath, "seq": 0}
+    assert ledger.read(lpath)[0]["ledger_version"] == ledger.LEDGER_VERSION
+
+
+def test_bench_gate_never_self_compares(tmp_path, monkeypatch):
+    """`--check-against ledger.jsonl` with the ledger append active must
+    gate against the PREVIOUS recorded run, not the record this run just
+    appended — a self-comparison is ratio 1.0 on every metric, i.e. a gate
+    that can never fail."""
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    lpath = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("TPQ_LEDGER", lpath)
+    # run 0: the fast prior run
+    ledger.append(lpath, ledger.make_record(_record(c=_cfg(device=1e7))))
+    # run 1: 2x slower, checking against the ledger (its LAST record)
+    rec = _record(c=_cfg(device=0.4e7))
+    args = bench.parse_args(["--check-against", lpath])
+    rc = bench._ledger_and_check(rec, args, str(tmp_path / "art.json"))
+    assert rc == 2, "gate compared the run against itself"
+    assert rec["check"]["regressions"]
+    # and the regressed run was NOT recorded: appending it would make it
+    # the very baseline the next run is gated against (see ratchet test)
+    assert "ledger" not in rec
+    assert len(ledger.read(lpath)) == 1
+
+
+def test_bench_gate_failed_run_never_becomes_baseline(tmp_path, monkeypatch):
+    """The no-ratchet contract: with the ledger itself as the baseline, a
+    regression must keep failing run after run — if the red run were
+    appended, the NEXT run would compare against it, match within noise,
+    and the 2x loss would pass CI forever after one red build."""
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    lpath = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("TPQ_LEDGER", lpath)
+    ledger.append(lpath, ledger.make_record(_record(c=_cfg(device=1e7))))
+    args = bench.parse_args(["--check-against", lpath])
+    art = str(tmp_path / "art.json")
+
+    # the regression fails the gate on EVERY run, not just the first
+    for _ in range(2):
+        rec = _record(c=_cfg(device=0.4e7, stages=_stages(dec=2.4)))
+        assert bench._ledger_and_check(rec, args, art) == 2
+    assert len(ledger.read(lpath)) == 1  # only the good run is recorded
+
+    # a recovered run passes against the original baseline and records
+    rec = _record(c=_cfg(device=0.98e7))
+    assert bench._ledger_and_check(rec, args, art) == 0
+    assert rec["ledger"]["seq"] == 1
+    assert len(ledger.read(lpath)) == 2
+
+
+# ---------------------------------------------------------------------------
+# end-to-end smoke gate (the CI/tooling satellite)
+# ---------------------------------------------------------------------------
+
+def test_bench_smoke_check_against_end_to_end(tmp_path):
+    """`bench.py --smoke --check-against BASELINE.json` end to end in one
+    subprocess: tiny config, artifact + ledger written, gate exits 0
+    against a comparable slower baseline (improvements never fail), the
+    compact stdout line stays <2000 chars with the new ledger/check
+    fields, and `pq_tool doctor` on the traced run names the bottleneck
+    lane consistent with the embedded registry (the acceptance criterion).
+    """
+    # a comparable baseline (same config, same rows) that this machine is
+    # guaranteed to beat: the gate path runs deterministically to exit 0
+    baseline = _record(c=None)
+    baseline["metric"] = "plain_int64_decode_rows_per_sec_device"
+    baseline["configs"] = {"plain_int64": {
+        "rows": 20_000, "device_rows_per_sec": 1.0, "host_rows_per_sec": 1.0,
+        "host_reps_s": [1.0, 1.0], "device_windows_s": [[1.0, 1.0]],
+    }}
+    bpath = tmp_path / "BASELINE.json"
+    bpath.write_text(json.dumps(baseline))
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               BENCH_SCALE="0.002",  # pin rows=20000 to match the baseline
+               BENCH_JSON=str(tmp_path / "run.json"),
+               TPQ_LEDGER=str(tmp_path / "ledger.jsonl"),
+               TPQ_TRACE=str(tmp_path / "trace"))
+    r = subprocess.run(
+        [sys.executable, BENCH, "--smoke", "--check-against", str(bpath)],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env,
+        timeout=280)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    last = r.stdout.strip().splitlines()[-1]
+    assert len(last) < 2000  # the driver's tail window, with the new fields
+    parsed = json.loads(last)
+    assert parsed["check"].startswith("ok")
+    assert parsed["ledger"].endswith("#0")
+    recs = ledger.read(str(tmp_path / "ledger.jsonl"))
+    assert len(recs) == 1
+    assert recs[0]["ledger_version"] == ledger.LEDGER_VERSION
+    assert recs[0]["env"].get("BENCH_SCALE") == "0.002"
+    assert recs[0]["configs"]["plain_int64"]["rows"] == 20_000
+    # the artifact carries the full check entry (improvements included)
+    art = json.loads((tmp_path / "run.json").read_text())
+    assert art["check"]["regressions"] == []
+    assert art["check"]["compared"] > 0
+    # doctor on the traced smoke run: dominant lane matches the registry
+    tdoc = json.loads((tmp_path / "trace.plain_int64.json").read_text())
+    tree = tdoc["otherData"]["registry"]
+    rep = doctor_registry(tree)
+    assert rep is not None
+    pipe = tree["pipeline"]
+
+    def g(k):
+        v = pipe.get(k)
+        return float(v) if isinstance(v, (int, float)) else 0.0
+
+    host = (g("io_seconds") + g("decompress_seconds")
+            + g("recompress_seconds")) or float(
+        (tree.get("reader") or {}).get("host_seconds") or 0.0)
+    lanes = {"link": g("stage_seconds"), "host_decompress": host,
+             "device_resolve": g("dispatch_seconds") + g("finalize_seconds"),
+             "stall": g("stall_seconds")}
+    assert rep["dominant_lane"] == max(lanes, key=lambda k: (lanes[k], k))
+    assert rep["dominant_share"] == pytest.approx(
+        lanes[rep["dominant_lane"]] / sum(lanes.values()), rel=0.10)
+    from tpu_parquet.cli import pq_tool
+
+    out = io.StringIO()
+    args = pq_tool.build_parser().parse_args(
+        ["doctor", str(tmp_path / "trace.plain_int64.json")])
+    assert args.func(args, out=out) == 0
+    assert f"verdict: {rep['verdict']}" in out.getvalue()

@@ -65,6 +65,13 @@ class AllocTracker:
         with self._lock:
             self.total = 0
 
+    def snapshot(self) -> "tuple[int, int]":
+        """Consistent ``(in_use, peak)`` pair for the obs.Sampler's
+        watermark track (reading the attributes separately can pair a new
+        total with a stale peak mid-register)."""
+        with self._lock:
+            return self.total, self.peak
+
 
 class InFlightBudget:
     """Bounded in-flight bytes with *backpressure* instead of an exception.
@@ -130,3 +137,9 @@ class InFlightBudget:
         with self._cv:
             self.held -= n
             self._cv.notify_all()
+
+    def snapshot(self) -> "tuple[int, int]":
+        """Consistent ``(held, peak)`` for the obs.Sampler backpressure
+        track."""
+        with self._cv:
+            return self.held, self.peak

@@ -11,6 +11,13 @@ Command parity with the reference's parquet-tool (cmd/parquet-tool/cmds/):
     split     re-shard into parts of at most a given size (split.go:31-117)
     trace     summarize a TPQ_TRACE run (per-stage p50/p95, overlap
               efficiency, stall attribution, ship-route prediction error)
+    doctor    rule-based bottleneck attribution of a traced run (link- vs
+              host-decompress- vs stall- vs device-resolve-bound), with the
+              recalibrated TPQ_LINK_MBPS when the routes disagree with the
+              ship planner's cost model
+    bench     run-ledger tools: `bench diff A B` (per-metric deltas with
+              noise bounds from rep variance + stage attribution) and
+              `bench history LEDGER` (one line per recorded run)
 
 cat/head/rowcount take --filter "a > 5 and b == 'x'" for statistics-based
 row-group pruning (tpu_parquet.predicate).
@@ -175,6 +182,12 @@ def cmd_trace(args, out=sys.stdout) -> int:
     except json.JSONDecodeError as e:
         raise ValueError(f"{args.file}: not JSON ({e})") from None
     s = trace_summary(doc)
+    if not s["stages"]:
+        # zero spans: the run recorded nothing to summarize — one-line
+        # diagnosis, not a table of zeros (or a traceback downstream)
+        out.write(f"pq-tool trace: {args.file}: no spans recorded — was the "
+                  f"tracer enabled for the run (TPQ_TRACE / trace=)?\n")
+        return 1
     out.write(f"trace: {args.file}\n")
     out.write(f"events: {s['events']}  threads: {s['threads']}  "
               f"wall: {s['wall_seconds']:.3f}s\n")
@@ -211,13 +224,128 @@ def cmd_trace(args, out=sys.stdout) -> int:
                 + (f"{err:>7.2f}" if err is not None else f"{'-':>7}")
                 + "\n")
     reg = s.get("registry")
-    if reg:
-        pipe = reg.get("pipeline") or {}
-        out.write(
-            f"embedded registry: obs_version={reg.get('obs_version')} "
-            f"chunks={pipe.get('chunks')} "
-            f"busy={pipe.get('busy_seconds')}s "
-            f"stall={pipe.get('stall_seconds')}s\n")
+    if not reg:
+        # the span tables above still printed; the nonzero exit tells
+        # scripts the artifact is registry-less (an atexit-written process
+        # trace, or a hand-built one) so `doctor`/`bench diff` can't use it
+        out.write(f"pq-tool trace: {args.file}: no embedded registry — "
+                  f"write the trace via a reader-owned trace= path (or "
+                  f"Tracer.write(registry=...))\n")
+        return 1
+    pipe = reg.get("pipeline") or {}
+    out.write(
+        f"embedded registry: obs_version={reg.get('obs_version')} "
+        f"chunks={pipe.get('chunks')} "
+        f"busy={pipe.get('busy_seconds')}s "
+        f"stall={pipe.get('stall_seconds')}s\n")
+    return 0
+
+
+def _load_registry_tree(path, config=None):
+    """Resolve a doctor argument to one registry tree.
+
+    Accepts a trace-event document (uses the embedded registry), a bare
+    registry tree (``obs_version`` at top level), a bench artifact
+    (``configs``: picks ``--config`` or the first config embedding an
+    ``obs`` tree), or a ledger record.  Returns ``(tree, None)`` or
+    ``(None, diagnosis)``.
+    """
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"{path}: not JSON ({e})") from None
+    if not isinstance(doc, dict):
+        return None, "top level is not an object"
+    if "traceEvents" in doc:
+        tree = (doc.get("otherData") or {}).get("registry")
+        if not tree:
+            return None, ("trace has no embedded registry — write it via a "
+                          "reader-owned trace= path")
+        return tree, None
+    if "obs_version" in doc:
+        return doc, None
+    cfgs = doc.get("configs")
+    if isinstance(cfgs, dict):
+        names = ([config] if config else
+                 [n for n, c in cfgs.items()
+                  if isinstance(c, dict) and isinstance(c.get("obs"), dict)])
+        for n in names:
+            c = cfgs.get(n)
+            if isinstance(c, dict) and isinstance(c.get("obs"), dict):
+                return c["obs"], None
+        return None, (f"config {config!r} has no embedded obs registry"
+                      if config else "no config embeds an obs registry")
+    return None, "not a trace, registry tree, or bench artifact"
+
+
+def cmd_doctor(args, out=sys.stdout) -> int:
+    """Rule-based bottleneck attribution: which lane bounds this run (link /
+    host decompress / stall / device resolve), how sure, and — when the
+    measured routes disagree with the ship planner's cost model — the
+    recalibrated ``TPQ_LINK_MBPS`` to re-run with.  obs.doctor_registry
+    does the math; this renders the verdict."""
+    from ..obs import doctor_registry
+
+    tree, why = _load_registry_tree(args.file, getattr(args, "config", None))
+    if tree is None:
+        out.write(f"pq-tool doctor: {args.file}: {why}\n")
+        return 1
+    rep = doctor_registry(tree)
+    if rep is None:
+        out.write(f"pq-tool doctor: {args.file}: registry has no lane "
+                  f"seconds to attribute (nothing was decoded?)\n")
+        return 1
+    out.write(f"doctor: {args.file}\n")
+    lanes = rep["lanes"]
+    out.write("lanes: " + "  ".join(
+        f"{k}={lanes[k]:.3f}s"
+        for k in sorted(lanes, key=lambda k: -lanes[k])) + "\n")
+    out.write(f"verdict: {rep['verdict']} "
+              f"({100 * rep['dominant_share']:.0f}% of lane seconds)\n")
+    rm = rep.get("route_model")
+    if rm:
+        err = rm.get("error_ratio")
+        if err is None:
+            out.write("route model: chosen routes never measured "
+                      "(measured_s null — no staging seconds recorded)\n")
+        else:
+            side = ("optimistic" if err > 1 else "pessimistic")
+            out.write(
+                f"route model: predicted {rm['predicted_seconds']:.4f}s, "
+                f"measured {rm['measured_seconds']:.4f}s "
+                f"(error {err:.2f}x {side}; planner assumed "
+                f"{rm['planner_link_mbps'] or '?'} MB/s, measured "
+                f"{rm['measured_link_mbps'] or '?'} MB/s)\n")
+    recal = rep.get("recalibrate_link_mbps")
+    if recal is not None:
+        out.write(f"recalibrate: re-run with TPQ_LINK_MBPS={recal:g} "
+                  f"(the measured staging rate) to align the planner\n")
+    return 0
+
+
+def cmd_bench_diff(args, out=sys.stdout) -> int:
+    """Noise-aware comparison of two recorded runs (ledger entries or bench
+    artifacts); exits 1 when a metric regressed beyond its noise bound."""
+    from .. import ledger
+
+    a = ledger.load_side(args.a)
+    b = ledger.load_side(args.b)
+    d = ledger.diff(a, b, floor=args.floor)
+    out.write(ledger.format_diff(d, args.a, args.b))
+    return 1 if d["regressions"] else 0
+
+
+def cmd_bench_history(args, out=sys.stdout) -> int:
+    from .. import ledger
+
+    records = ledger.read(args.ledger)
+    start = 0
+    if args.n and len(records) > args.n:
+        out.write(f"(showing last {args.n} of {len(records)} runs)\n")
+        start = len(records) - args.n
+        records = records[start:]
+    out.write(ledger.format_history(records, args.ledger, start=start))
     return 0
 
 
@@ -322,6 +450,35 @@ def build_parser() -> argparse.ArgumentParser:
         "trace", help="summarize a TPQ_TRACE run (Chrome trace-event JSON)")
     tr.add_argument("file")
     tr.set_defaults(func=cmd_trace)
+
+    dr = sub.add_parser(
+        "doctor",
+        help="bottleneck attribution of a traced run (trace / registry / "
+             "bench artifact) + TPQ_LINK_MBPS recalibration")
+    dr.add_argument("file")
+    dr.add_argument("--config", default=None,
+                    help="bench-artifact input: which config's registry to "
+                         "diagnose (default: first with an obs tree)")
+    dr.set_defaults(func=cmd_doctor)
+
+    be = sub.add_parser(
+        "bench", help="run-ledger tools: compare and list recorded runs")
+    bsub = be.add_subparsers(dest="bench_command", required=True)
+    bd = bsub.add_parser(
+        "diff",
+        help="per-metric deltas A -> B with noise bounds from rep variance; "
+             "exit 1 on a regression beyond noise")
+    bd.add_argument("a", help="bench artifact .json, ledger .jsonl (last "
+                              "run), or ledger.jsonl#N")
+    bd.add_argument("b", help="same forms as A")
+    bd.add_argument("--floor", type=float, default=0.10,
+                    help="minimum relative band when reps carry no noise "
+                         "information (default 0.10)")
+    bd.set_defaults(func=cmd_bench_diff)
+    bh = bsub.add_parser("history", help="one line per recorded run")
+    bh.add_argument("ledger", help="ledger.jsonl path")
+    bh.add_argument("-n", type=int, default=20, help="show the last N runs")
+    bh.set_defaults(func=cmd_bench_history)
 
     sp = sub.add_parser("split", help="split into files of at most SIZE bytes")
     sp.add_argument("--size", required=True, help="max part size, e.g. 100MB")

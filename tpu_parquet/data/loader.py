@@ -150,8 +150,9 @@ class DataLoader:
         max_memory: int = 0,
         validate_crc: bool = False,
         trace=None,
+        sample_ms=None,
     ):
-        from ..obs import resolve_tracer
+        from ..obs import resolve_sample_ms, resolve_tracer
 
         # span tracer (obs.py): batch/decode-wait spans + window-occupancy
         # counters; None = the TPQ_TRACE process tracer (no-op without the
@@ -159,6 +160,9 @@ class DataLoader:
         # embedded) every time an epoch iterator finishes or is abandoned —
         # the loader has no close(), so iteration end is its close
         self._tracer, self._owns_tracer = resolve_tracer(trace)
+        # counter-sampling cadence (obs.Sampler): each __iter__ runs one
+        # sampler for the epoch — throughput/queue-depth curves on the trace
+        self._sample_ms = resolve_sample_ms(sample_ms)
         if isinstance(files, (str, os.PathLike)):
             files = [files]
         self._paths = [os.fspath(p) for p in files]
@@ -599,9 +603,20 @@ class DataLoader:
     def __iter__(self):
         """Iterate the CURRENT epoch from the current cursor, then advance
         the epoch.  ``state()`` between batches is a valid resume point."""
+        from ..obs import Sampler
+
         epoch = self._epoch
         stats = self._stats
         tr = self._tracer
+        sampler = Sampler(tr, self._sample_ms,
+                          track_id=self._pstats._obs_id)
+        if sampler.enabled:
+            sampler.add_source("loader_progress", lambda: {
+                "rows": stats.rows, "batches": stats.batches,
+                "decode_wait_seconds": round(stats.decode_wait_seconds, 6),
+            })
+            sampler.add_source("pipeline_lanes", self._pstats.sample)
+            sampler.start()
         gen = self._batches(epoch, self._rows_taken)
         try:
             while True:
@@ -625,6 +640,7 @@ class DataLoader:
                 yield batch
                 stats.touch_wall()
         finally:
+            sampler.stop()  # thread-leak-safe even on early abandon
             gen.close()
             if self._owns_tracer:
                 # per-loader trace artifact: rewrite (cumulatively) at every

@@ -2912,6 +2912,10 @@ class ReaderStats:
     # route — obs.StatsRegistry.ship_feedback compares them to the measured
     # link lane (staged bytes / stage seconds) for TPQ_LINK_MBPS calibration
     route_pred_seconds: dict = field(default_factory=dict)
+    # the link rate the planner ASSUMED (TPQ_LINK_MBPS or the default
+    # planning point) — pq_tool doctor prints it next to the measured rate
+    # so a recalibration names both sides
+    planner_link_mbps: float = 0.0
 
     def count_route(self, route: str, logical: int, shipped: int,
                     predicted: float = 0.0) -> None:
@@ -2959,10 +2963,14 @@ class ReaderStats:
                 r: {"streams": self.route_streams[r],
                     "logical": self.route_bytes_logical.get(r, 0),
                     "shipped": self.route_bytes_shipped.get(r, 0),
+                    # 9 decimals: a tiny stream's sub-µs prediction must
+                    # not round to a 0.0 that ship_feedback would read as
+                    # "no prediction" (nulling the error ratio)
                     "predicted_s": round(
-                        self.route_pred_seconds.get(r, 0.0), 6)}
+                        self.route_pred_seconds.get(r, 0.0), 9)}
                 for r in sorted(self.route_streams)
             },
+            "planner_link_mbps": round(self.planner_link_mbps, 1),
             "host_seconds": round(self.host_seconds, 6),
             "device_seconds": round(self.device_seconds, 6),
             "wall_seconds": round(self.wall_seconds, 6),
@@ -3005,8 +3013,9 @@ class DeviceFileReader:
 
     def __init__(self, source, columns=None, validate_crc: bool = False,
                  profile_dir: "str | None" = None, max_memory: int = 0,
-                 row_filter=None, prefetch: int = 0, trace=None):
-        from .obs import resolve_tracer
+                 row_filter=None, prefetch: int = 0, trace=None,
+                 sample_ms=None):
+        from .obs import Sampler, resolve_sample_ms, resolve_tracer
         from .pipeline import PipelineStats
         from .reader import FileReader
 
@@ -3044,8 +3053,33 @@ class DeviceFileReader:
         # link-byte ship planner (ship.py): per-reader so env overrides
         # (TPQ_FORCE_ROUTE, TPQ_LINK_MBPS) bind at open time
         self._ship_planner = ShipPlanner()
+        self._stats.planner_link_mbps = self._ship_planner.link_mbps
+        # live counter sampler (obs.Sampler, TPQ_SAMPLE_MS / sample_ms=):
+        # throughput + backpressure curves on the trace; inert (no thread)
+        # unless the tracer is enabled AND an interval is set
+        # track_id ties each reader's curves to its pipeline's `pipe=` wall
+        # counter — scan_files opens several readers on ONE shared tracer,
+        # and same-named id-less tracks would interleave into one sawtooth
+        self._sampler = Sampler(self._tracer, resolve_sample_ms(sample_ms),
+                                track_id=self._pipe_stats._obs_id)
+        if self._sampler.enabled:
+            self._sampler.add_source("reader_progress", self._sample_progress)
+            self._sampler.add_source("pipeline_lanes", self._pipe_stats.sample)
+            self._sampler.add_source("alloc_bytes", self._sample_alloc)
+            self._sampler.start()
+
+    def _sample_progress(self) -> dict:
+        st = self._stats
+        return {"rows": st.rows, "chunks": st.chunks,
+                "staged_bytes": st.staged_bytes,
+                "compressed_bytes": st.compressed_bytes}
+
+    def _sample_alloc(self) -> dict:
+        in_use, peak = self.alloc.snapshot()
+        return {"in_use": in_use, "peak": peak}
 
     def close(self):
+        self._sampler.stop()  # before the write: the final tick must land
         self._host.close()
         if self._owns_tracer:
             self._tracer.write(registry=self.obs_registry())
@@ -3337,7 +3371,7 @@ class DeviceFileReader:
                     # these into the per-route predicted-vs-measured table
                     tr.instant("ship", route=route, column=name,
                                logical=logical, shipped=shipped,
-                               predicted_s=round(predicted, 6))
+                               predicted_s=round(predicted, 9))
         # every selected leaf must have a chunk in the row group (host
         # FileReader parity — reader.py read_row_group's missing check)
         seen = set(out) | {name for name, _ in plans}
@@ -3633,19 +3667,35 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0):
     srs: dict[int, SharedReader] = {}
     pending: dict[tuple, dict] = {}
     current = {"stats": None}  # stats of the reader whose item is submitting
+    depth_owner = {"stats": None}  # last stats whose queue_depth gauge we set
 
     class _StatsFwd:
         """Route prefetch_map's stall/peak accounting to the owning reader.
 
         Submission happens in the consumer thread right after gen_items
         yields an item, so ``current`` always names the reader whose chunk
-        is paying the budget wait."""
+        is paying the budget wait.  The queue-depth gauge is point-in-time
+        state, not a flow: when the window's ownership moves to the next
+        reader, the previous owner's gauge must drop to 0 — otherwise its
+        sampler (and the final stop() tick at close) records a phantom
+        backlog frozen at whatever depth it last saw, and prefetch_map's
+        end-of-run reset only ever reaches the LAST reader."""
 
         @staticmethod
         def add_stall(seconds, t0=None):
             st = current["stats"]
             if st is not None:
                 st.add_stall(seconds, t0)
+
+        @staticmethod
+        def set_queue_depth(n):
+            st = current["stats"]
+            prev = depth_owner["stats"]
+            if prev is not None and prev is not st:
+                prev.set_queue_depth(0)
+            depth_owner["stats"] = st
+            if st is not None:
+                st.set_queue_depth(n)
 
         @staticmethod
         def note_peak(b):
@@ -3795,7 +3845,7 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
 
 def scan_files(paths, columns=None, validate_crc: bool = False,
                max_memory: int = 0, row_filter=None, with_path: bool = False,
-               prefetch: int = 0, trace=None):
+               prefetch: int = 0, trace=None, sample_ms=None):
     """Scan several files' row groups through ONE continuous transfer pipeline.
 
     ``prefetch=K`` additionally runs chunk IO + decompression K-deep on a
@@ -3848,6 +3898,7 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
             r = DeviceFileReader(
                 path, columns=columns, validate_crc=validate_crc,
                 max_memory=max_memory, row_filter=row_filter, trace=tracer,
+                sample_ms=sample_ms,
             )
             readers.append(r)
             for i in range(r.num_row_groups):

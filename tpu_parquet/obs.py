@@ -48,8 +48,9 @@ import time
 from typing import Optional
 
 __all__ = [
-    "OBS_VERSION", "LatencyHistogram", "StatsRegistry", "Tracer",
-    "current_tracer", "resolve_tracer", "trace_summary",
+    "OBS_VERSION", "LatencyHistogram", "Sampler", "StatsRegistry", "Tracer",
+    "current_tracer", "doctor_registry", "resolve_sample_ms",
+    "resolve_tracer", "trace_summary",
 ]
 
 # version of every schema this module emits (the registry tree, the trace
@@ -268,7 +269,12 @@ class Tracer:
         with self._lock:
             self._events.append(ev)
 
-    def counter(self, name: str, **values) -> None:
+    def counter(self, name: str, track_id=None, **values) -> None:
+        """One counter sample.  ``track_id`` sets the trace event's ``id``
+        field: Chrome counter tracks are keyed ``(pid, name[, id])``, so
+        same-named counters from different emitters (two readers of one
+        ``scan_files`` sampling onto the shared tracer) render as separate
+        ``name[id]`` tracks instead of interleaving into one sawtooth."""
         if not self.enabled:
             return
         ev = {
@@ -277,6 +283,8 @@ class Tracer:
             "pid": self._pid, "tid": self._tid(),
             "args": values,
         }
+        if track_id is not None:
+            ev["id"] = str(track_id)
         with self._lock:
             self._events.append(ev)
 
@@ -307,10 +315,16 @@ class Tracer:
 
     def write(self, path: "str | None" = None,
               registry: "StatsRegistry | None" = None) -> "str | None":
-        """Serialize to ``path`` (default: the construction path)."""
+        """Serialize to ``path`` (default: the construction path).  Missing
+        parent directories are created here, not discovered at close time:
+        ``TPQ_TRACE=runs/today/t.json`` into a fresh tree must not fail with
+        a late FileNotFoundError after the run already happened."""
         path = path or self.path
         if path is None:
             return None
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         with open(path, "w") as f:
             json.dump(self.export(registry), f)
             f.write("\n")
@@ -369,12 +383,129 @@ def resolve_tracer(trace) -> "tuple[Tracer, bool]":
 
 
 # ---------------------------------------------------------------------------
+# counter sampler
+# ---------------------------------------------------------------------------
+
+def resolve_sample_ms(sample_ms=None) -> float:
+    """Resolve a ``sample_ms=`` kwarg against ``TPQ_SAMPLE_MS`` (kwarg wins;
+    0 or unset disables sampling)."""
+    if sample_ms is not None:
+        try:
+            return max(float(sample_ms), 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+    env = os.environ.get("TPQ_SAMPLE_MS", "")
+    try:
+        return max(float(env), 0.0) if env else 0.0
+    except ValueError:
+        return 0.0
+
+
+class Sampler:
+    """Daemon thread snapshotting counter sources into a tracer every N ms.
+
+    The tracer's spans say how long each unit of work took; this says what
+    the whole machine looked like OVER TIME — Chrome counter tracks of the
+    cumulative stage seconds (their slope is live per-lane throughput), the
+    prefetch queue depth, and the alloc watermarks, so Perfetto shows
+    throughput/backpressure *curves* instead of end totals and a stall is
+    visible as the flat stretch where every curve stops climbing.
+
+    Sources are zero-arg callables returning ``{counter: number}``; each
+    tick emits one ``tracer.counter(track, **values)`` per source.  A
+    source that raises is skipped for that tick (``dropped`` counts them) —
+    sampling must never take the run down.  Inert (``start`` is a no-op)
+    when the tracer is disabled or the interval is 0, so callers wire it
+    unconditionally.  Shutdown is thread-leak-safe: ``stop()`` joins the
+    thread (which emits one final sample so the track's last point is the
+    end state), and the thread is a daemon so an abandoned sampler can
+    never hold the interpreter open.
+    """
+
+    def __init__(self, tracer: "Tracer | None", interval_ms: float,
+                 name: str = "tpq-sampler", track_id=None):
+        self.tracer = tracer
+        self.interval_s = max(float(interval_ms or 0.0), 0.0) / 1e3
+        self.name = name
+        # forwarded as the counter events' Chrome track id so concurrent
+        # samplers (scan_files opens several readers) keep separate tracks
+        self.track_id = track_id
+        self._sources: list = []  # [(track, fn)]
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: "threading.Thread | None" = None
+        self.ticks = 0
+        self.dropped = 0
+
+    @property
+    def enabled(self) -> bool:
+        return (self.tracer is not None and self.tracer.enabled
+                and self.interval_s > 0)
+
+    def add_source(self, track: str, fn) -> "Sampler":
+        with self._lock:
+            self._sources.append((track, fn))
+        return self
+
+    def start(self) -> "Sampler":
+        if not self.enabled or self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name=self.name,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Idempotent; joins the sampling thread (no leak, tier-1 guarded)."""
+        t = self._thread
+        if t is None:
+            return
+        self._stop.set()
+        t.join(timeout=10.0)
+        self._thread = None
+
+    def __enter__(self) -> "Sampler":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _run(self) -> None:
+        while True:
+            self.sample_once()
+            if self._stop.wait(self.interval_s):
+                self.sample_once()  # final point: the track ends at the end state
+                return
+
+    def sample_once(self) -> None:
+        with self._lock:
+            sources = list(self._sources)
+        for track, fn in sources:
+            try:
+                values = fn()
+            except Exception:  # noqa: BLE001 — sampling never kills the run
+                self.dropped += 1
+                continue
+            if not values:
+                continue
+            nums = {k: v for k, v in values.items()
+                    if isinstance(v, (int, float)) and not isinstance(v, bool)}
+            nums.pop("track_id", None)  # reserved for the keyword below
+            if nums:
+                self.tracer.counter(track, track_id=self.track_id, **nums)
+        self.ticks += 1
+
+
+# ---------------------------------------------------------------------------
 # unified registry
 # ---------------------------------------------------------------------------
 
 # keys that are peaks/config, not flows: composition takes the max
 _MERGE_MAXED = frozenset((
     "peak_in_flight_bytes", "window_peak_rows", "prefetch", "budget_bytes",
+    "planner_link_mbps",
 ))
 # ratios/rates derived from the flows: summing them is meaningless (four
 # files' overlap_efficiency is not their sum) — the merge drops them and
@@ -550,6 +681,12 @@ class StatsRegistry:
         lane).  ``error_ratio`` = measured/predicted: >1 means the model
         was optimistic (raise ``TPQ_LINK_MBPS``'s denominator — i.e. the
         link was slower than planned), <1 pessimistic.
+
+        The ``measured_seconds``/``error_ratio`` keys are always present:
+        a route chosen by the planner but never timed (a forced route with
+        tracing off, a run whose staging span recorded no seconds) reports
+        ``null`` — explicitly unmeasured, never a divide-by-zero or a bogus
+        0.0 ratio a diff would read as "infinitely fast".
         """
         with self._lock:
             reader = dict(self._reader or {})
@@ -560,18 +697,21 @@ class StatsRegistry:
         link_bps = staged / stage_s if staged and stage_s else 0.0
         out = {}
         for route, c in sorted(routes.items()):
-            entry = {
+            # null-check and ratio on the RAW values, display rounding last:
+            # a 100-byte stream on a fast link measures ~1e-7s, which
+            # round(..., 6) flattens to exactly the bogus-0.0 this contract
+            # exists to rule out
+            pred = float(c.get("predicted_s", 0.0))
+            meas = c.get("shipped", 0) / link_bps if link_bps else None
+            out[route] = {
                 "streams": c.get("streams", 0),
                 "shipped_bytes": c.get("shipped", 0),
-                "predicted_seconds": round(c.get("predicted_s", 0.0), 6),
+                "predicted_seconds": round(pred, 9),
+                "measured_seconds": (round(meas, 9) if meas is not None
+                                     else None),
+                "error_ratio": (round(meas / pred, 3)
+                                if meas is not None and pred else None),
             }
-            if link_bps:
-                measured = c.get("shipped", 0) / link_bps
-                entry["measured_seconds"] = round(measured, 6)
-                if entry["predicted_seconds"]:
-                    entry["error_ratio"] = round(
-                        measured / entry["predicted_seconds"], 3)
-            out[route] = entry
         return {"link_bytes_per_sec": round(link_bps, 1), "routes": out}
 
     def as_dict(self) -> dict:
@@ -692,12 +832,15 @@ def trace_summary(doc) -> dict:
         r["shipped_bytes"] += int(s.get("shipped", 0))
         r["predicted_seconds"] += float(s.get("predicted_s", 0.0))
     for r in routes.values():
-        r["predicted_seconds"] = round(r["predicted_seconds"], 6)
-        if link_bps:
-            r["measured_seconds"] = round(r["shipped_bytes"] / link_bps, 6)
-            if r["predicted_seconds"]:
-                r["error_ratio"] = round(
-                    r["measured_seconds"] / r["predicted_seconds"], 3)
+        # keys always present; null = unmeasured (same contract as
+        # StatsRegistry.ship_feedback — never a fake 0.0 ratio, so the
+        # ratio and the null check use the RAW values, rounding last)
+        pred = r["predicted_seconds"]
+        meas = r["shipped_bytes"] / link_bps if link_bps else None
+        r["predicted_seconds"] = round(pred, 9)
+        r["measured_seconds"] = round(meas, 9) if meas is not None else None
+        r["error_ratio"] = (round(meas / pred, 3)
+                            if meas is not None and pred else None)
     return {
         "obs_version": other.get("obs_version"),
         "events": len(events),
@@ -712,3 +855,107 @@ def trace_summary(doc) -> dict:
         "routes": dict(sorted(routes.items())),
         "registry": other.get("registry"),
     }
+
+
+# ---------------------------------------------------------------------------
+# doctor: rule-based bottleneck attribution (the pq_tool doctor backend)
+# ---------------------------------------------------------------------------
+
+# the four verdicts `pq_tool doctor` can return, keyed by lane
+DOCTOR_VERDICTS = {
+    "link": "link-bound",
+    "host_decompress": "host-decompress-bound",
+    "stall": "stall-bound",
+    "device_resolve": "device-resolve-bound",
+}
+# routes whose overall error_ratio leaves this band disagree with the cost
+# model enough that re-running with the recalibrated TPQ_LINK_MBPS is the
+# next step (inside it, re-banking changes no route choice worth chasing)
+DOCTOR_ERROR_BAND = (0.8, 1.25)
+
+
+def doctor_registry(tree: dict) -> "dict | None":
+    """Attribute a run's bottleneck from its registry tree (rule-based).
+
+    The overlapped pipeline runs four lanes concurrently; steady-state wall
+    time is the *largest* lane, so the verdict is simply the lane with the
+    most recorded seconds:
+
+    - ``link``            ``stage_seconds`` (host->device staging — the
+      transfers themselves)
+    - ``host_decompress``  ``io + decompress + recompress`` seconds (the
+      host's half of the work; falls back to the reader's ``host_seconds``
+      for prefetch=0 runs that never routed through the chunk pool)
+    - ``device_resolve``  ``dispatch + finalize`` seconds (op-table
+      resolves and deferred validity syncs)
+    - ``stall``           budget backpressure (the submitter blocked on
+      ``max_memory`` — more memory or less lookahead, not more bandwidth)
+
+    Folds in ``ship_feedback()``: when the routes' measured link-lane
+    seconds disagree with the planner's predictions beyond
+    ``DOCTOR_ERROR_BAND``, the report carries ``recalibrate_link_mbps`` —
+    the measured staging rate as the ``TPQ_LINK_MBPS`` value to re-run
+    with (exactly the 1B re-measure procedure in ROADMAP item 1).
+
+    Returns ``None`` when the tree has no lane seconds to attribute.
+    """
+    if not isinstance(tree, dict):
+        return None
+    pipe = tree.get("pipeline") or {}
+    reader = tree.get("reader") or {}
+    if not isinstance(pipe, dict) or not isinstance(reader, dict):
+        return None
+
+    def g(d, k):
+        v = d.get(k)
+        return float(v) if isinstance(v, (int, float)) else 0.0
+
+    host = (g(pipe, "io_seconds") + g(pipe, "decompress_seconds")
+            + g(pipe, "recompress_seconds"))
+    if host == 0.0:
+        host = g(reader, "host_seconds")
+    lanes = {
+        "link": g(pipe, "stage_seconds"),
+        "host_decompress": host,
+        "device_resolve": (g(pipe, "dispatch_seconds")
+                           + g(pipe, "finalize_seconds")),
+        "stall": g(pipe, "stall_seconds"),
+    }
+    total = sum(lanes.values())
+    if total <= 0:
+        return None
+    dominant = max(lanes, key=lambda k: (lanes[k], k))
+    out = {
+        "lanes": {k: round(v, 6) for k, v in lanes.items()},
+        "dominant_lane": dominant,
+        "verdict": DOCTOR_VERDICTS[dominant],
+        "dominant_share": round(lanes[dominant] / total, 4),
+    }
+    fb = reader.get("ship_feedback")
+    routes = (fb or {}).get("routes") or {}
+    if routes:
+        pred = sum(float(r.get("predicted_seconds") or 0.0)
+                   for r in routes.values())
+        timed = [float(r["measured_seconds"]) for r in routes.values()
+                 if r.get("measured_seconds") is not None]
+        # same null-vs-0.0 contract as ship_feedback: "no route was ever
+        # timed" is None, a tiny-but-real sum stays a number (9 decimals,
+        # is-not-None gating — truthiness would flatten ~1e-7s to "unmeasured")
+        meas = sum(timed) if timed else None
+        link_bps = float(fb.get("link_bytes_per_sec") or 0.0)
+        err = (round(meas / pred, 3)
+               if meas is not None and pred else None)
+        out["route_model"] = {
+            "predicted_seconds": round(pred, 9),
+            "measured_seconds": round(meas, 9) if meas is not None else None,
+            "error_ratio": err,
+            "measured_link_mbps": (round(link_bps / 1e6, 1)
+                                   if link_bps else None),
+            "planner_link_mbps": reader.get("planner_link_mbps") or None,
+        }
+        lo, hi = DOCTOR_ERROR_BAND
+        if err is not None and link_bps and not (lo <= err <= hi):
+            from .ship import recalibrate_link_mbps
+
+            out["recalibrate_link_mbps"] = recalibrate_link_mbps(link_bps)
+    return out

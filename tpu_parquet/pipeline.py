@@ -99,6 +99,10 @@ class PipelineStats:
         self.stall_seconds = 0.0
         self.wall_seconds = 0.0
         self.peak_in_flight_bytes = 0
+        # live prefetch backlog (items submitted, not yet consumed) — a
+        # point-in-time gauge for obs.Sampler, deliberately NOT in as_dict()
+        # (its end-of-run value is always 0 and would only add key noise)
+        self.queue_depth = 0
         self._stage_seconds = {s: 0.0 for s in STAGES}
         self._stage_hist = {s: LatencyHistogram() for s in STAGES}
         self.tracer = tracer if tracer is not None else current_tracer()
@@ -160,6 +164,22 @@ class PipelineStats:
             # clock deliberately excludes)
             tr.counter("pipeline_wall", seconds=round(wall, 6),
                        pipe=self._obs_id)
+
+    def set_queue_depth(self, n: int) -> None:
+        with self._lock:
+            self.queue_depth = int(n)
+
+    def sample(self) -> dict:
+        """Point-in-time counter snapshot for :class:`~tpu_parquet.obs.Sampler`:
+        the cumulative per-stage seconds (as counter tracks their slope IS
+        live per-lane throughput), the stall total, and the live prefetch
+        queue depth (backpressure visible as a curve, not an end total)."""
+        with self._lock:
+            out = {s: round(v, 6) for s, v in self._stage_seconds.items()}
+            out["stall"] = round(self.stall_seconds, 6)
+            out["chunks"] = self.chunks
+            out["queue_depth"] = self.queue_depth
+        return out
 
     def note_peak(self, budget: InFlightBudget) -> None:
         with self._lock:
@@ -365,11 +385,15 @@ def prefetch_map(
                         stats.note_peak(budget)
                 carried = None
                 pending.append((ex.submit(fn, item), c))
+                if stats is not None:
+                    stats.set_queue_depth(len(pending))
             if not pending:
                 if carried is None:
                     break
                 continue  # budget-carried item with empty window: block-acquire
             fut, c = pending.popleft()
+            if stats is not None:
+                stats.set_queue_depth(len(pending))
             try:
                 res = fut.result()
             finally:
@@ -377,6 +401,8 @@ def prefetch_map(
                     budget.release(c)
             yield res
     finally:
+        if stats is not None:
+            stats.set_queue_depth(0)
         for fut, _c in pending:
             fut.cancel()
         ex.shutdown(wait=True)

@@ -218,6 +218,17 @@ class ShipPlanner:
         return {r: round(t * 1e3, 3) for r, t in self.costs(f).items()}
 
 
+def recalibrate_link_mbps(link_bytes_per_sec: float) -> "float | None":
+    """The ``TPQ_LINK_MBPS`` value a measured staging rate says to re-run
+    with (``pq_tool doctor``'s recalibration output): the observed link
+    lane in MB/s, floored at the planner's own 1 MB/s clamp.  ``None``
+    when nothing was measured — an unmeasured link must never overwrite a
+    banked calibration with a guess."""
+    if not link_bytes_per_sec or link_bytes_per_sec <= 0:
+        return None
+    return max(round(link_bytes_per_sec / 1e6, 1), 1.0)
+
+
 _default: "ShipPlanner | None" = None
 _default_lock = threading.Lock()
 
