@@ -1003,6 +1003,12 @@ def main(argv=None):
         for knob in ("BENCH_PIPELINE", "BENCH_LOADER", "BENCH_WRITES",
                      "BENCH_PALLAS"):
             os.environ.setdefault(knob, "0")
+        # the smoke/tier-1 gate path runs with the hang watchdog ARMED (a
+        # generous deadline: it must never fire on a slow box, only on a
+        # true wedge) so recorder+watchdog wiring is exercised on every
+        # gate run; the zero-daemon-thread assert at the end of main()
+        # proves every reader stopped it
+        os.environ.setdefault("TPQ_HANG_S", "300")
 
     # Claim TPQ_TRACE for the per-config artifacts and UNSET it: left in the
     # env it would enable the process-global tracer inside every TIMED rep —
@@ -1291,6 +1297,17 @@ def main(argv=None):
     # but the exit happens AFTER: the driver always gets its JSON line
     rc = _ledger_and_check(record, args, artifact_path)
     emit_results(record, artifact_path)
+    # obs daemon hygiene: every sampler/watchdog any reader started must be
+    # stopped by now (readers close in their benches) — a leak here is a
+    # thread-lifecycle regression the smoke gate must catch.  After emit:
+    # the driver always gets its JSON line first.
+    import threading
+
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith(("tpq-sampler", "tpq-watchdog"))]
+    if leaked:
+        log(f"FAIL: obs daemon threads leaked after completion: {leaked}")
+        sys.exit(3)
     if rc:
         sys.exit(rc)
 

@@ -95,7 +95,7 @@ def _assert_nesting(events):
 # ---------------------------------------------------------------------------
 
 def test_disabled_tracer_is_noop():
-    tr = Tracer(enabled=False)
+    tr = Tracer(enabled=False, ring=None)  # ring=None: no flight tee either
     s1 = tr.span("a", x=1)
     s2 = tr.span("b")
     assert s1 is s2  # the shared no-op singleton: zero allocation per span
@@ -105,6 +105,12 @@ def test_disabled_tracer_is_noop():
     tr.counter("c", v=3)
     tr.complete("x", 0.0, 1.0)
     assert tr.events() == []
+    # the DEFAULT disabled tracer still tees into the flight recorder (the
+    # always-on black box) without recording any trace events
+    tr2 = Tracer(enabled=False)
+    assert tr2.active and not tr2.enabled
+    tr2.complete("x", 0.0, 1.0)
+    assert tr2.events() == []
 
 
 def test_span_nesting_and_export_fields():
@@ -539,7 +545,9 @@ def test_disabled_tracer_overhead_under_3_percent():
     # tracer cost; a microbenchmark pins the collector like it pins the CPU
     gc.collect()
     gc.disable()
-    tr = Tracer(enabled=False)
+    # ring=None: this guards the PURE no-op path (spans compiled away);
+    # the always-on ring tee has its own <3% guard in tests/test_autopsy.py
+    tr = Tracer(enabled=False, ring=None)
     ps = PipelineStats(tracer=tr)
     rng = np.random.default_rng(0)
     data = rng.integers(0, 1 << 40, 300_000)
@@ -895,6 +903,9 @@ def test_device_reader_sampler_tracks(tmp_path):
     lanes = tracks["pipeline_lanes"][-1]
     assert {"io", "decompress", "stage", "stall", "queue_depth"} <= set(lanes)
     assert lanes["queue_depth"] == 0  # drained at end
+    # the source must follow the LIVE PipelineStats (iter_row_groups
+    # replaces it per scan): a constructor-time binding samples flat zeros
+    assert lanes["chunks"] > 0, "sampler froze on the pre-scan PipelineStats"
     assert {"in_use", "peak"} <= set(tracks["alloc_bytes"][-1])
 
 

@@ -10,6 +10,34 @@ single read operation and reset per row group.
 from __future__ import annotations
 
 import threading
+import time
+import weakref
+
+# live-instance registries for the flight recorder (obs.FlightRecorder):
+# a hang dump must show every budget's waiters and every tracker's
+# watermark WITHOUT the dumping thread knowing who created them.  WeakSets:
+# registration never extends a lifetime (per-chunk AllocTrackers are
+# created by the thousand and must stay collectable).
+_LIVE_BUDGETS: "weakref.WeakSet[InFlightBudget]" = weakref.WeakSet()
+_LIVE_TRACKERS: "weakref.WeakSet[AllocTracker]" = weakref.WeakSet()
+
+
+def budget_snapshots() -> "list[dict]":
+    """Consistent snapshots of every live :class:`InFlightBudget` (the
+    flight recorder's backpressure section; see obs.FlightRecorder)."""
+    return [b.snapshot() for b in list(_LIVE_BUDGETS)]
+
+
+def tracker_snapshots() -> "list[dict]":
+    """``{in_use, peak, max_size}`` of every live :class:`AllocTracker`
+    with a nonzero watermark (idle per-chunk trackers carry no signal)."""
+    out = []
+    for t in list(_LIVE_TRACKERS):
+        in_use, peak = t.snapshot()
+        if in_use or peak:
+            out.append({"in_use": in_use, "peak": peak,
+                        "max_size": t.max_size})
+    return out
 
 
 class MemoryBudgetExceeded(MemoryError):
@@ -32,6 +60,7 @@ class AllocTracker:
         self.total = 0
         self.peak = 0  # high-water mark (obs.StatsRegistry reports it)
         self._lock = threading.Lock()
+        _LIVE_TRACKERS.add(self)
 
     def register(self, nbytes: int) -> None:
         # the high-water mark is tracked even without a cap — the default
@@ -97,6 +126,12 @@ class InFlightBudget:
         self.held = 0
         self.peak = 0
         self._cv = threading.Condition()
+        # hang observability (obs.FlightRecorder / obs.Watchdog): who is
+        # blocked in acquire() right now, and since when — the single most
+        # diagnostic fact about a wedged pipeline
+        self._waiting: dict[int, float] = {}  # thread ident -> wait start
+        self._abort: "BaseException | None" = None
+        _LIVE_BUDGETS.add(self)
 
     def _charge(self, nbytes: int) -> int:
         n = int(nbytes)
@@ -120,15 +155,42 @@ class InFlightBudget:
             return True
 
     def acquire(self, nbytes: int) -> None:
-        """Block until ``nbytes`` fit under the cap, then take them."""
+        """Block until ``nbytes`` fit under the cap, then take them.
+
+        While blocked, the waiter is visible in :meth:`snapshot` (waiter
+        count + longest wait age).  An :meth:`abort` delivered by the
+        watchdog wakes every waiter and raises the abort exception here —
+        the graceful-degradation exit from a wedge that would otherwise
+        block forever.
+        """
         if self.max_bytes <= 0:
             return
         n = self._charge(nbytes)
+        tid = threading.get_ident()
         with self._cv:
-            while not self._fits(n):
-                self._cv.wait()
+            started = None
+            try:
+                while not self._fits(n):
+                    if self._abort is not None:
+                        raise self._abort
+                    if started is None:
+                        started = time.monotonic()
+                        self._waiting[tid] = started
+                    self._cv.wait()
+            finally:
+                if started is not None:
+                    self._waiting.pop(tid, None)
             self.held += n
             self.peak = max(self.peak, self.held)
+
+    def abort(self, exc: BaseException) -> None:
+        """Poison the budget: every current and future blocking
+        :meth:`acquire` raises ``exc``.  Called by the watchdog's
+        raise-policy hook so a submitter wedged on backpressure surfaces
+        :class:`~tpu_parquet.errors.HangError` instead of hanging."""
+        with self._cv:
+            self._abort = exc
+            self._cv.notify_all()
 
     def release(self, nbytes: int) -> None:
         if self.max_bytes <= 0:
@@ -138,8 +200,18 @@ class InFlightBudget:
             self.held -= n
             self._cv.notify_all()
 
-    def snapshot(self) -> "tuple[int, int]":
-        """Consistent ``(held, peak)`` for the obs.Sampler backpressure
-        track."""
+    def snapshot(self) -> dict:
+        """Consistent backpressure snapshot for the obs.Sampler track and
+        the flight recorder: held/peak bytes plus ``waiters`` (threads
+        blocked in :meth:`acquire` now) and ``longest_wait_s`` (the oldest
+        waiter's age — a growing value with a frozen ``held`` IS a wedge)."""
         with self._cv:
-            return self.held, self.peak
+            now = time.monotonic()
+            waits = [now - t0 for t0 in self._waiting.values()]
+            return {
+                "held": self.held,
+                "peak": self.peak,
+                "max_bytes": self.max_bytes,
+                "waiters": len(waits),
+                "longest_wait_s": round(max(waits), 6) if waits else 0.0,
+            }

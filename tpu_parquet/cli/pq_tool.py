@@ -15,9 +15,16 @@ Command parity with the reference's parquet-tool (cmd/parquet-tool/cmds/):
               host-decompress- vs stall- vs device-resolve-bound), with the
               recalibrated TPQ_LINK_MBPS when the routes disagree with the
               ship planner's cost model
+    autopsy   post-mortem of a flight-recorder dump (the watchdog's or
+              TPQ_DUMP_SIGNAL's hang/crash snapshot): stalled lane,
+              blocked-thread classification, probable cause
     bench     run-ledger tools: `bench diff A B` (per-metric deltas with
               noise bounds from rep variance + stage attribution) and
               `bench history LEDGER` (one line per recorded run)
+
+trace/doctor/bench-diff arguments may be ledger refs — `latest`, `#N`,
+`ledger.jsonl#N` (default ledger: TPQ_LEDGER or ./ledger.jsonl) — so a
+post-mortem never requires remembering an artifact path.
 
 cat/head/rowcount take --filter "a > 5 and b == 'x'" for statistics-based
 row-group pruning (tpu_parquet.predicate).
@@ -169,18 +176,60 @@ def cmd_stats(args, out=sys.stdout) -> int:
     return 0
 
 
+def _load_doc(spec: str):
+    """Load a command argument to a JSON document: a plain file path, or a
+    ledger reference (``latest``, ``#N``, ``ledger.jsonl[#N]`` — see
+    ledger.load_side), so post-mortems address runs the way ``bench diff``
+    already does instead of remembering artifact paths."""
+    from .. import ledger
+
+    if ledger.is_ref(spec):
+        return ledger.load_side(spec)
+    try:
+        with open(spec) as f:
+            return json.load(f)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"{spec}: not JSON ({e})") from None
+
+
 def cmd_trace(args, out=sys.stdout) -> int:
     """Render a Chrome trace-event JSON (a ``TPQ_TRACE`` run) as the
     per-stage latency / overlap / stall / route-prediction report — the
     trace made useful without a browser (obs.trace_summary does the math;
-    Perfetto / chrome://tracing load the same file for the timeline)."""
+    Perfetto / chrome://tracing load the same file for the timeline).
+
+    Also accepts ledger refs (``latest``, ``#N``): the record's env names
+    the run's ``TPQ_TRACE`` base, and the per-config artifact
+    ``<base>.<config>.json`` (``--config``, default the record's first
+    config) is summarized in its place."""
     from ..obs import trace_summary
 
-    try:
-        with open(args.file) as f:
-            doc = json.load(f)
-    except json.JSONDecodeError as e:
-        raise ValueError(f"{args.file}: not JSON ({e})") from None
+    doc = _load_doc(args.file)
+    label = args.file
+    if isinstance(doc, dict) and "traceEvents" not in doc and "configs" in doc:
+        # a bench/ledger record: resolve its per-config trace artifact
+        base = (doc.get("env") or {}).get("TPQ_TRACE")
+        if not base:
+            out.write(f"pq-tool trace: {args.file}: run was recorded "
+                      f"without TPQ_TRACE — no trace artifact to "
+                      f"summarize (re-run with TPQ_TRACE=<base>)\n")
+            return 1
+        cfgs = doc.get("configs") or {}
+        cfg = getattr(args, "config", None) or next(iter(cfgs), None)
+        if not cfg:
+            out.write(f"pq-tool trace: {args.file}: record has no configs\n")
+            return 1
+        label = f"{base}.{cfg}.json"
+        try:
+            with open(label) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            out.write(f"pq-tool trace: {args.file}: trace artifact "
+                      f"{label} not found (moved or cleaned?)\n")
+            return 1
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{label}: not JSON ({e})") from None
+    args = argparse.Namespace(**{**vars(args), "file": label})
     s = trace_summary(doc)
     if not s["stages"]:
         # zero spans: the run recorded nothing to summarize — one-line
@@ -247,14 +296,11 @@ def _load_registry_tree(path, config=None):
     Accepts a trace-event document (uses the embedded registry), a bare
     registry tree (``obs_version`` at top level), a bench artifact
     (``configs``: picks ``--config`` or the first config embedding an
-    ``obs`` tree), or a ledger record.  Returns ``(tree, None)`` or
-    ``(None, diagnosis)``.
+    ``obs`` tree), or a ledger record / ledger ref (``latest``, ``#N``,
+    ``ledger.jsonl[#N]``).  Returns ``(tree, None)`` or ``(None,
+    diagnosis)``.
     """
-    try:
-        with open(path) as f:
-            doc = json.load(f)
-    except json.JSONDecodeError as e:
-        raise ValueError(f"{path}: not JSON ({e})") from None
+    doc = _load_doc(path)
     if not isinstance(doc, dict):
         return None, "top level is not an object"
     if "traceEvents" in doc:
@@ -321,6 +367,55 @@ def cmd_doctor(args, out=sys.stdout) -> int:
     if recal is not None:
         out.write(f"recalibrate: re-run with TPQ_LINK_MBPS={recal:g} "
                   f"(the measured staging rate) to align the planner\n")
+    return 0
+
+
+def cmd_autopsy(args, out=sys.stdout) -> int:
+    """Post-mortem of a flight-recorder dump (a hang/crash snapshot written
+    by the watchdog, ``TPQ_DUMP_SIGNAL``, a worker crash, or the explicit
+    API): which lane stopped advancing first, which threads are blocked on
+    which lock/queue, the longest budget-wait age, each thread's last
+    recorded event, and a one-line probable cause — the ``doctor`` verdict
+    style, for runs that never finished (obs.autopsy_dump does the math)."""
+    from ..obs import autopsy_dump
+
+    doc = _load_doc(args.file)
+    try:
+        rep = autopsy_dump(doc)
+    except ValueError as e:
+        out.write(f"pq-tool autopsy: {args.file}: {e}\n")
+        return 1
+    out.write(f"autopsy: {args.file} (reason: {rep['reason']}, "
+              f"pid {rep['pid']})\n")
+    ages = rep["ages"]
+    if rep["stalled_first"] is not None:
+        out.write(f"stalled first: {rep['stalled_first']} "
+                  f"(no advance for {ages.get(rep['stalled_first'], '?')}s "
+                  f"of a {rep['hang_s']}s deadline)\n")
+    if ages:
+        worst = sorted(ages.items(), key=lambda kv: -kv[1])[:6]
+        out.write("lane ages: " + "  ".join(
+            f"{k}={v:g}s" for k, v in worst) + "\n")
+    threads = rep["threads"]
+    if threads:
+        name_w = max(max(len(t["name"]) for t in threads.values()), 6)
+        out.write("threads:\n")
+        for _tid, t in sorted(threads.items(),
+                              key=lambda kv: kv[1]["name"]):
+            last = t["last_event"]
+            tail = (f"  last: {last['name']} {last['age_s']:g}s ago"
+                    if last else "")
+            dead = "" if t["alive"] else "  [DEAD]"
+            out.write(f"  {t['name']:<{name_w}}  {t['class']}{dead}{tail}\n")
+    b = rep.get("budget")
+    if b:
+        out.write(f"budget: {b['waiters']} waiter(s), longest wait "
+                  f"{b['longest_wait_s']:g}s\n")
+    err = rep.get("error")
+    if err:
+        out.write(f"error: {err.get('type')}: {err.get('message')}\n")
+    out.write(f"verdict: {rep['verdict']}\n")
+    out.write(f"probable cause: {rep['probable_cause']}\n")
     return 0
 
 
@@ -447,19 +542,31 @@ def build_parser() -> argparse.ArgumentParser:
     st.set_defaults(func=cmd_stats)
 
     tr = sub.add_parser(
-        "trace", help="summarize a TPQ_TRACE run (Chrome trace-event JSON)")
+        "trace", help="summarize a TPQ_TRACE run (Chrome trace-event JSON, "
+                      "or a ledger ref: latest, #N, ledger.jsonl#N)")
     tr.add_argument("file")
+    tr.add_argument("--config", default=None,
+                    help="ledger-ref input: which config's trace artifact "
+                         "to summarize (default: the record's first)")
     tr.set_defaults(func=cmd_trace)
 
     dr = sub.add_parser(
         "doctor",
         help="bottleneck attribution of a traced run (trace / registry / "
-             "bench artifact) + TPQ_LINK_MBPS recalibration")
+             "bench artifact / ledger ref: latest, #N, ledger.jsonl#N) "
+             "+ TPQ_LINK_MBPS recalibration")
     dr.add_argument("file")
     dr.add_argument("--config", default=None,
                     help="bench-artifact input: which config's registry to "
                          "diagnose (default: first with an obs tree)")
     dr.set_defaults(func=cmd_doctor)
+
+    au = sub.add_parser(
+        "autopsy",
+        help="post-mortem of a flight-recorder dump (hang/crash snapshot): "
+             "stalled lane, blocked-thread classes, probable cause")
+    au.add_argument("file")
+    au.set_defaults(func=cmd_autopsy)
 
     be = sub.add_parser(
         "bench", help="run-ledger tools: compare and list recorded runs")

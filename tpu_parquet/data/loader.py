@@ -151,8 +151,11 @@ class DataLoader:
         validate_crc: bool = False,
         trace=None,
         sample_ms=None,
+        hang_s=None,
+        hang_policy=None,
     ):
-        from ..obs import resolve_sample_ms, resolve_tracer
+        from ..obs import (register_flight_registry, resolve_hang_s,
+                           resolve_sample_ms, resolve_tracer)
 
         # span tracer (obs.py): batch/decode-wait spans + window-occupancy
         # counters; None = the TPQ_TRACE process tracer (no-op without the
@@ -163,6 +166,16 @@ class DataLoader:
         # counter-sampling cadence (obs.Sampler): each __iter__ runs one
         # sampler for the epoch — throughput/queue-depth curves on the trace
         self._sample_ms = resolve_sample_ms(sample_ms)
+        # hang watchdog deadline (obs.Watchdog, TPQ_HANG_S / hang_s=): each
+        # __iter__ arms one watchdog for the epoch, watching batch/row
+        # progress and the decode pipeline's lanes; on a wedge it dumps the
+        # flight recorder and (policy "raise") aborts the unit budget so
+        # the submitter raises errors.HangError
+        self._hang_s = resolve_hang_s(hang_s)
+        self._hang_policy = hang_policy
+        self._watchdog = None
+        self._budget = None  # the live epoch budget (_blocks sets it)
+        register_flight_registry(self, "obs_registry")
         if isinstance(files, (str, os.PathLike)):
             files = [files]
         self._paths = [os.fspath(p) for p in files]
@@ -456,6 +469,11 @@ class DataLoader:
                   if self._max_memory > 0 else None)
         cost = ((lambda u: self._unit_cost_all[u])
                 if budget is not None else None)
+        if budget is not None:
+            self._budget = budget  # sampler's budget_waiters track
+            wd = self._watchdog
+            if wd is not None and wd.enabled:
+                wd.add_abort_hook(budget.abort)
         # ONE unit of lookahead: the next unit's chunk pipeline runs while
         # the consumer permutes/batches the current one.  Deeper unit-level
         # fan-out only oversubscribes the cores the chunk pipeline already
@@ -478,7 +496,7 @@ class DataLoader:
                     break
                 t1 = time.perf_counter()
                 self._stats.decode_wait_seconds += t1 - t0
-                if tr.enabled:
+                if tr.active:
                     # consumer time blocked on the decode stream — the span
                     # that shrinks toward zero as prefetch hides the decode
                     tr.complete("decode_wait", t0, t1)
@@ -493,7 +511,7 @@ class DataLoader:
                 buffered += n
                 self._stats.window_peak_rows = max(
                     self._stats.window_peak_rows, buffered)
-                if tr.enabled:
+                if tr.enabled:  # counter track only: the ring wants spans
                     tr.counter("shuffle_window_rows", rows=buffered)
                 while buffered >= window:
                     cat = {c: (np.concatenate(parts[c])
@@ -516,6 +534,10 @@ class DataLoader:
                                       bidx, buffered)
                     if self._shuffle else None)
         finally:
+            self._budget = None
+            wd = self._watchdog
+            if budget is not None and wd is not None and wd.enabled:
+                wd.remove_abort_hook(budget.abort)
             stream.close()
 
     def _emit(self, cols: dict, n: int):
@@ -603,7 +625,7 @@ class DataLoader:
     def __iter__(self):
         """Iterate the CURRENT epoch from the current cursor, then advance
         the epoch.  ``state()`` between batches is a valid resume point."""
-        from ..obs import Sampler
+        from ..obs import Sampler, Watchdog
 
         epoch = self._epoch
         stats = self._stats
@@ -616,18 +638,41 @@ class DataLoader:
                 "decode_wait_seconds": round(stats.decode_wait_seconds, 6),
             })
             sampler.add_source("pipeline_lanes", self._pstats.sample)
+            sampler.add_source("budget_waiters", lambda: (
+                self._budget.snapshot() if self._budget is not None else {}))
             sampler.start()
+        watchdog = Watchdog(self._hang_s, policy=self._hang_policy)
+        lane = None
+        if watchdog.enabled:
+            watchdog.watch("loader", lambda: {
+                "batches": stats.batches, "rows": stats.rows,
+            })
+            watchdog.watch("pipeline", self._pstats.sample)
+            # consumer gate: a training loop pausing between batches (eval,
+            # checkpoint) freezes every lane above — only a consumer
+            # genuinely blocked in next() may read as a hang
+            lane = watchdog.watch_consumer()
+            self._watchdog = watchdog  # _blocks registers its budget's abort
+            watchdog.start()
         gen = self._batches(epoch, self._rows_taken)
         try:
             while True:
+                if watchdog.enabled:
+                    watchdog.check()  # surface a fired HangError even when
+                    # no budget wait existed for the abort hook to interrupt
                 # time each batch's PRODUCTION (decode + shuffle + assembly,
                 # consumer wait excluded) as a "batch" span
                 t0 = time.perf_counter()
+                if lane is not None:
+                    lane.producing()
                 try:
                     batch, consumed = next(gen)
                 except StopIteration:
                     break
-                if tr.enabled:
+                finally:
+                    if lane is not None:
+                        lane.idle()
+                if tr.active:
                     tr.complete("batch", t0, time.perf_counter(),
                                 rows=consumed)
                 self._rows_taken += consumed
@@ -640,7 +685,9 @@ class DataLoader:
                 yield batch
                 stats.touch_wall()
         finally:
-            sampler.stop()  # thread-leak-safe even on early abandon
+            watchdog.stop()  # thread-leak-safe even on early abandon
+            self._watchdog = None
+            sampler.stop()
             gen.close()
             if self._owns_tracer:
                 # per-loader trace artifact: rewrite (cumulatively) at every

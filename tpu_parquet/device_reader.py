@@ -3014,8 +3014,9 @@ class DeviceFileReader:
     def __init__(self, source, columns=None, validate_crc: bool = False,
                  profile_dir: "str | None" = None, max_memory: int = 0,
                  row_filter=None, prefetch: int = 0, trace=None,
-                 sample_ms=None):
-        from .obs import Sampler, resolve_sample_ms, resolve_tracer
+                 sample_ms=None, hang_s=None, hang_policy=None):
+        from .obs import (Sampler, Watchdog, register_flight_registry,
+                          resolve_hang_s, resolve_sample_ms, resolve_tracer)
         from .pipeline import PipelineStats
         from .reader import FileReader
 
@@ -3062,11 +3063,39 @@ class DeviceFileReader:
         # and same-named id-less tracks would interleave into one sawtooth
         self._sampler = Sampler(self._tracer, resolve_sample_ms(sample_ms),
                                 track_id=self._pipe_stats._obs_id)
+        # the chunk feed's in-flight budget, once a scan creates one — the
+        # sampler's budget_waiters track and the watchdog's abort hook both
+        # late-bind through it (_chunk_feed sets it)
+        self._live_budget = None
         if self._sampler.enabled:
             self._sampler.add_source("reader_progress", self._sample_progress)
-            self._sampler.add_source("pipeline_lanes", self._pipe_stats.sample)
+            # late-bound like the watchdog lanes below: iter_row_groups
+            # replaces _pipe_stats per scan and the sampled track must
+            # follow the live object, not the constructor-time one
+            self._sampler.add_source("pipeline_lanes",
+                                     lambda: self._pipe_stats.sample())
             self._sampler.add_source("alloc_bytes", self._sample_alloc)
+            self._sampler.add_source("budget_waiters", self._sample_budget)
             self._sampler.start()
+        # hang watchdog (obs.Watchdog, TPQ_HANG_S / hang_s=): fires a
+        # flight dump (and, policy "raise", aborts the chunk feed's budget
+        # so the submitter raises HangError) when no lane below advances.
+        # Lambdas late-bind self._pipe_stats: iter_row_groups replaces it
+        # per scan and the heartbeats must follow the live object.
+        self._watchdog = Watchdog(resolve_hang_s(hang_s), policy=hang_policy)
+        if self._watchdog.enabled:
+            self._watchdog.watch("pipeline",
+                                 lambda: self._pipe_stats.sample())
+            self._watchdog.watch("reader", self._sample_progress)
+            # idle consumer gate until the first scan replaces it: both
+            # counter lanes above are frozen at 0 while the reader sits
+            # un-iterated, and a reader built long before its first
+            # iter_row_groups must not read as a hang
+            self._watchdog.watch_consumer()
+            self._watchdog.start()
+        # a wedged process's dump should embed the same registry tree a
+        # clean close would have written (weakly held — see obs)
+        register_flight_registry(self, "obs_registry")
 
     def _sample_progress(self) -> dict:
         st = self._stats
@@ -3078,7 +3107,12 @@ class DeviceFileReader:
         in_use, peak = self.alloc.snapshot()
         return {"in_use": in_use, "peak": peak}
 
+    def _sample_budget(self) -> dict:
+        b = self._live_budget
+        return b.snapshot() if b is not None else {}
+
     def close(self):
+        self._watchdog.stop()  # before the sampler: no dump mid-teardown
         self._sampler.stop()  # before the write: the final tick must land
         self._host.close()
         if self._owns_tracer:
@@ -3366,7 +3400,7 @@ class DeviceFileReader:
             tr = self._pipe_stats.tracer
             for route, logical, shipped, predicted in asm.ship_records:
                 self._stats.count_route(route, logical, shipped, predicted)
-                if tr is not None and tr.enabled:
+                if tr is not None and tr.active:
                     # one instant per shipped stream: pq_tool trace folds
                     # these into the per-route predicted-vs-measured table
                     tr.instant("ship", route=route, column=name,
@@ -3387,7 +3421,7 @@ class DeviceFileReader:
         self._stats.host_seconds += now - t0
         self._stats.wall_seconds = now - self._t0
         tr = self._pipe_stats.tracer
-        if tr is not None and tr.enabled:
+        if tr is not None and tr.active:
             tr.complete("prepare", t0, now, rg=index, bytes=stager.total)
         return out, plans, stager
 
@@ -3592,6 +3626,7 @@ class DeviceFileReader:
                 finalize_each=finalize_each,
                 prefetch=self._prefetch,
                 budget_bytes=self.alloc.max_size,
+                watchdog=self._watchdog,
             ):
                 yield out
 
@@ -3633,7 +3668,7 @@ def _timed_stage(reader: DeviceFileReader, stager: _RowGroupStager):
     return buf_dev
 
 
-def _chunk_feed(work, prefetch: int, budget_bytes: int = 0):
+def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
     """Chunk-granular prefetch over the ``(reader, path, index)`` stream.
 
     The host half of the overlapped pipeline (ISSUE 1 tentpole): IO + CRC +
@@ -3664,6 +3699,11 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0):
     from .pipeline import SharedReader, prefetch_map
 
     budget = InFlightBudget(budget_bytes)
+    if watchdog is not None and watchdog.enabled:
+        # the raise-policy exit from a wedge: aborting the budget wakes the
+        # submitter blocked in acquire() with HangError (obs.Watchdog)
+        watchdog.add_abort_hook(budget.abort)
+    fed: set = set()  # readers whose _live_budget points at this feed
     srs: dict[int, SharedReader] = {}
     pending: dict[tuple, dict] = {}
     current = {"stats": None}  # stats of the reader whose item is submitting
@@ -3706,6 +3746,8 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0):
     def gen_items():
         for r, path, i in work:
             current["stats"] = r._pipe_stats
+            r._live_budget = budget  # sampler budget_waiters track late-binds
+            fed.add(r)
             sr = srs.get(id(r))
             if sr is None:
                 sr = srs[id(r)] = SharedReader(r._host._f)
@@ -3769,28 +3811,40 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0):
         stats.count_chunk()
         return (id(r), i), p, (md, asm)
 
-    for key, p, payload in prefetch_map(gen_items(), collect, prefetch,
-                                        budget=budget, cost=cost,
-                                        stats=_StatsFwd()):
-        slot = pending[key]
-        if p is not None:
-            slot["chunks"][p] = payload
-        slot["todo"] -= 1
-        if slot["todo"] == 0:
-            del pending[key]
-            r = slot["r"]
-            r._pipe_stats.note_peak(budget)
-            r._pipe_stats.touch_wall()
-            yield r, slot["path"], slot["i"], {
-                "chunks": slot["chunks"],
-                "rows_dropped": slot["rows_dropped"],
-            }
+    try:
+        for key, p, payload in prefetch_map(gen_items(), collect, prefetch,
+                                            budget=budget, cost=cost,
+                                            stats=_StatsFwd()):
+            slot = pending[key]
+            if p is not None:
+                slot["chunks"][p] = payload
+            slot["todo"] -= 1
+            if slot["todo"] == 0:
+                del pending[key]
+                r = slot["r"]
+                r._pipe_stats.note_peak(budget)
+                r._pipe_stats.touch_wall()
+                yield r, slot["path"], slot["i"], {
+                    "chunks": slot["chunks"],
+                    "rows_dropped": slot["rows_dropped"],
+                }
+    finally:
+        # un-bind the dead feed's budget: a later flight dump (or a reused
+        # reader's sampler) must not report this scan's stale zero-waiter
+        # budget as live state — and the reader-lifetime watchdog must not
+        # pin (or abort) this scan's budget after the feed is gone
+        if watchdog is not None and watchdog.enabled:
+            watchdog.remove_abort_hook(budget.abort)
+        for r in fed:
+            if r._live_budget is budget:
+                r._live_budget = None
 
 
 def _scan_pipeline(work, ex, finalize_each: bool = False,
                    close_finished: bool = False,
                    defer_finalize: bool = False,
-                   prefetch: int = 0, budget_bytes: int = 0):
+                   prefetch: int = 0, budget_bytes: int = 0,
+                   watchdog=None):
     """The one-deep prepare/stage/dispatch pipeline shared by
     ``DeviceFileReader.iter_row_groups`` (one reader) and :func:`scan_files`
     (many).  ``work`` yields ``(reader, path, row_group_index)``; this yields
@@ -3813,39 +3867,70 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
     never touches a closed descriptor).
     """
     if prefetch > 0:
-        stream = _chunk_feed(work, prefetch, budget_bytes)
+        stream = _chunk_feed(work, prefetch, budget_bytes, watchdog=watchdog)
     else:
         stream = ((r, path, i, None) for r, path, i in work)
-    prev = None  # (reader, path, prepared, staging future)
-    for r, path, i, collected in stream:
-        prepared = r._prepare_row_group(i, executor=ex, collected=collected)
-        fut = ex.submit(_timed_stage, r, prepared[2]) if prepared[1] else None
+    # consumer gate: the watchdog may only fire while the consumer is
+    # genuinely blocked in here producing — a consumer pausing between row
+    # groups freezes every other lane (full prefetch window) and must not
+    # read as a hang (obs.ConsumerLane)
+    lane = (watchdog.watch_consumer()
+            if watchdog is not None and watchdog.enabled else None)
+    try:
+        if lane is not None:
+            lane.producing()
+        prev = None  # (reader, path, prepared, staging future)
+        for r, path, i, collected in stream:
+            if watchdog is not None:
+                watchdog.check()  # surface a fired raise-policy HangError
+                # even when no budget wait existed to interrupt (prefetch=0)
+            prepared = r._prepare_row_group(i, executor=ex,
+                                            collected=collected)
+            fut = (ex.submit(_timed_stage, r, prepared[2])
+                   if prepared[1] else None)
+            if prev is not None:
+                pr, pp, pprep, pfut = prev
+                out = pr._dispatch_row_group(
+                    pprep, pfut.result() if pfut else None
+                )
+                if lane is not None:
+                    lane.idle()
+                yield pp, out
+                if lane is not None:
+                    lane.producing()
+                if finalize_each or pr is not r:
+                    if not defer_finalize:
+                        # a mid-pipeline finalize is a D2H sync that stalls
+                        # the async queue; multi-file scans defer it to one
+                        # combined end-of-scan check (_finalize_many)
+                        pr.finalize()
+                    if close_finished and pr is not r:
+                        pr.close()
+            prev = (r, path, prepared, fut)
         if prev is not None:
             pr, pp, pprep, pfut = prev
-            yield pp, pr._dispatch_row_group(
+            out = pr._dispatch_row_group(
                 pprep, pfut.result() if pfut else None
             )
-            if finalize_each or pr is not r:
-                if not defer_finalize:
-                    # a mid-pipeline finalize is a D2H sync that stalls the
-                    # async queue; multi-file scans defer it to one combined
-                    # end-of-scan check (_finalize_many)
-                    pr.finalize()
-                if close_finished and pr is not r:
-                    pr.close()
-        prev = (r, path, prepared, fut)
-    if prev is not None:
-        pr, pp, pprep, pfut = prev
-        yield pp, pr._dispatch_row_group(
-            pprep, pfut.result() if pfut else None
-        )
-        if not defer_finalize:
-            pr.finalize()
+            if lane is not None:
+                lane.idle()
+            yield pp, out
+            if lane is not None:
+                lane.producing()
+            if not defer_finalize:
+                pr.finalize()
+    finally:
+        # the scan is over (or dead): leave the lane advancing so a
+        # reader's long-lived watchdog never mistakes post-scan idleness
+        # (or a consumer that abandoned us) for a wedge
+        if lane is not None:
+            lane.idle()
 
 
 def scan_files(paths, columns=None, validate_crc: bool = False,
                max_memory: int = 0, row_filter=None, with_path: bool = False,
-               prefetch: int = 0, trace=None, sample_ms=None):
+               prefetch: int = 0, trace=None, sample_ms=None, hang_s=None,
+               hang_policy=None):
     """Scan several files' row groups through ONE continuous transfer pipeline.
 
     ``prefetch=K`` additionally runs chunk IO + decompression K-deep on a
@@ -3885,7 +3970,7 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
     """
     from concurrent.futures import ThreadPoolExecutor
 
-    from .obs import resolve_tracer
+    from .obs import Watchdog, resolve_hang_s, resolve_tracer
 
     # one tracer spans the whole scan (per-file tracers would shred the
     # timeline Perfetto is supposed to show); with a path, the trace + the
@@ -3893,12 +3978,34 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
     tracer, owns_tracer = resolve_tracer(trace)
     readers: list[DeviceFileReader] = []
 
+    # ONE watchdog spans the whole scan (per-reader watchdogs would call a
+    # reader idle just because its neighbor has the pipeline's turn);
+    # child readers are armed with an explicit hang_s=0 below so the env
+    # cannot raise N redundant watchdog threads for one scan
+    watchdog = Watchdog(resolve_hang_s(hang_s), policy=hang_policy)
+    if watchdog.enabled:
+        def _lanes():
+            out: dict = {}
+            for r in list(readers):
+                for k, v in r._pipe_stats.sample().items():
+                    out[k] = out.get(k, 0) + v
+            return out
+
+        watchdog.watch("pipeline", _lanes)
+        watchdog.watch("reader", lambda: {
+            "rows": sum(r._stats.rows for r in list(readers)),
+            "chunks": sum(r._stats.chunks for r in list(readers)),
+            "staged_bytes": sum(r._stats.staged_bytes
+                                for r in list(readers)),
+        })
+        watchdog.start()
+
     def work():
         for path in paths:
             r = DeviceFileReader(
                 path, columns=columns, validate_crc=validate_crc,
                 max_memory=max_memory, row_filter=row_filter, trace=tracer,
-                sample_ms=sample_ms,
+                sample_ms=sample_ms, hang_s=0,
             )
             readers.append(r)
             for i in range(r.num_row_groups):
@@ -3910,10 +4017,12 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
             for pp, out in _scan_pipeline(work(), ex, close_finished=True,
                                           defer_finalize=True,
                                           prefetch=int(prefetch),
-                                          budget_bytes=int(max_memory)):
+                                          budget_bytes=int(max_memory),
+                                          watchdog=watchdog):
                 yield (pp, out) if with_path else out
         _finalize_many(readers)
     finally:
+        watchdog.stop()
         try:
             # idempotent re-check: covers consumers that abandon the scan
             # early (break/islice) — their consumed-but-unchecked files

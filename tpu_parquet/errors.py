@@ -12,6 +12,25 @@ class ParquetError(ValueError):
     """Malformed parquet input."""
 
 
+class HangError(RuntimeError):
+    """A watched pipeline made no progress within the watchdog deadline.
+
+    Raised by :class:`tpu_parquet.obs.Watchdog` (policy ``raise``) in the
+    SUBMITTING thread — the one blocked on an
+    :class:`~tpu_parquet.alloc.InFlightBudget` — after a flight-recorder
+    dump has been written, so the wedge becomes a diagnosable error instead
+    of a silent hang.  Deliberately NOT a ParquetError: the input file is
+    not malformed, the pipeline is stuck, and the fuzz harness's
+    crash oracle must never classify a hang as a parse failure.
+    ``dump_path`` names the flight-recorder snapshot to feed
+    ``pq_tool autopsy``.
+    """
+
+    def __init__(self, message: str, dump_path: "str | None" = None):
+        super().__init__(message)
+        self.dump_path = dump_path
+
+
 class CheckpointError(ParquetError):
     """Malformed, incompatible, or version-mismatched loader checkpoint state.
 

@@ -40,9 +40,9 @@ import subprocess
 import time
 
 __all__ = [
-    "LEDGER_VERSION", "append", "attribute_stages", "check", "diff",
-    "env_fingerprint", "format_diff", "format_history", "git_rev",
-    "load_side", "make_record", "read", "rel_noise",
+    "LEDGER_VERSION", "append", "attribute_stages", "check", "default_path",
+    "diff", "env_fingerprint", "format_diff", "format_history", "git_rev",
+    "is_ref", "load_side", "make_record", "read", "rel_noise",
 ]
 
 # version of the ledger line schema; bumped when a field changes meaning so
@@ -192,14 +192,34 @@ def read(path: str) -> "list[dict]":
     return out
 
 
+def default_path() -> str:
+    """The default ledger the bare refs resolve against: ``TPQ_LEDGER``
+    when set, else ``ledger.jsonl`` in the working directory (the same
+    name bench.py appends to next to its artifact)."""
+    return os.environ.get("TPQ_LEDGER") or "ledger.jsonl"
+
+
+def is_ref(spec: str) -> bool:
+    """True when ``spec`` is a ledger reference rather than a plain
+    artifact path: ``latest``, ``latest#N``, ``#N``, ``*.jsonl``, or
+    ``*.jsonl#N`` — the forms ``load_side`` resolves through a ledger."""
+    path, _, _idx = spec.partition("#")
+    return path in ("", "latest") or path.endswith(".jsonl")
+
+
 def load_side(spec: str) -> dict:
     """Resolve one side of a diff/check to a run record.
 
     Accepted forms: a bench artifact ``*.json`` (read whole), a ledger
-    ``*.jsonl`` (its LAST record), or ``ledger.jsonl#N`` (record N;
-    negative counts from the end, so ``#-2`` is the previous run).
+    ``*.jsonl`` (its LAST record), ``ledger.jsonl#N`` (record N; negative
+    counts from the end, so ``#-2`` is the previous run), and the default-
+    ledger shorthands ``latest`` (last record of :func:`default_path`),
+    ``latest#N``, and bare ``#N`` — so post-mortems (`pq_tool doctor
+    latest`) never require remembering artifact paths.
     """
     path, _, idx = spec.partition("#")
+    if path in ("", "latest"):
+        path = default_path()
     if idx or path.endswith(".jsonl"):
         records = read(path)
         if not records:

@@ -36,6 +36,32 @@ never pull numpy/jax):
 per-stage p50/p95 table, overlap efficiency, stall attribution, and
 route-prediction error via :func:`trace_summary`, so a trace is useful
 without a browser.
+
+The crash/hang half (the black-box flight recorder) lives here too:
+
+- :class:`FlightRecorder` — an always-on, bounded per-thread ring of the
+  most recent trace events.  Every :class:`Tracer` — including the
+  disabled no-``TPQ_TRACE`` singleton — tees its spans/instants/counters
+  into the process recorder, so the last N seconds of every lane are
+  recoverable from a hung or crashed process.  ``dump()`` writes a
+  versioned JSON snapshot: ring events per thread, every Python thread's
+  stack (``sys._current_frames``), live ``InFlightBudget`` /
+  ``AllocTracker`` snapshots (waiter count + longest-wait age), live
+  ``PipelineStats`` lane samples, and the merged live registry tree.
+  Triggers: explicit API, ``TPQ_DUMP_SIGNAL`` (``faulthandler``-style,
+  opt-in), an unhandled exception in a pipeline/loader worker, and
+  exit-on-unhandled-exception (both file triggers gated on ``TPQ_FLIGHT``).
+
+- :class:`Watchdog` — a daemon thread (same lifecycle discipline as
+  :class:`Sampler`) watching per-stage progress heartbeats; when no
+  watched counter advances within ``TPQ_HANG_S`` / ``hang_s=`` it writes a
+  flight dump and either logs-and-continues or aborts the in-flight
+  budget so the submitter raises :class:`~tpu_parquet.errors.HangError`.
+
+- :func:`autopsy_dump` — the ``pq_tool autopsy`` backend: classifies each
+  dumped thread's stack (budget-wait / queue-get / future-wait /
+  device-sync / lock-wait), names the lane that stopped advancing first,
+  and renders a one-line probable cause.
 """
 
 from __future__ import annotations
@@ -43,14 +69,22 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import sys
 import threading
 import time
+import weakref
+from collections import deque
 from typing import Optional
 
 __all__ = [
-    "OBS_VERSION", "LatencyHistogram", "Sampler", "StatsRegistry", "Tracer",
-    "current_tracer", "doctor_registry", "resolve_sample_ms",
-    "resolve_tracer", "trace_summary",
+    "FLIGHT_VERSION", "OBS_VERSION", "ConsumerLane", "FlightRecorder",
+    "LatencyHistogram",
+    "Sampler", "StatsRegistry", "Tracer", "Watchdog", "autopsy_dump",
+    "current_tracer", "doctor_registry", "flight_dump_path",
+    "flight_recorder", "install_flight_hooks", "note_worker_crash",
+    "register_flight_registry", "register_flight_source",
+    "resolve_hang_s", "resolve_sample_ms", "resolve_tracer",
+    "trace_summary",
 ]
 
 # version of every schema this module emits (the registry tree, the trace
@@ -158,6 +192,251 @@ class LatencyHistogram:
 
 
 # ---------------------------------------------------------------------------
+# flight recorder: the always-on black box
+# ---------------------------------------------------------------------------
+
+# version of the dump snapshot schema `FlightRecorder.dump` writes and
+# `autopsy_dump` consumes — golden-key-tested like the registry tree
+FLIGHT_VERSION = 1
+
+# live providers the dump pulls from (module-level, not per-recorder, so a
+# test's private recorder still sees the process's live pipelines/readers):
+# weakrefs only — registration must never extend a reader's lifetime
+_flight_lock = threading.Lock()
+_flight_sources: "list[tuple[str, weakref.ref, str]]" = []
+_flight_registries: "list[tuple[weakref.ref, str]]" = []
+
+
+def _prune_providers(lst) -> None:
+    lst[:] = [entry for entry in lst if entry[-2]() is not None]
+
+
+def register_flight_source(label: str, obj, method: str = "sample") -> None:
+    """Register a live counter source (``obj.method() -> {name: number}``)
+    for flight dumps — e.g. every :class:`~tpu_parquet.pipeline
+    .PipelineStats` registers its ``sample`` so a dump shows the per-lane
+    seconds and queue depth at the moment of the wedge.  Weakly held."""
+    with _flight_lock:
+        _prune_providers(_flight_sources)
+        _flight_sources.append((label, weakref.ref(obj), method))
+
+
+def register_flight_registry(obj, method: str = "obs_registry") -> None:
+    """Register a live registry provider (``obj.method() ->
+    StatsRegistry``): readers and loaders register themselves so a dump
+    embeds the same tree a clean close would have written.  Weakly held."""
+    with _flight_lock:
+        _prune_providers(_flight_registries)
+        _flight_registries.append((weakref.ref(obj), method))
+
+
+def flight_dump_path() -> str:
+    """Where unsolicited dumps land: ``TPQ_FLIGHT`` when set, else
+    ``tpq_flight.<pid>.json`` in the working directory."""
+    return os.environ.get("TPQ_FLIGHT") or f"tpq_flight.{os.getpid()}.json"
+
+
+class FlightRecorder:
+    """Always-on bounded in-memory ring of recent trace events.
+
+    One ``deque(maxlen=capacity)`` per thread (``TPQ_RING_EVENTS`` events
+    each, default 256; 0 disables), appended lock-free on the hot path (a
+    thread only ever appends to its own ring; CPython deque appends are
+    atomic) — the recording cost is one thread-local attribute read, a
+    tuple build, and an append, guarded <3% by the tier-1 overhead test.
+    A chatty thread can never evict a stalled thread's history, which is
+    exactly the history a hang autopsy needs.
+
+    ``snapshot()``/``dump()`` produce the versioned post-mortem document:
+    ring events per thread with ages, every thread's current stack, live
+    budget/tracker/pipeline state, and the merged live registry tree.
+    Dumping never raises into the caller's control flow beyond I/O errors
+    on the explicit path — every provider is individually guarded.
+    """
+
+    def __init__(self, capacity: "int | None" = None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("TPQ_RING_EVENTS", "") or 256)
+            except ValueError:
+                capacity = 256
+        self.capacity = max(int(capacity), 0)
+        self._lock = threading.Lock()
+        self._threads: "dict[int, tuple[str, deque]]" = {}
+        self._local = threading.local()
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    # -- recording (hot path) -------------------------------------------------
+
+    def _register_thread(self) -> deque:
+        t = threading.current_thread()
+        ring: deque = deque(maxlen=self.capacity)
+        with self._lock:
+            # keyed by ident: reused idents overwrite their dead
+            # predecessor, so the map stays bounded by live threads
+            self._threads[t.ident or 0] = (t.name, ring)
+        self._local.ring = ring
+        return ring
+
+    def record(self, ph: str, name: str, ts: float, dur: float = 0.0,
+               args: "dict | None" = None) -> None:
+        """Append one event (``ts``/``dur`` in perf_counter seconds)."""
+        if not self.capacity:
+            return
+        try:
+            ring = self._local.ring
+        except AttributeError:
+            ring = self._register_thread()
+        ring.append((ph, name, ts, dur, args))
+
+    # -- snapshot / dump ------------------------------------------------------
+
+    @staticmethod
+    def _format_stack(frame) -> "list[dict]":
+        import traceback
+
+        out = []
+        for fs in traceback.extract_stack(frame):
+            out.append({"file": fs.filename, "line": fs.lineno,
+                        "func": fs.name, "code": fs.line or ""})
+        return out  # outermost first, same order as a printed traceback
+
+    def snapshot(self, reason: str = "explicit",
+                 watchdog: "dict | None" = None,
+                 error: "BaseException | None" = None) -> dict:
+        now_p = time.perf_counter()
+        with self._lock:
+            rings = list(self._threads.items())
+        threads = {}
+        for tid, (name, ring) in rings:
+            # writers append to their own ring lock-free (by design), and a
+            # CPython deque raises if mutated during iteration even at
+            # constant maxlen size — retry the copy; a busy thread's ring
+            # settles between appends, and a dump must never be lost to it
+            events: list = []
+            for _ in range(5):
+                try:
+                    events = list(ring)
+                    break
+                except RuntimeError:
+                    continue
+            threads[tid] = (name, events)
+        frames = sys._current_frames()
+        # `or 0`: enumerate() can briefly surface a thread whose ident is
+        # not yet assigned (mid-start) — it must not break the dump
+        alive = {(t.ident or 0): t.name for t in threading.enumerate()}
+        tout: dict = {}
+        for tid in sorted(set(threads) | set(frames) | set(alive)):
+            name, ring = threads.get(tid, (alive.get(tid, "?"), []))
+            events = [{
+                "ph": ph, "name": nm,
+                "age_s": round(now_p - ts, 6),
+                "dur_s": round(dur, 6),
+                **({"args": a} if a else {}),
+            } for ph, nm, ts, dur, a in ring]
+            entry: dict = {
+                "name": alive.get(tid, name),
+                "alive": tid in alive,
+                "events": events,
+                "last_event": events[-1] if events else None,
+            }
+            f = frames.get(tid)
+            if f is not None:
+                try:
+                    entry["stack"] = self._format_stack(f)
+                except Exception:  # noqa: BLE001 — a dump must not fail
+                    entry["stack"] = []
+            tout[str(tid)] = entry
+        doc = {
+            "flight_version": FLIGHT_VERSION,
+            "obs_version": OBS_VERSION,
+            "reason": reason,
+            "ts": round(time.time(), 3),
+            "pid": os.getpid(),
+            "ring_capacity": self.capacity,
+            "threads": tout,
+            "watchdog": watchdog,
+            "error": ({"type": type(error).__name__,
+                       "message": str(error)[:500]}
+                      if error is not None else None),
+        }
+        try:
+            from . import alloc
+
+            doc["budgets"] = alloc.budget_snapshots()
+            doc["trackers"] = alloc.tracker_snapshots()
+        except Exception:  # noqa: BLE001
+            doc["budgets"], doc["trackers"] = [], []
+        samples: dict = {}
+        with _flight_lock:
+            sources = list(_flight_sources)
+            registries = list(_flight_registries)
+        for label, ref, method in sources:
+            obj = ref()
+            if obj is None:
+                continue
+            try:
+                v = getattr(obj, method)()
+            except Exception:  # noqa: BLE001 — a dead source never kills a dump
+                continue
+            if isinstance(v, dict):
+                samples[label] = v
+        doc["samples"] = samples
+        reg_tree = None
+        merged = StatsRegistry()
+        found = False
+        for ref, method in registries:
+            obj = ref()
+            if obj is None:
+                continue
+            try:
+                merged.merge_from(getattr(obj, method)())
+                found = True
+            except Exception:  # noqa: BLE001
+                continue
+        if found:
+            try:
+                reg_tree = merged.as_dict()
+            except Exception:  # noqa: BLE001
+                reg_tree = None
+        doc["registry"] = reg_tree
+        return doc
+
+    def dump(self, path: "str | None" = None, reason: str = "explicit",
+             watchdog: "dict | None" = None,
+             error: "BaseException | None" = None) -> str:
+        """Write a snapshot to ``path`` (default :func:`flight_dump_path`);
+        returns the path.  Same mkdir-parents contract as Tracer.write."""
+        doc = self.snapshot(reason=reason, watchdog=watchdog, error=error)
+        path = path or flight_dump_path()
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f, default=repr)
+            f.write("\n")
+        return path
+
+
+_flight: "FlightRecorder | None" = None
+
+
+def flight_recorder() -> FlightRecorder:
+    """The process-wide flight recorder (capacity from ``TPQ_RING_EVENTS``
+    at first use).  Always returns an object; with capacity 0 its record
+    calls are no-ops and tracers skip the tee entirely."""
+    global _flight
+    if _flight is None:
+        with _flight_lock:
+            if _flight is None:
+                _flight = FlightRecorder()
+    return _flight
+
+
+# ---------------------------------------------------------------------------
 # span tracer
 # ---------------------------------------------------------------------------
 
@@ -207,11 +486,22 @@ class Tracer:
     When ``enabled`` is False every record call is one ``if`` and ``span()``
     returns a module-level no-op singleton — the hot loops keep their obs
     calls unconditionally and pay <3% (tier-1 guarded).
+
+    Every tracer additionally TEES its events into a
+    :class:`FlightRecorder` ring (the process recorder by default, even
+    when disabled — that is the black box: the last N events per thread
+    survive in memory with no ``TPQ_TRACE`` set).  ``ring=None`` opts a
+    tracer out entirely; hot-path guards check :attr:`active` (enabled OR
+    ring-teed) so span timing happens exactly when someone is listening.
     """
 
-    def __init__(self, path: "str | None" = None, enabled: bool = True):
+    def __init__(self, path: "str | None" = None, enabled: bool = True,
+                 ring: "FlightRecorder | None | type[Ellipsis]" = ...):
         self.enabled = bool(enabled)
         self.path = path
+        if ring is ...:
+            ring = flight_recorder()
+        self.ring = ring if (ring is not None and ring.enabled) else None
         self._events: list[dict] = []
         self._lock = threading.Lock()
         self._pid = os.getpid()
@@ -219,6 +509,12 @@ class Tracer:
         self._written = False
         if path is not None and self.enabled:
             atexit.register(self._atexit_write)
+
+    @property
+    def active(self) -> bool:
+        """True when recording anywhere (the event list or the flight
+        ring) — the guard hot loops use around building span args."""
+        return self.enabled or self.ring is not None
 
     # -- recording ------------------------------------------------------------
 
@@ -237,13 +533,17 @@ class Tracer:
         return tid
 
     def span(self, name: str, **args):
-        """Context manager timing a nested span (no-op when disabled)."""
-        if not self.enabled:
+        """Context manager timing a nested span (no-op when neither the
+        event list nor the flight ring is recording)."""
+        if not self.enabled and self.ring is None:
             return _NULL_SPAN
         return _Span(self, name, args)
 
     def complete(self, name: str, t0: float, t1: float, **args) -> None:
         """Record an already-timed interval (perf_counter seconds)."""
+        ring = self.ring
+        if ring is not None:
+            ring.record("X", name, t0, t1 - t0, args or None)
         if not self.enabled:
             return
         ev = {
@@ -257,6 +557,9 @@ class Tracer:
             self._events.append(ev)
 
     def instant(self, name: str, **args) -> None:
+        ring = self.ring
+        if ring is not None:
+            ring.record("i", name, time.perf_counter(), 0.0, args or None)
         if not self.enabled:
             return
         ev = {
@@ -275,6 +578,9 @@ class Tracer:
         same-named counters from different emitters (two readers of one
         ``scan_files`` sampling onto the shared tracer) render as separate
         ``name[id]`` tracks instead of interleaving into one sawtooth."""
+        ring = self.ring
+        if ring is not None:
+            ring.record("C", name, time.perf_counter(), 0.0, values or None)
         if not self.enabled:
             return
         ev = {
@@ -494,8 +800,285 @@ class Sampler:
                     if isinstance(v, (int, float)) and not isinstance(v, bool)}
             nums.pop("track_id", None)  # reserved for the keyword below
             if nums:
-                self.tracer.counter(track, track_id=self.track_id, **nums)
+                # the EMIT side is guarded like the read side: scan_files
+                # can close/write the shared tracer while a sibling
+                # reader's sampler (or the watchdog) still ticks, and a
+                # torn-down tracer must drop the tick, not kill the daemon
+                # thread mid-run (satellite: shared-cadence hygiene)
+                try:
+                    self.tracer.counter(track, track_id=self.track_id, **nums)
+                except Exception:  # noqa: BLE001
+                    self.dropped += 1
         self.ticks += 1
+
+
+# ---------------------------------------------------------------------------
+# hang watchdog
+# ---------------------------------------------------------------------------
+
+def resolve_hang_s(hang_s=None) -> float:
+    """Resolve a ``hang_s=`` kwarg against ``TPQ_HANG_S`` (kwarg wins —
+    including an explicit 0, which disables the watchdog even when the env
+    is set; unset/invalid env disables)."""
+    if hang_s is not None:
+        try:
+            return max(float(hang_s), 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+    env = os.environ.get("TPQ_HANG_S", "")
+    try:
+        return max(float(env), 0.0) if env else 0.0
+    except ValueError:
+        return 0.0
+
+
+class ConsumerLane:
+    """A watchdog lane that distinguishes a wedged pipeline from a merely
+    paused consumer.
+
+    Every other heartbeat freezes the moment the consumer stops pulling
+    (the prefetch window fills, counters stop) — so a training loop that
+    pauses between batches to checkpoint or eval would look exactly like a
+    hang.  This lane's value ADVANCES (wall clock) while the consumer is
+    away (``idle``) and FREEZES at the moment it entered the producer
+    (``producing``): the watchdog can then only fire while the consumer is
+    genuinely blocked inside ``next()`` on a frozen pipeline.
+    """
+
+    __slots__ = ("_since",)
+
+    def __init__(self):
+        self._since: "float | None" = None
+
+    def producing(self) -> None:
+        """The consumer just entered the producer (blocked in next())."""
+        self._since = time.monotonic()
+
+    def idle(self) -> None:
+        """About to yield: the consumer is going away with its batch."""
+        self._since = None
+
+    def value(self) -> float:
+        s = self._since
+        return s if s is not None else time.monotonic()
+
+
+class Watchdog:
+    """Daemon thread that detects a wedged pipeline from frozen heartbeats.
+
+    ``watch(label, fn)`` registers a progress source: a zero-arg callable
+    returning a number or a ``{lane: number}`` dict (each dict key becomes
+    its own ``label.lane``).  The thread re-reads every source on a cadence
+    of ``hang_s / 4`` (clamped to [20 ms, 1 s]); a lane "advances" when its
+    value changes.  When **no** watched lane advances within ``hang_s``,
+    the watchdog fires ONCE:
+
+    1. writes a flight-recorder dump (``reason="hang"``) carrying per-lane
+       no-advance ages and the lane that stopped advancing first, then
+    2. policy ``"log"``: logs a warning and re-arms (graceful degradation:
+       the run continues, the dump is the artifact), or policy ``"raise"``
+       (the default, ``TPQ_HANG_POLICY`` overrides): builds a
+       :class:`~tpu_parquet.errors.HangError` naming the dump and calls
+       every registered abort hook — readers/loaders register their
+       :meth:`~tpu_parquet.alloc.InFlightBudget.abort`, so the SUBMITTER
+       blocked on backpressure wakes and raises instead of hanging
+       forever.
+
+    Lifecycle discipline matches :class:`Sampler`: inert (``start`` is a
+    no-op) when ``hang_s`` is 0 or nothing is watched, ``stop()`` joins,
+    the thread is a daemon, and every heartbeat/dump/hook call is guarded
+    — a watchdog must never take a healthy run down.
+
+    The deadline must exceed the longest legitimate single unit of work
+    (one chunk's IO+decompress, one device sync): heartbeats are
+    cumulative counters that only move when a unit COMPLETES.
+    """
+
+    def __init__(self, hang_s, recorder: "FlightRecorder | None" = None,
+                 name: str = "tpq-watchdog", policy: "str | None" = None,
+                 dump_path: "str | None" = None):
+        self.hang_s = max(float(hang_s or 0.0), 0.0)
+        self.recorder = recorder
+        self.name = name
+        env_policy = os.environ.get("TPQ_HANG_POLICY", "")
+        self.policy = policy or env_policy or "raise"
+        if self.policy not in ("raise", "log"):
+            if policy:  # explicit kwarg: a code bug, fail loudly
+                raise ValueError(
+                    f"hang policy {self.policy!r} is not 'raise' or 'log'")
+            # env typo: degrade to the safe default instead of failing
+            # every reader/loader construction (resolve_hang_s treats a
+            # malformed TPQ_HANG_S the same way — disabled, not fatal)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "TPQ_HANG_POLICY=%r is not 'raise' or 'log'; using 'raise'",
+                env_policy)
+            self.policy = "raise"
+        self.dump_path = dump_path
+        self._watch: list = []  # [(label, fn)]
+        self._abort_hooks: list = []
+        self._last: dict = {}  # lane -> [value, t_change, advanced_ever]
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self.fired = False
+        self.error = None
+        self.last_dump: "str | None" = None
+        self.dropped = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.hang_s > 0
+
+    def watch(self, label: str, fn) -> "Watchdog":
+        with self._lock:
+            self._watch.append((label, fn))
+        return self
+
+    def watch_consumer(self, label: str = "consumer") -> ConsumerLane:
+        """Register (or REPLACE — one lane per label, so a reader's second
+        scan doesn't leave a stale always-advancing lane that would defeat
+        the all-frozen condition) a :class:`ConsumerLane` gate."""
+        lane = ConsumerLane()
+        with self._lock:
+            self._watch = [(l, f) for l, f in self._watch if l != label]
+            self._watch.append((label, lane.value))
+            self._last.pop(label, None)
+        return lane
+
+    def add_abort_hook(self, fn) -> "Watchdog":
+        """Register ``fn(exc)`` to run when the raise policy fires (e.g.
+        ``budget.abort`` — the hook that turns a wedge into an error)."""
+        with self._lock:
+            self._abort_hooks.append(fn)
+        return self
+
+    def remove_abort_hook(self, fn) -> None:
+        """Deregister a hook (idempotent).  A reader-lifetime watchdog sees
+        one budget per scan: each feed must remove its hook on teardown or
+        dead budgets accumulate for the reader's whole life."""
+        with self._lock:
+            try:
+                self._abort_hooks.remove(fn)
+            except ValueError:
+                pass
+
+    def check(self) -> None:
+        """Submitter-side hook: raise the pending HangError, if any."""
+        if self.error is not None:
+            raise self.error
+
+    def start(self) -> "Watchdog":
+        if not self.enabled or not self._watch or self._thread is not None:
+            return self
+        self._stop.clear()
+        self._note(self._read(), time.monotonic())
+        self._thread = threading.Thread(target=self._run, name=self.name,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Idempotent; joins the watchdog thread (no leak, tier-1 guarded)."""
+        t = self._thread
+        if t is None:
+            return
+        self._stop.set()
+        t.join(timeout=10.0)
+        self._thread = None
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- internals ------------------------------------------------------------
+
+    def _read(self) -> dict:
+        with self._lock:
+            watch = list(self._watch)
+        out = {}
+        for label, fn in watch:
+            try:
+                v = fn()
+            except Exception:  # noqa: BLE001 — a heartbeat never kills the run
+                self.dropped += 1
+                continue
+            if isinstance(v, dict):
+                for k, x in v.items():
+                    if isinstance(x, (int, float)) and not isinstance(x, bool):
+                        out[f"{label}.{k}"] = x
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[label] = v
+        return out
+
+    def _note(self, vals: dict, now: float) -> float:
+        """Fold one reading in; returns the newest per-lane change time.
+        Locked: ``watch_consumer`` may drop a lane from another thread."""
+        with self._lock:
+            for lane, v in vals.items():
+                rec = self._last.get(lane)
+                if rec is None:
+                    self._last[lane] = [v, now, False]
+                elif v != rec[0]:
+                    rec[0], rec[1], rec[2] = v, now, True
+            return max((rec[1] for rec in self._last.values()), default=now)
+
+    def _run(self) -> None:
+        interval = min(max(self.hang_s / 4.0, 0.02), 1.0)
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            newest = self._note(self._read(), now)
+            if not self._last or now - newest <= self.hang_s:
+                continue
+            self._fire(now)
+            if self.policy == "raise":
+                return  # fired for good: the error is armed, nothing to re-watch
+            with self._lock:
+                for rec in self._last.values():  # log policy: re-arm
+                    rec[1] = now
+
+    def _fire(self, now: float) -> None:
+        import logging
+
+        self.fired = True
+        with self._lock:
+            ages = {lane: round(now - rec[1], 3)
+                    for lane, rec in self._last.items()}
+            moved = [l for l, rec in self._last.items() if rec[2]]
+        pool = moved or list(ages)
+        stalled_first = max(pool, key=lambda l: (ages[l], l)) if pool else None
+        report = {
+            "hang_s": self.hang_s,
+            "policy": self.policy,
+            "ages": ages,
+            "stalled_first": stalled_first,
+        }
+        rec = self.recorder if self.recorder is not None else flight_recorder()
+        try:
+            self.last_dump = rec.dump(self.dump_path, reason="hang",
+                                      watchdog=report)
+        except Exception:  # noqa: BLE001 — an unwritable dump must not mask the hang
+            self.last_dump = None
+        msg = (f"watchdog: no watched lane advanced for {self.hang_s:g}s "
+               f"(first stalled: {stalled_first}); "
+               f"flight dump: {self.last_dump or '<unwritable>'}")
+        logging.getLogger(__name__).warning(msg)
+        if self.policy == "raise":
+            from .errors import HangError
+
+            err = HangError(msg, dump_path=self.last_dump)
+            self.error = err
+            with self._lock:
+                hooks = list(self._abort_hooks)
+            for h in hooks:
+                try:
+                    h(err)
+                except Exception:  # noqa: BLE001
+                    pass
 
 
 # ---------------------------------------------------------------------------
@@ -959,3 +1542,240 @@ def doctor_registry(tree: dict) -> "dict | None":
 
             out["recalibrate_link_mbps"] = recalibrate_link_mbps(link_bps)
     return out
+
+
+# ---------------------------------------------------------------------------
+# autopsy: rule-based hang/crash attribution (the pq_tool autopsy backend)
+# ---------------------------------------------------------------------------
+
+# thread-blockage classes autopsy can assign, most diagnostic first; the
+# rule table below walks each dumped stack innermost-out and returns the
+# first matching class (obs/threading frames are skipped, not classified —
+# a signal handler's own frames sit on top of the interrupted wait)
+AUTOPSY_CLASSES = ("budget-wait", "queue-get", "future-wait", "device-sync",
+                   "worker-idle", "lock-wait", "obs", "running")
+
+
+def _classify_frames(frames) -> str:
+    """One thread's blockage class from its dumped stack (outermost-first
+    frame dicts, as FlightRecorder stores them)."""
+    waitish = None
+    for f in reversed(frames or []):  # innermost first
+        path = str(f.get("file", "")).replace("\\", "/")
+        func = str(f.get("func", ""))
+        if path.endswith("tpu_parquet/alloc.py") and func in (
+                "acquire", "try_acquire"):
+            return "budget-wait"
+        if path.endswith("/queue.py") and func in ("get", "put"):
+            return "queue-get"
+        if "concurrent/futures" in path and func in ("result", "wait"):
+            return "future-wait"
+        if "concurrent/futures" in path and func == "_worker":
+            # a pool worker idle on its (C-level, frame-less) work queue:
+            # the producer side waiting for work, NOT a starved consumer —
+            # it must never feed the dead-worker verdict
+            return "worker-idle"
+        if "/jax/" in path or func == "block_until_ready":
+            return "device-sync"
+        if path.endswith("/threading.py") or path.endswith(
+                "tpu_parquet/obs.py"):
+            # a bare lock/Event wait, or the recorder's own dump frames on
+            # top of the interrupted stack: keep scanning outward for the
+            # frame that says WHOSE wait this is
+            if func in ("wait", "_wait_for_tstate_lock", "join"):
+                waitish = waitish or "lock-wait"
+            continue
+    return waitish or "running"
+
+
+def autopsy_dump(doc: dict) -> dict:
+    """Attribute a flight-recorder dump: which lane stopped advancing
+    first, which threads are blocked on what, the longest budget-wait age,
+    and a one-line probable cause (rule-based, golden-tested).
+
+    Raises ``ValueError`` for anything that is not a readable
+    ``FLIGHT_VERSION`` dump — autopsy must refuse documents it would
+    misread, the same contract as the registry/ledger versions.
+    """
+    if not isinstance(doc, dict) or "flight_version" not in doc:
+        raise ValueError("not a flight-recorder dump (no flight_version)")
+    if doc.get("flight_version") != FLIGHT_VERSION:
+        raise ValueError(
+            f"flight_version {doc.get('flight_version')!r} != "
+            f"{FLIGHT_VERSION}")
+    wd = doc.get("watchdog") or {}
+    threads_out: dict = {}
+    classes: dict[str, int] = {}
+    for tid, t in (doc.get("threads") or {}).items():
+        if not isinstance(t, dict):
+            continue
+        name = str(t.get("name", "?"))
+        if name.startswith(("tpq-watchdog", "tpq-sampler")):
+            cls = "obs"
+        else:
+            cls = _classify_frames(t.get("stack"))
+        last = t.get("last_event") or None
+        threads_out[tid] = {
+            "name": name,
+            "alive": bool(t.get("alive", True)),
+            "class": cls,
+            "last_event": ({"name": last.get("name"),
+                            "age_s": last.get("age_s")}
+                           if isinstance(last, dict) else None),
+        }
+        classes[cls] = classes.get(cls, 0) + 1
+    budgets = [b for b in (doc.get("budgets") or []) if isinstance(b, dict)]
+    waiters = sum(int(b.get("waiters") or 0) for b in budgets)
+    longest = max((float(b.get("longest_wait_s") or 0.0) for b in budgets),
+                  default=0.0)
+    dead = [t["name"] for t in threads_out.values() if not t["alive"]]
+    stalled_first = wd.get("stalled_first")
+    # the rule table, most specific first
+    if classes.get("budget-wait") or waiters:
+        verdict = "budget-wait"
+        cause = (f"submitter starved on InFlightBudget "
+                 f"({max(waiters, classes.get('budget-wait', 0))} waiter(s), "
+                 f"longest wait {longest:.1f}s): nothing downstream releases "
+                 f"bytes — raise max_memory, shrink prefetch, or unblock the "
+                 f"consumer")
+    elif classes.get("device-sync"):
+        verdict = "device-sync"
+        cause = ("a thread is blocked inside the device runtime "
+                 "(stage/dispatch never returned) — a device hang, not a "
+                 "host-side bug")
+    elif classes.get("queue-get") or classes.get("future-wait"):
+        verdict = "dead-worker"
+        cause = ("consumers are waiting on work that is not being produced"
+                 + (f" (dead thread(s): {', '.join(sorted(dead))})"
+                    if dead else "")
+                 + " — a worker died or its input stream stopped")
+    elif stalled_first:
+        verdict = f"stalled-{stalled_first.split('.', 1)[0]}"
+        cause = (f"lane {stalled_first!r} stopped advancing first with no "
+                 f"classified blocked thread — likely stuck in user code or "
+                 f"a long single unit of work")
+    else:
+        verdict = "inconclusive"
+        cause = ("no blocked thread classified and no stalled lane recorded"
+                 " — re-dump while the process is actually wedged")
+    return {
+        "reason": doc.get("reason"),
+        "pid": doc.get("pid"),
+        "stalled_first": stalled_first,
+        "ages": wd.get("ages") or {},
+        "hang_s": wd.get("hang_s"),
+        "threads": threads_out,
+        "budget": {"waiters": waiters,
+                   "longest_wait_s": round(longest, 3)} if budgets else None,
+        "error": doc.get("error"),
+        "verdict": verdict,
+        "probable_cause": cause,
+    }
+
+
+# ---------------------------------------------------------------------------
+# dump triggers: worker crash, unhandled exception, signal
+# ---------------------------------------------------------------------------
+
+_crash_dump_done = False
+
+
+def note_worker_crash(exc: BaseException) -> None:
+    """Called by pipeline/loader worker wrappers when ``fn`` raises: the
+    crash lands in the ring unconditionally (``worker_crash`` instant);
+    with ``TPQ_FLIGHT`` set, the FIRST crash also writes a dump — the
+    artifact for a worker death the consumer may never fully report."""
+    global _crash_dump_done
+    rec = flight_recorder()
+    rec.record("i", "worker_crash", time.perf_counter(), 0.0,
+               {"type": type(exc).__name__, "msg": str(exc)[:200]})
+    if os.environ.get("TPQ_FLIGHT") and not _crash_dump_done:
+        _crash_dump_done = True
+        try:
+            rec.dump(reason="worker-crash", error=exc)
+        except Exception:  # noqa: BLE001 — diagnostics never mask the crash
+            pass
+
+
+_hooks_installed = False
+_installed_excepthook = None
+_installed_prev_hook = None
+
+
+def install_flight_hooks(force: bool = False) -> dict:
+    """Install the opt-in dump triggers (idempotent; returns what took):
+
+    - ``TPQ_DUMP_SIGNAL=<USR1|SIGUSR1|10|...>``: a signal handler that
+      writes a flight dump on receipt (``faulthandler`` style — send the
+      signal to a hung process, collect the dump, run ``pq_tool autopsy``).
+      Main-thread only; silently skipped elsewhere.
+    - ``TPQ_FLIGHT=<path>``: a ``sys.excepthook`` wrapper that writes a
+      dump before the interpreter dies of an unhandled exception (the
+      exit-on-error artifact), chaining to the previous hook.
+
+    Runs once at import; ``force=True`` re-reads the env (tests)."""
+    global _hooks_installed
+    out = {"signal": False, "excepthook": False}
+    if _hooks_installed and not force:
+        return out
+    _hooks_installed = True
+    sig = os.environ.get("TPQ_DUMP_SIGNAL", "")
+    if sig:
+        try:
+            import signal as _signal
+
+            if sig.isdigit():
+                signum = _signal.Signals(int(sig))
+            else:
+                signum = getattr(
+                    _signal, sig if sig.startswith("SIG") else f"SIG{sig}")
+
+            def _dump_async():
+                try:
+                    flight_recorder().dump(reason="signal")
+                except Exception:  # noqa: BLE001 — never crash the helper
+                    pass
+
+            def _on_dump_signal(signum, frame):  # noqa: ARG001
+                # Python signal handlers run on the MAIN thread between its
+                # bytecodes: the interrupted code may hold one of the locks
+                # the snapshot needs (recorder ring, PipelineStats, budget
+                # cv), and a same-thread re-acquire would deadlock the very
+                # process this handler is meant to diagnose.  A helper
+                # thread WAITS on those locks instead (they are all short,
+                # never-held-while-blocking critical sections).
+                try:
+                    threading.Thread(target=_dump_async,
+                                     name="tpq-flight-dump",
+                                     daemon=True).start()
+                except Exception:  # noqa: BLE001 — never crash the handler
+                    pass
+
+            _signal.signal(signum, _on_dump_signal)
+            out["signal"] = True
+        except (AttributeError, ValueError, OSError, TypeError):
+            pass  # unknown name, non-main thread, unsupported platform
+    if os.environ.get("TPQ_FLIGHT"):
+        global _installed_excepthook, _installed_prev_hook
+        prev_hook = sys.excepthook
+        if prev_hook is _installed_excepthook and prev_hook is not None:
+            # re-install (force=True): chain to the ORIGINAL hook, never to
+            # our own previous wrapper — stacking would dump N times and
+            # pin every prior wrapper alive
+            prev_hook = _installed_prev_hook
+
+        def _flight_excepthook(tp, val, tb):
+            try:
+                flight_recorder().dump(reason="crash", error=val)
+            except Exception:  # noqa: BLE001
+                pass
+            prev_hook(tp, val, tb)
+
+        _installed_excepthook = _flight_excepthook
+        _installed_prev_hook = prev_hook
+        sys.excepthook = _flight_excepthook
+        out["excepthook"] = True
+    return out
+
+
+install_flight_hooks()

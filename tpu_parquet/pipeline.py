@@ -49,7 +49,8 @@ from contextlib import contextmanager
 from typing import Callable, Iterable, Iterator, Optional, TypeVar
 
 from .alloc import InFlightBudget
-from .obs import LatencyHistogram, current_tracer
+from .obs import (LatencyHistogram, current_tracer, note_worker_crash,
+                  register_flight_source)
 
 T = TypeVar("T")
 R = TypeVar("R")
@@ -109,6 +110,9 @@ class PipelineStats:
         self._obs_id = next(_pstats_ids)
         self._lock = threading.Lock()
         self._t0: Optional[float] = None
+        # a flight dump must show every live pipeline's lane seconds and
+        # queue depth at the moment of the wedge (weakly held — see obs)
+        register_flight_source(f"pipeline[{self._obs_id}]", self, "sample")
 
     # -- accumulation ---------------------------------------------------------
 
@@ -130,14 +134,14 @@ class PipelineStats:
             t1 = time.perf_counter()
             self.add(stage, t1 - t0)
             tr = self.tracer
-            if tr is not None and tr.enabled:
+            if tr is not None and tr.active:
                 tr.complete(stage, t0, t1, **span_args)
 
     def add_stall(self, seconds: float, t0: Optional[float] = None) -> None:
         with self._lock:
             self.stall_seconds += seconds
         tr = self.tracer
-        if tr is not None and tr.enabled and t0 is not None:
+        if tr is not None and tr.active and t0 is not None:
             tr.complete("stall", t0, t0 + seconds)
 
     def count_chunk(self) -> None:
@@ -157,7 +161,7 @@ class PipelineStats:
             self.wall_seconds = now - self._t0
             wall = self.wall_seconds
         tr = self.tracer
-        if tr is not None and tr.enabled and wall:
+        if tr is not None and tr.active and wall:
             # the pipeline's own wall clock rides the trace as a counter so
             # pq_tool trace reports the SAME overlap efficiency as this
             # object (span extents alone include consumer tails the wall
@@ -350,6 +354,18 @@ def prefetch_map(
         for item in items:
             yield fn(item)
         return
+
+    def run(item):
+        # the worker half of the flight recorder's crash trigger: a dying
+        # worker notes itself in the ring (and dumps under TPQ_FLIGHT)
+        # BEFORE the future carries the exception back — the consumer may
+        # be blocked elsewhere and never surface it promptly
+        try:
+            return fn(item)
+        except BaseException as e:
+            note_worker_crash(e)
+            raise
+
     it = iter(items)
     pending: deque = deque()  # (future, charged_cost)
     carried: Optional[tuple] = None  # (item, cost) awaiting budget headroom
@@ -384,7 +400,7 @@ def prefetch_map(
                     if stats is not None:
                         stats.note_peak(budget)
                 carried = None
-                pending.append((ex.submit(fn, item), c))
+                pending.append((ex.submit(run, item), c))
                 if stats is not None:
                     stats.set_queue_depth(len(pending))
             if not pending:
