@@ -1,0 +1,527 @@
+"""Fault-tolerant IO backend tests (ISSUE 7): the fault matrix, the
+coalescing planner, the degradation ladder, truncation surfacing, and the
+TPQ_* env-parsing hardening.
+
+The acceptance contract: every injected transient fault recovers to
+bit-identical output; exhausted retries raise RetryExhaustedError with an
+attempt log; an injected stall fires the watchdog and ``pq_tool autopsy``
+classifies the dump as network-stall naming the offending range.
+"""
+
+import io
+import json
+import logging
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_parquet.errors import (HangError, ParquetError, RetryExhaustedError,
+                                TransientIOError)
+from tpu_parquet.iostore import (CoalescedFetcher, FaultInjectingStore,
+                                 FaultSpec, GenericRangeStore, IOConfig,
+                                 LocalStore, plan_coalesced, require_full,
+                                 resolve_store)
+from tpu_parquet.reader import FileReader
+from tpu_parquet.writer import FileWriter
+
+
+def _write_file(path, groups=3, rows=400, seed=0):
+    from tpu_parquet.format import (CompressionCodec,
+                                    FieldRepetitionType as FRT, Type)
+    from tpu_parquet.schema.core import build_schema, data_column
+
+    schema = build_schema([data_column("a", Type.INT64, FRT.REQUIRED),
+                           data_column("b", Type.INT64, FRT.REQUIRED)])
+    rng = np.random.default_rng(seed)
+    with FileWriter(path, schema, codec=CompressionCodec.SNAPPY) as w:
+        for _ in range(groups):
+            w.write_columns({"a": rng.integers(0, 1 << 30, rows),
+                             "b": rng.integers(0, 1 << 30, rows)})
+            w.flush_row_group()
+    return path
+
+
+@pytest.fixture(scope="module")
+def pq_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("iostore") / "faulty.parquet")
+    _write_file(path)
+    with FileReader(path) as r:
+        base = r.read_pylist()
+    return path, base
+
+
+def _cfg(**kw):
+    kw.setdefault("retries", 4)
+    kw.setdefault("backoff_ms", 1.0)
+    return IOConfig(**kw)
+
+
+def _fault_factory(spec, config=None, stores=None, seed=0):
+    def make(f):
+        st = FaultInjectingStore(LocalStore(f), spec,
+                                 config=config or _cfg(), seed=seed)
+        if stores is not None:
+            stores.append(st)
+        return st
+
+    return make
+
+
+def _obs_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith(("tpq-sampler", "tpq-watchdog"))]
+
+
+# ---------------------------------------------------------------------------
+# the fault matrix: recoverable faults are invisible in the output
+# ---------------------------------------------------------------------------
+
+RECOVERABLE = {
+    "latency_spike": FaultSpec(latency_s=0.005),
+    "transient_errors": FaultSpec(fail_first=2),
+    "torn_read": FaultSpec(torn_first=1),
+    "torn_then_error": FaultSpec(torn_first=1, fail_first=2),
+}
+
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+@pytest.mark.parametrize("fault", sorted(RECOVERABLE))
+def test_fault_matrix_recovers_bit_identical(pq_file, fault, prefetch):
+    path, base = pq_file
+    stores = []
+    with FileReader(path, prefetch=prefetch,
+                    store=_fault_factory(RECOVERABLE[fault],
+                                         stores=stores)) as r:
+        assert r.read_pylist() == base
+        tree = r.obs_registry().as_dict()
+    d = tree["io"]
+    assert d["exhausted"] == 0
+    if "transient" in fault or "error" in fault:
+        assert d["retries"] > 0 and d["transient_errors"] > 0
+    if fault.startswith("torn"):
+        assert d["short_reads"] > 0
+
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+def test_retries_exhausted_raises_with_attempt_log(pq_file, prefetch):
+    path, _base = pq_file
+    with pytest.raises(RetryExhaustedError) as ei:
+        with FileReader(path, prefetch=prefetch,
+                        store=_fault_factory(
+                            FaultSpec(fail_first=99),
+                            config=_cfg(retries=2))) as r:
+            r.read_all()
+    e = ei.value
+    assert len(e.attempts) == 3  # first try + 2 retries
+    assert e.offset is not None and e.size
+    assert all("injected transient" in a["error"] for a in e.attempts)
+
+
+def test_per_scan_retry_budget_exhausts(pq_file):
+    path, _base = pq_file
+    # every chunk fails twice; a 1-retry scan budget dies long before the
+    # per-request retry limit would
+    with pytest.raises(RetryExhaustedError, match="retry budget"):
+        with FileReader(path, prefetch=0,
+                        store=_fault_factory(
+                            FaultSpec(fail_first=2),
+                            config=_cfg(retries=4, retry_budget=1))) as r:
+            r.read_all()
+
+
+def test_retry_budget_resets_per_scan(pq_file):
+    path, base = pq_file
+    stores = []
+    # 6 chunk reads x 1 transient each = 6 retries per scan: a 8-retry
+    # budget survives any single scan but would die on the second scan if
+    # the budget leaked across begin_scan()
+    fac = _fault_factory(FaultSpec(fail_first=1),
+                         config=_cfg(retries=2, retry_budget=8),
+                         stores=stores)
+    with FileReader(path, prefetch=4, store=fac) as r:
+        assert r.read_pylist() == base
+        stores[0].spec = FaultSpec(fail_first=2)  # fresh faults, scan 2
+        stores[0]._attempts.clear()
+        assert r.read_pylist() == base
+
+
+def test_deadline_bounds_a_slow_store(pq_file):
+    path, _base = pq_file
+    with pytest.raises(RetryExhaustedError):
+        with FileReader(path, prefetch=0,
+                        store=_fault_factory(
+                            FaultSpec(latency_s=0.2),
+                            config=_cfg(retries=3,
+                                        deadline_s=0.05))) as r:
+            r.read_all()
+
+
+def test_deadline_env_knob(pq_file, monkeypatch):
+    monkeypatch.setenv("TPQ_IO_DEADLINE_S", "0.04")
+    path, _base = pq_file
+    stores = []
+    with pytest.raises(RetryExhaustedError):
+        with FileReader(path, prefetch=0,
+                        store=_fault_factory(FaultSpec(latency_s=0.2),
+                                             config=IOConfig.from_env(),
+                                             stores=stores)) as r:
+            r.read_all()
+    assert stores[0].stats.deadline_hits > 0
+
+
+# ---------------------------------------------------------------------------
+# stall -> watchdog -> HangError -> autopsy network-stall naming the range
+# ---------------------------------------------------------------------------
+
+def test_stall_fires_watchdog_and_autopsy_names_range(tmp_path, monkeypatch):
+    from tpu_parquet.device_reader import DeviceFileReader
+    from tpu_parquet.obs import autopsy_dump
+
+    monkeypatch.setenv("TPQ_FLIGHT", str(tmp_path / "stall_dump.json"))
+    path = _write_file(str(tmp_path / "stall.parquet"))
+    stores = []
+    dr = DeviceFileReader(
+        path, prefetch=2, max_memory=1 << 20, hang_s=0.3,
+        store=_fault_factory(FaultSpec(stall_first=1, stall_s=60.0),
+                             config=_cfg(retries=0), stores=stores))
+    try:
+        with pytest.raises(HangError) as ei:
+            for _ in dr.iter_row_groups():
+                pass
+    finally:
+        for s in stores:
+            s.release()
+        dr.close()
+    assert not _obs_threads()
+    e = ei.value
+    assert e.dump_path and os.path.exists(e.dump_path)
+    with open(e.dump_path) as f:
+        rep = autopsy_dump(json.load(f))
+    assert rep["verdict"] == "network-stall"
+    assert rep["io"] is not None
+    assert rep["io"]["size"] > 0 and rep["io"]["age_s"] > 0
+    assert str(rep["io"]["offset"]) in rep["probable_cause"]
+
+
+def test_stall_sequential_path_also_raises_hang(tmp_path, monkeypatch):
+    """prefetch=0: the CONSUMER thread itself is pinned inside the stalled
+    fetch — the watchdog's store abort must wake it there too."""
+    from tpu_parquet.device_reader import DeviceFileReader
+
+    monkeypatch.setenv("TPQ_FLIGHT", str(tmp_path / "stall0_dump.json"))
+    path = _write_file(str(tmp_path / "stall0.parquet"))
+    stores = []
+    dr = DeviceFileReader(
+        path, prefetch=0, hang_s=0.3,
+        store=_fault_factory(FaultSpec(stall_first=1, stall_s=60.0),
+                             config=_cfg(retries=0), stores=stores))
+    try:
+        with pytest.raises(HangError):
+            for _ in dr.iter_row_groups():
+                pass
+    finally:
+        for s in stores:
+            s.release()
+        dr.close()
+    assert not _obs_threads()
+
+
+def test_scan_files_through_fault_store(pq_file, tmp_path):
+    from tpu_parquet.device_reader import scan_files
+
+    path, base = pq_file
+    path2 = _write_file(str(tmp_path / "second.parquet"), seed=7)
+    rows = {"a": [], "b": []}
+    for cols in scan_files([path, path2], prefetch=2,
+                           store=_fault_factory(FaultSpec(fail_first=1))):
+        for k, v in cols.items():
+            rows[k].extend(np.asarray(v.to_host()).tolist())
+    with FileReader(path2) as r:
+        base2 = r.read_pylist()
+    assert rows["a"] == base["a"] + base2["a"]
+    assert not _obs_threads()
+
+
+# ---------------------------------------------------------------------------
+# coalescing: planner + ladder
+# ---------------------------------------------------------------------------
+
+def test_plan_coalesced_merges_within_gap():
+    plan = plan_coalesced([(0, 100), (110, 50), (1000, 20)], gap=16)
+    assert [(g.offset, g.size) for g in plan] == [(0, 160), (1000, 20)]
+    assert plan[0].members == {(0, 100): 1, (110, 50): 1}
+
+
+def test_plan_coalesced_respects_cap_and_determinism():
+    ranges = [(i * 120, 100) for i in range(8)]
+    plan = plan_coalesced(ranges, gap=64, max_span=300)
+    assert all(g.size <= 300 for g in plan)
+    again = plan_coalesced(list(reversed(ranges)), gap=64, max_span=300)
+    assert [g.key() for g in plan] == [g.key() for g in again]
+    # full coverage, no member lost to the splits
+    members = [m for g in plan for m in g.members]
+    assert sorted(members) == sorted(ranges)
+
+
+def test_coalesced_reads_used_on_fault_store(pq_file):
+    path, base = pq_file
+    stores = []
+    with FileReader(path, prefetch=4,
+                    store=_fault_factory(FaultSpec(), stores=stores)) as r:
+        assert r.read_pylist() == base
+    d = stores[0].stats.as_dict()
+    assert d["coalesced_spans"] > 0
+    # fewer store round trips than chunks: that is the point
+    assert d["reads"] <= d["coalesced_spans"] + 1
+
+
+def test_coalesced_failure_degrades_to_single_ranges(pq_file):
+    path, base = pq_file
+    stores = []
+
+    def only_big(offset, size):
+        return size > 6000  # spans only: members stay healthy
+
+    with FileReader(path, prefetch=4,
+                    store=_fault_factory(
+                        FaultSpec(fail_first=99, match=only_big),
+                        config=_cfg(retries=1), stores=stores)) as r:
+        assert r.read_pylist() == base  # ladder: span fails, singles serve
+    d = stores[0].stats.as_dict()
+    assert d["coalesce_fallbacks"] > 0
+    assert stores[0].coalesce_disabled  # 2+ span failures: stop trying
+
+
+def test_lying_span_size_degrades_not_corrupts():
+    data = bytes(range(256)) * 8
+
+    class Lying(GenericRangeStore):
+        def size(self):
+            return len(data)
+
+        def _fetch_once(self, offset, size, timeout):
+            buf = data[offset: offset + size]
+            return buf[:-5] if size > 120 else buf
+
+    st = Lying(config=_cfg(retries=1, coalesce_gap=64))
+    fetcher = CoalescedFetcher(st, [(0, 100), (100, 100)])
+    assert fetcher.groups == 1
+    assert fetcher.read(0, 100) == data[:100]
+    assert fetcher.read(100, 100) == data[100:200]
+    assert st.stats.coalesce_fallbacks == 1
+
+
+def test_eof_padded_full_length_lie_is_rejected():
+    """A store that pads its EOF reads to full length fabricates bytes —
+    read_range must reject the provably-past-EOF response, so the ladder
+    serves the members from honest single reads (fuzz finding)."""
+    data = bytes(range(200)) * 2  # 400-byte object
+
+    class Padding(GenericRangeStore):
+        def size(self):
+            return len(data)
+
+        def _fetch_once(self, offset, size, timeout):
+            buf = data[offset: offset + size]
+            if len(buf) < size and size > 120:
+                return buf + b"\x00" * (size - len(buf))  # padded EOF span
+            return buf
+
+    st = Padding(config=_cfg(retries=1, coalesce_gap=64))
+    # two members whose coalesced span ends 50 bytes past EOF
+    fetcher = CoalescedFetcher(st, [(250, 100), (350, 100)])
+    assert fetcher.read(250, 100) == data[250:350]
+    assert fetcher.read(350, 100) == data[350:]  # honest short EOF read
+    assert st.stats.coalesce_fallbacks == 1
+    # a direct full-length-past-EOF response exhausts as a lie, never serves
+    with pytest.raises(RetryExhaustedError, match="past EOF"):
+        st.read_range(300, 150)
+
+
+def test_local_store_never_coalesces(pq_file):
+    path, base = pq_file
+    with FileReader(path, prefetch=4) as r:
+        assert r.read_pylist() == base
+        assert r._store.stats is None
+        assert not r._store.prefers_coalescing
+        assert r.obs_registry().as_dict()["io"] is None
+
+
+# ---------------------------------------------------------------------------
+# truncation: a short file is named as such (satellite 1)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+def test_truncated_file_names_offset_got_want(pq_file, prefetch):
+    path, _base = pq_file
+    with open(path, "rb") as f:
+        whole = f.read()
+    from tpu_parquet.footer import read_file_metadata
+
+    md = read_file_metadata(io.BytesIO(whole))
+    with pytest.raises(ParquetError, match=r"truncated file.*wanted \d+ "
+                       r"bytes at offset \d+, got \d+"):
+        with FileReader(io.BytesIO(whole[:200]), metadata=md,
+                        prefetch=prefetch) as r:
+            r.read_all()
+
+
+def test_truncated_sequential_read_chunk_names_offset(pq_file):
+    """The prefetch=0 read_row_group path (chunk_decode.read_chunk) names
+    the truncation too — not just the pipeline's decode_item."""
+    from tpu_parquet.footer import read_file_metadata
+
+    path, _base = pq_file
+    with open(path, "rb") as f:
+        whole = f.read()
+    md = read_file_metadata(io.BytesIO(whole))
+    with pytest.raises(ParquetError, match="truncated file reading column"):
+        with FileReader(io.BytesIO(whole[:150]), metadata=md) as r:
+            r.read_row_group(0)
+
+
+def test_require_full_passthrough():
+    assert require_full(b"abcd", 0, 4) == b"abcd"
+    with pytest.raises(ParquetError, match="column x.y"):
+        require_full(b"ab", 10, 4, context="column x.y")
+
+
+# ---------------------------------------------------------------------------
+# env hardening: malformed numeric knobs degrade with one warning
+# ---------------------------------------------------------------------------
+
+NUMERIC_KNOBS = [
+    # (env name, resolver, expected default)
+    ("TPQ_SAMPLE_MS",
+     lambda: __import__("tpu_parquet.obs", fromlist=["resolve_sample_ms"])
+     .resolve_sample_ms(), 0.0),
+    ("TPQ_HANG_S",
+     lambda: __import__("tpu_parquet.obs", fromlist=["resolve_hang_s"])
+     .resolve_hang_s(), 0.0),
+    ("TPQ_RING_EVENTS",
+     lambda: __import__("tpu_parquet.obs", fromlist=["FlightRecorder"])
+     .FlightRecorder().capacity, 256),
+    ("TPQ_LINK_MBPS",
+     lambda: __import__("tpu_parquet.ship", fromlist=["ShipPlanner"])
+     .ShipPlanner().link_mbps, 350.0),
+    ("TPQ_IO_DEADLINE_S", lambda: IOConfig.from_env().deadline_s, 0.0),
+    ("TPQ_IO_RETRIES", lambda: IOConfig.from_env().retries, 4),
+    ("TPQ_IO_BACKOFF_MS", lambda: IOConfig.from_env().backoff_ms, 25.0),
+    ("TPQ_IO_RETRY_BUDGET", lambda: IOConfig.from_env().retry_budget, 64),
+    ("TPQ_IO_COALESCE_GAP", lambda: IOConfig.from_env().coalesce_gap,
+     1 << 16),
+]
+
+
+@pytest.mark.parametrize("name,resolve,default",
+                         NUMERIC_KNOBS, ids=[k[0] for k in NUMERIC_KNOBS])
+def test_malformed_env_degrades_with_warning(name, resolve, default,
+                                             monkeypatch, caplog):
+    bad = f"abc-{name}"  # unique per knob: the once-per-value warning fires
+    monkeypatch.setenv(name, bad)
+    with caplog.at_level(logging.WARNING, logger="tpu_parquet.obs"):
+        assert resolve() == default  # degraded, not raised
+    assert any(bad in rec.message for rec in caplog.records)
+
+
+@pytest.mark.parametrize("name,resolve,default",
+                         NUMERIC_KNOBS, ids=[k[0] for k in NUMERIC_KNOBS])
+def test_valid_env_still_parses(name, resolve, default, monkeypatch):
+    monkeypatch.setenv(name, "7")
+    v = resolve()
+    assert v == pytest.approx(7)
+
+
+def test_negative_numeric_env_clamps(monkeypatch):
+    monkeypatch.setenv("TPQ_IO_RETRIES", "-3")
+    assert IOConfig.from_env().retries == 0
+
+
+# ---------------------------------------------------------------------------
+# store plumbing details
+# ---------------------------------------------------------------------------
+
+def test_local_store_bytesio_and_size():
+    st = LocalStore(io.BytesIO(b"0123456789"))
+    assert not st.parallel  # no usable fd: the locked seek+read path
+    assert st.size() == 10
+    assert st.read_range(2, 4) == b"2345"
+    assert st.read_range(8, 10) == b"89"  # short at EOF, no raise
+
+
+def test_resolve_store_forms(pq_file):
+    path, _base = pq_file
+    f = open(path, "rb")
+    try:
+        assert isinstance(resolve_store(f, None), LocalStore)
+        st = FaultInjectingStore(LocalStore(f))
+        assert resolve_store(f, st) is st
+        assert isinstance(resolve_store(f, lambda g: LocalStore(g)),
+                          LocalStore)
+        with pytest.raises(TypeError):
+            resolve_store(f, lambda g: object())
+        with pytest.raises(TypeError):
+            resolve_store(f, 42)
+    finally:
+        f.close()
+
+
+def test_torn_reread_verification_mismatch_costs_a_retry():
+    """A full re-read that DISAGREES with the torn attempt's prefix is
+    rejected as a transient fault (data instability) and retried; a
+    subsequent consistent read is accepted — CRC at the decode layer stays
+    the terminal integrity check."""
+    flips = {"n": 0}
+
+    class Unstable(GenericRangeStore):
+        def size(self):
+            return 1 << 20
+
+        def _fetch_once(self, offset, size, timeout):
+            flips["n"] += 1
+            if flips["n"] == 1:
+                return b"\xAA" * (size // 2)  # torn
+            return (b"\xBB" if flips["n"] == 2 else b"\xAA") * size
+
+    st = Unstable(config=_cfg(retries=5))
+    out = st.read_range(0, 100)
+    # attempt 1 torn, attempt 2 full-but-mismatched (rejected), attempt 3
+    # matches the torn prefix and is accepted
+    assert flips["n"] == 3
+    assert out == b"\xAA" * 100
+    assert st.stats.short_reads == 1
+    assert st.stats.transient_errors == 2
+
+
+def test_abort_poisons_inflight_and_future_reads():
+    boom = HangError("wedged", dump_path="/tmp/x.json")
+
+    class Slow(GenericRangeStore):
+        def size(self):
+            return 1 << 20
+
+        def _fetch_once(self, offset, size, timeout):
+            raise TransientIOError("flaky")
+
+    st = Slow(config=_cfg(retries=50, backoff_ms=5))
+    done = {}
+
+    def reader():
+        try:
+            st.read_range(0, 64)
+        except BaseException as e:  # noqa: BLE001
+            done["exc"] = e
+
+    t = threading.Thread(target=reader)
+    t.start()
+    st.abort(boom)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert done["exc"] is boom
+    with pytest.raises(HangError):
+        st.read_range(64, 64)
+    st.begin_scan()  # a new scan clears the poison
+    with pytest.raises(RetryExhaustedError):
+        st.read_range(64, 64)

@@ -285,7 +285,8 @@ def _full_registry():
 def test_registry_tree_golden_keys():
     tree = _full_registry().as_dict()
     assert set(tree) == {"obs_version", "pipeline", "reader", "loader",
-                         "alloc", "histograms"}
+                         "io", "alloc", "histograms"}
+    assert tree["io"] is None  # no IO-backend stats were folded in
     assert tree["obs_version"] == OBS_VERSION
     assert tree["alloc"] == {"peak_bytes": 4096}
     assert set(tree["histograms"]) == {"stage.io", "stage.stage"}

@@ -426,15 +426,15 @@ def read_chunk(
     alloc: Optional[AllocTracker] = None,
 ) -> ColumnData:
     """Read + decode one column chunk from an open file (readChunk parity)."""
+    from .iostore import require_full
+
     md, offset = validate_chunk_meta(chunk, leaf)
     size = md.total_compressed_size
     if alloc is not None:
         alloc.register(size)
     f.seek(offset)
     buf = f.read(size)
-    if len(buf) != size:
-        raise ParquetError(
-            f"chunk truncated: wanted {size} bytes at {offset}, got {len(buf)}"
-        )
+    require_full(buf, offset, size,
+                 context=f"column {'.'.join(leaf.path)}")
     dec = ChunkDecoder(leaf, validate_crc=validate_crc, alloc=alloc)
     return dec.decode(buf, md.codec, md.num_values)

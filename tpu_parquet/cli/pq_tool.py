@@ -411,6 +411,10 @@ def cmd_autopsy(args, out=sys.stdout) -> int:
     if b:
         out.write(f"budget: {b['waiters']} waiter(s), longest wait "
                   f"{b['longest_wait_s']:g}s\n")
+    io = rep.get("io")
+    if io:
+        out.write(f"io: range at offset {io['offset']} ({io['size']} bytes) "
+                  f"in flight for {io['age_s']:g}s\n")
     err = rep.get("error")
     if err:
         out.write(f"error: {err.get('type')}: {err.get('message')}\n")

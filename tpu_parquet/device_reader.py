@@ -44,6 +44,7 @@ from .kernels import bitpack
 from .compress import decompress_block
 from .footer import ParquetError
 from .format import Encoding, PageType, Type, parse_encoding
+from .iostore import require_full
 from .jax_decode import (
     DeviceColumnData, ParsedDataPage, _bucket, _bucket_bytes, _bucket_count,
     _SLACK, _concat_jit, _concat_ragged_jit, _dict_gather_bytes_jit,
@@ -3014,7 +3015,8 @@ class DeviceFileReader:
     def __init__(self, source, columns=None, validate_crc: bool = False,
                  profile_dir: "str | None" = None, max_memory: int = 0,
                  row_filter=None, prefetch: int = 0, trace=None,
-                 sample_ms=None, hang_s=None, hang_policy=None):
+                 sample_ms=None, hang_s=None, hang_policy=None,
+                 store=None):
         from .obs import (Sampler, Watchdog, register_flight_registry,
                           resolve_hang_s, resolve_sample_ms, resolve_tracer)
         from .pipeline import PipelineStats
@@ -3030,7 +3032,10 @@ class DeviceFileReader:
                                 validate_crc=validate_crc,
                                 max_memory=max_memory,
                                 row_filter=row_filter,
-                                trace=self._tracer)
+                                trace=self._tracer, store=store)
+        # the IO backend all chunk bytes enter through (iostore.py) —
+        # shared with the host reader so both paths see one retry budget
+        self._store = self._host._store
         # chunk-granular host prefetch depth (IO + CRC + decompress + parse
         # of upcoming chunks on a bounded pool, spanning row-group
         # boundaries); 0 = the sequential host phase
@@ -3076,6 +3081,10 @@ class DeviceFileReader:
                                      lambda: self._pipe_stats.sample())
             self._sampler.add_source("alloc_bytes", self._sample_alloc)
             self._sampler.add_source("budget_waiters", self._sample_budget)
+            if self._store.stats is not None:
+                # retry/backoff curves next to the lanes they stall
+                self._sampler.add_source("io_retries",
+                                         self._store.stats.progress)
             self._sampler.start()
         # hang watchdog (obs.Watchdog, TPQ_HANG_S / hang_s=): fires a
         # flight dump (and, policy "raise", aborts the chunk feed's budget
@@ -3087,6 +3096,16 @@ class DeviceFileReader:
             self._watchdog.watch("pipeline",
                                  lambda: self._pipe_stats.sample())
             self._watchdog.watch("reader", self._sample_progress)
+            if self._store.stats is not None:
+                # store heartbeat: the counters FREEZE while a fetch is
+                # stalled (a retrying store keeps advancing) — so a
+                # network stall fires the dog and the flight dump names
+                # the in-flight range (pq_tool autopsy: network-stall)
+                self._watchdog.watch("iostore", self._store.stats.progress)
+            # raise-policy exit from a stalled fetch: poisoning the store
+            # wakes the worker pinned inside the transport, so the HangError
+            # (not a belated transport error) reaches the consumer
+            self._watchdog.add_abort_hook(self._store.abort)
             # idle consumer gate until the first scan replaces it: both
             # counter lanes above are frozen at 0 while the reader sits
             # un-iterated, and a reader built long before its first
@@ -3129,6 +3148,8 @@ class DeviceFileReader:
         reg.add_reader(self._stats)
         reg.add_pipeline(self._pipe_stats)
         reg.note_alloc_peak(self.alloc)
+        if self._store.stats is not None:
+            reg.add_io(self._store.stats)
         return reg
 
     def __enter__(self):
@@ -3240,7 +3261,7 @@ class DeviceFileReader:
         if not fcols <= set(by_path):
             return None, 0, {}
         if f is None:  # the chunk feed passes a thread-safe pread view
-            f = self._host._f
+            f = self._host._sr.as_file()  # store-backed, like every read
         filter_pages = {}
         boundaries = {}
         # FILTER chunks' bytes, handed to the decode loop when also selected
@@ -3331,7 +3352,9 @@ class DeviceFileReader:
             self._t0 = t0
         leaves = {l.path: l for l in self.schema.selected_leaves()}
         out: dict[str, DeviceColumnData] = {}
-        f = self._host._f
+        # store-backed view: the sequential path's bytes enter through the
+        # same fault-tolerant backend as the prefetch pool's
+        f = self._host._sr.as_file()
         self.alloc.reset()
         if collected is None:
             skip_pages, rows_dropped, planned_bufs = self._plan_page_pruning(
@@ -3368,8 +3391,8 @@ class DeviceFileReader:
                 if buf is None:
                     f.seek(offset)
                     buf = f.read(md.total_compressed_size)
-                if len(buf) != md.total_compressed_size:
-                    raise ParquetError("chunk truncated")
+                require_full(buf, offset, md.total_compressed_size,
+                             context=f"column {'.'.join(path)}")
                 self._stats.chunks += 1
                 self._stats.compressed_bytes += md.total_compressed_size
                 self.alloc.register(md.total_compressed_size)
@@ -3613,6 +3636,10 @@ class DeviceFileReader:
         self._pipe_stats = PipelineStats(prefetch=self._prefetch,
                                          budget_bytes=self.alloc.max_size,
                                          tracer=self._tracer)
+        # fresh per-scan retry budget / coalescing state / abort poison on
+        # BOTH paths (the prefetch feed also calls this — idempotent at
+        # scan start; the prefetch=0 path has no other reset point)
+        self._store.begin_scan()
         indices = [i for i in range(self.num_row_groups)
                    if self._host.row_group_selected(i)]
         if not indices:
@@ -3696,7 +3723,8 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
     per-row-group counter across threads).
     """
     from .alloc import AllocTracker, InFlightBudget
-    from .pipeline import SharedReader, prefetch_map
+    from .iostore import CoalescedFetcher
+    from .pipeline import prefetch_map
 
     budget = InFlightBudget(budget_bytes)
     if watchdog is not None and watchdog.enabled:
@@ -3704,7 +3732,7 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
         # submitter blocked in acquire() with HangError (obs.Watchdog)
         watchdog.add_abort_hook(budget.abort)
     fed: set = set()  # readers whose _live_budget points at this feed
-    srs: dict[int, SharedReader] = {}
+    srs: dict = {}  # reader id -> its host's store-backed SharedReader
     pending: dict[tuple, dict] = {}
     current = {"stats": None}  # stats of the reader whose item is submitting
     depth_owner = {"stats": None}  # last stats whose queue_depth gauge we set
@@ -3750,12 +3778,17 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
             fed.add(r)
             sr = srs.get(id(r))
             if sr is None:
-                sr = srs[id(r)] = SharedReader(r._host._f)
+                # the host reader's own store-backed view — one wrapper
+                # per (file, store) pair, never a divergent copy
+                sr = srs[id(r)] = r._host._sr
+                # fresh per-scan retry budget + coalescing state
+                sr.store.begin_scan()
             rg = r.metadata.row_groups[i]
             leaves = {l.path: l for l in r.schema.selected_leaves()}
             skip_pages, rows_dropped, planned_bufs = r._plan_page_pruning(
                 rg, leaves, f=sr.as_file())
             items = []
+            ranges = []
             for chunk in rg.columns or []:
                 md = chunk.meta_data
                 if md is None or md.path_in_schema is None:
@@ -3765,16 +3798,32 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
                 if leaf is None:
                     continue  # unselected: never read its bytes
                 md, offset = validate_chunk_meta(chunk, leaf)
-                items.append((r, sr, i, p, leaf, md, offset,
+                items.append([r, sr, i, p, leaf, md, offset,
                               (skip_pages or {}).get(p),
-                              planned_bufs.get(p)))
+                              planned_bufs.get(p), None])
+                if planned_bufs.get(p) is None:
+                    # chunks the pruning planner already read never join a
+                    # coalesced span (their bytes are in hand)
+                    ranges.append((offset, md.total_compressed_size))
+            # range coalescing (iostore.py): this group's chunk reads merge
+            # into fewer, larger, individually-retryable fetches, fanned
+            # out on the prefetch pool (the first worker to touch a span
+            # fetches it) — only for stores that ask for it
+            st = sr.store
+            if (st.prefers_coalescing and not st.coalesce_disabled
+                    and len(ranges) > 1):
+                fetcher = CoalescedFetcher(st, ranges)
+                for it in items:
+                    if it[8] is None:
+                        it[9] = fetcher
             key = (id(r), i)
             pending[key] = {"r": r, "path": path, "i": i,
                             "todo": max(len(items), 1), "chunks": {},
                             "rows_dropped": rows_dropped}
             if not items:
-                items.append((r, None, i, None, None, None, None, None, None))
-            yield from items
+                items.append([r, None, i, None, None, None, None, None,
+                              None, None])
+            yield from map(tuple, items)
 
     def cost(item):
         md = item[5]
@@ -3784,7 +3833,7 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
         return comp + max(md.total_uncompressed_size or 0, comp)
 
     def collect(item):
-        r, sr, i, p, leaf, md, offset, skip, buf0 = item
+        r, sr, i, p, leaf, md, offset, skip, buf0, fetcher = item
         if md is None:
             return (id(r), i), None, None
         stats = r._pipe_stats
@@ -3794,9 +3843,11 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
             buf = buf0  # the pruning planner already paid this chunk's IO
         else:
             with stats.timed("io"):
-                buf = sr.pread(offset, md.total_compressed_size)
-        if len(buf) != md.total_compressed_size:
-            raise ParquetError("chunk truncated")
+                buf = (fetcher.read(offset, md.total_compressed_size)
+                       if fetcher is not None
+                       else sr.pread(offset, md.total_compressed_size))
+        require_full(buf, offset, md.total_compressed_size,
+                     context=f"column {'.'.join(p)}")
         with stats.timed("decompress"):
             asm = _collect_chunk(
                 buf, md.codec, md.num_values, leaf, r._deferred,
@@ -3930,7 +3981,7 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
 def scan_files(paths, columns=None, validate_crc: bool = False,
                max_memory: int = 0, row_filter=None, with_path: bool = False,
                prefetch: int = 0, trace=None, sample_ms=None, hang_s=None,
-               hang_policy=None):
+               hang_policy=None, store=None):
     """Scan several files' row groups through ONE continuous transfer pipeline.
 
     ``prefetch=K`` additionally runs chunk IO + decompression K-deep on a
@@ -3940,6 +3991,10 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
     provides for staging, extended to the host's half of the work.  The
     feed's lookahead opens upcoming files a little earlier, so the open-fd
     bound becomes O(prefetch) instead of one.
+
+    ``store=`` selects the IO backend per file (iostore.py): pass a
+    FACTORY callable (``lambda f: MyRangeStore(...)``) so each file gets
+    its own store — a single shared instance would mix files' bytes.
 
     The multi-file dataset form of ``DeviceFileReader.iter_row_groups``
     (BASELINE config 5 is a multi-file row-group scan): per-file iteration
@@ -3998,6 +4053,20 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
             "staged_bytes": sum(r._stats.staged_bytes
                                 for r in list(readers)),
         })
+
+        def _io_lanes():
+            out: dict = {}
+            for r in list(readers):
+                st = r._store.stats
+                if st is None:
+                    continue
+                for k, v in st.progress().items():
+                    out[k] = out.get(k, 0) + v
+            return out
+
+        # store heartbeat across every file's store: frozen fetch counters
+        # + frozen pipeline = a network stall the dump can name
+        watchdog.watch("iostore", _io_lanes)
         watchdog.start()
 
     def work():
@@ -4005,9 +4074,13 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
             r = DeviceFileReader(
                 path, columns=columns, validate_crc=validate_crc,
                 max_memory=max_memory, row_filter=row_filter, trace=tracer,
-                sample_ms=sample_ms, hang_s=0,
+                sample_ms=sample_ms, hang_s=0, store=store,
             )
             readers.append(r)
+            if watchdog.enabled:
+                # like the per-reader wiring: a fired watchdog must wake
+                # fetches stalled inside any file's store (no-op for local)
+                watchdog.add_abort_hook(r._store.abort)
             for i in range(r.num_row_groups):
                 if r._host.row_group_selected(i):
                     yield r, path, i

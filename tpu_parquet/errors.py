@@ -31,6 +31,40 @@ class HangError(RuntimeError):
         self.dump_path = dump_path
 
 
+class TransientIOError(IOError):
+    """A single range-read attempt failed in a way that is worth retrying.
+
+    Raised by :class:`tpu_parquet.iostore.ByteStore` implementations (and
+    the fault injector) for the failure modes real object stores exhibit —
+    connection resets, throttling, torn/short responses, per-attempt
+    deadline overruns.  ``GenericRangeStore.read_range`` catches it and
+    retries with backoff; it only escapes to callers wrapped in a
+    :class:`RetryExhaustedError`.  Rooted at ``IOError`` (NOT ParquetError):
+    the input file is fine, the transport hiccuped, and the fuzz harness's
+    crash oracle must never read a network fault as a parse failure.
+    """
+
+
+class RetryExhaustedError(IOError):
+    """A range read failed after exhausting its retries / deadline / budget.
+
+    Raised by :class:`tpu_parquet.iostore.GenericRangeStore` when a read's
+    bounded retries, its per-request deadline (``TPQ_IO_DEADLINE_S``), or
+    the per-scan retry budget (``TPQ_IO_RETRY_BUDGET``) runs out.
+    ``attempts`` carries the full attempt log (one dict per try: error,
+    elapsed, backoff) so the error itself is the diagnosis; ``offset`` /
+    ``size`` name the range that could not be read.  Rooted at ``IOError``,
+    not ParquetError — the bytes were never readable, nothing was malformed.
+    """
+
+    def __init__(self, message: str, attempts: "list | None" = None,
+                 offset: "int | None" = None, size: "int | None" = None):
+        super().__init__(message)
+        self.attempts = list(attempts or [])
+        self.offset = offset
+        self.size = size
+
+
 class CheckpointError(ParquetError):
     """Malformed, incompatible, or version-mismatched loader checkpoint state.
 

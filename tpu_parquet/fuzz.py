@@ -527,6 +527,116 @@ def fuzz_loader_state(data: bytes) -> None:
         loader.restore(pristine)
 
 
+def fuzz_io_ranges(data: bytes) -> None:
+    """Fuzz target #14: the range-coalescing planner + a store that lies.
+
+    Blob layout: byte 0 picks the gap threshold, byte 1 the span cap, byte
+    2 the store's lie mode, then 5-byte records (3-byte offset, 2-byte
+    size) describe the ranges.  Invariants of ``plan_coalesced`` (the
+    surface every coalesced fetch trusts):
+
+    - deterministic: two plans over the same inputs are identical;
+    - covering: every nonzero input range lands in exactly one group, with
+      multiplicity, and inside its group's span;
+    - bounded: groups are sorted and disjoint, no group bridges a hole
+      wider than the gap threshold, and a group merged across HOLES never
+      exceeds the span cap (only overlap-forced merges may — disjointness
+      outranks the cap);
+
+    then every member is read through a :class:`CoalescedFetcher` over a
+    deterministic store whose span responses may lie about size (short or
+    overlong): each read must either return the exact true bytes (the
+    degradation ladder recovered via single-range fetches) or raise an
+    IOError-rooted retry error — never crash, never silently return wrong
+    bytes.
+    """
+    from .errors import RetryExhaustedError, TransientIOError
+    from .iostore import (CoalescedFetcher, GenericRangeStore, IOConfig,
+                          plan_coalesced)
+
+    if len(data) < 3:
+        return
+    gap = [0, 1, 16, 256, 1 << 16][data[0] % 5]
+    max_span = [128, 1 << 12, 1 << 20][data[1] % 3]
+    lie_mode = data[2] % 3  # 0 honest, 1 short, 2 overlong
+    payload = data[3:]
+    ranges = []
+    for i in range(0, len(payload) - 4, 5):
+        off = int.from_bytes(payload[i : i + 3], "little")
+        size = int.from_bytes(payload[i + 3 : i + 5], "little")
+        ranges.append((off, size))
+    if len(ranges) > 64:
+        ranges = ranges[:64]
+
+    plan = plan_coalesced(ranges, gap, max_span)
+    again = plan_coalesced(list(reversed(ranges)), gap, max_span)
+    if [g.key() for g in plan] != [g.key() for g in again]:
+        raise AssertionError("coalescing plan is input-order dependent")
+    want = {}
+    for off, size in ranges:
+        if size > 0:
+            want[(off, size)] = want.get((off, size), 0) + 1
+    got = {}
+    prev_end = None
+    for g in plan:
+        if prev_end is not None and g.offset < prev_end:
+            raise AssertionError("groups overlap or are unsorted")
+        prev_end = g.offset + g.size
+        ends = sorted((o, o + s) for (o, s) in g.members)
+        if ends[0][0] != g.offset or max(e for _o, e in ends) != prev_end:
+            raise AssertionError("group span does not hug its members")
+        cover_end = None
+        has_overlap = False
+        for o, e in ends:
+            if cover_end is not None:
+                if o - cover_end > gap:
+                    raise AssertionError(
+                        f"group bridges a hole wider than {gap}")
+                has_overlap = has_overlap or o < cover_end
+            cover_end = e if cover_end is None else max(cover_end, e)
+        if len(g.members) > 1 and g.size > max_span and not has_overlap:
+            raise AssertionError(f"merged span {g.size} exceeds cap {max_span}")
+        for m, n in g.members.items():
+            if not (g.offset <= m[0] and m[0] + m[1] <= prev_end):
+                raise AssertionError("member outside its group span")
+            got[m] = got.get(m, 0) + n
+    if got != want:
+        raise AssertionError(f"coverage broken: {got} != {want}")
+
+    # a store that lies about coalesced-span sizes must degrade, not corrupt
+    file_size = 1 << 18
+    member_max = max((s for _o, s in want), default=0)
+
+    class _LyingStore(GenericRangeStore):
+        def size(self):
+            return file_size
+
+        def _fetch_once(self, offset, size, timeout):
+            true = bytes((offset + j) % 251 for j in range(
+                min(size, max(file_size - offset, 0))))
+            if lie_mode == 0 or size <= member_max:
+                return true  # honest (single-member reads always are)
+            if lie_mode == 1:
+                return true[: size // 2]  # short, not at EOF
+            return true + b"\x00" * 7  # overlong
+
+    store = _LyingStore(config=IOConfig(retries=1, backoff_ms=0,
+                                        retry_budget=0, coalesce_gap=gap))
+    fetcher = CoalescedFetcher(store, list(want), gap=gap, max_span=max_span)
+    for off, size in want:
+        if off >= file_size:
+            continue  # fully past EOF: short returns are legitimate
+        expect = bytes((off + j) % 251
+                       for j in range(min(size, file_size - off)))
+        try:
+            buf = fetcher.read(off, size)
+        except (RetryExhaustedError, TransientIOError):
+            continue  # clean failure is an accepted outcome
+        if bytes(buf) != expect:
+            raise AssertionError(
+                f"lying store corrupted range [{off}, {off + size})")
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -541,7 +651,32 @@ TARGETS = {
     "snappy_ops": fuzz_snappy_ops,
     "narrow": fuzz_narrow,
     "loader_state": fuzz_loader_state,
+    "io_ranges": fuzz_io_ranges,
 }
+
+
+def crafted_io_range_blobs() -> "list[bytes]":
+    """Hand-crafted ``io_ranges`` inputs (and corpus blobs): the planner
+    shapes a real footer produces plus the hostile ones it doesn't."""
+
+    def rec(off, size):
+        return off.to_bytes(3, "little") + size.to_bytes(2, "little")
+
+    # adjacent column chunks with small header gaps (the real row-group
+    # shape coalescing exists for), generous gap + span
+    adjacent = bytes([4, 2, 0]) + b"".join(
+        rec(o, 1000) for o in range(64, 16064, 1040))
+    # duplicate + overlapping ranges (a re-read of a dict page overlaps its
+    # chunk), short-lie mode
+    overlap = bytes([2, 2, 1]) + rec(100, 500) + rec(100, 500) + \
+        rec(300, 800) + rec(2000, 100)
+    # span-cap pressure: members that would merge but for the 128-byte cap,
+    # overlong-lie mode
+    capped = bytes([1, 0, 2]) + b"".join(rec(o, 100) for o in range(0, 1200, 101))
+    # zero-size + EOF-straddling + past-EOF ranges, zero gap
+    edges = bytes([0, 1, 1]) + rec(50, 0) + rec((1 << 18) - 40, 200) + \
+        rec(1 << 18, 100) + rec(10, 7)
+    return [adjacent, overlap, capped, edges]
 
 
 # ---------------------------------------------------------------------------
@@ -710,6 +845,8 @@ def _seed_inputs(target: str) -> list[bytes]:
             # declared-size lie: bias +1 on a valid stream must reject
             b"\x03" + crafted_snappy_streams()[0],
         ]
+    if target == "io_ranges":
+        return crafted_io_range_blobs()
     if target == "loader_state":
         from .data import checkpoint as ck
 

@@ -1,0 +1,794 @@
+"""Fault-tolerant range-read IO backend: the byte layer under SharedReader.
+
+Production traffic reads Parquet from object stores, not local disk
+(ROADMAP direction 4), but until this module every byte entered through an
+infallible-``os.pread`` assumption: one transient stall or short read and
+the pipeline either wedged (diagnosed, since PR 6, by the watchdog) or died
+with an opaque downstream decode error.  The reference design's strict
+layer separation (PAPER.md §1: raw bytes below L0, everything above
+untouched) means the fix slots BENEATH the existing reader/pipeline stack —
+no decode layer changes.  Three stores:
+
+- :class:`LocalStore` — the existing ``os.pread`` path (locked seek+read
+  for fd-less sources), the zero-overhead default.  No retries, no
+  deadlines, no coalescing: a local fd does not fail transiently, and the
+  lineitem16 pipeline bench guards the indirection at ≤2%.
+- :class:`GenericRangeStore` — the robustness core any real GCS/S3 adapter
+  inherits: per-request deadlines (``TPQ_IO_DEADLINE_S``), bounded retries
+  with exponential backoff + decorrelated jitter (``TPQ_IO_RETRIES``,
+  ``TPQ_IO_BACKOFF_MS``) under a per-scan retry budget
+  (``TPQ_IO_RETRY_BUDGET``), short/torn-read detection with verified
+  re-reads, and graceful degradation from coalesced to single-range
+  fetches when a coalesced read repeatedly fails.  Subclasses implement
+  one method: :meth:`GenericRangeStore._fetch_once`.
+- :class:`FaultInjectingStore` — deterministic seeded injection of latency,
+  transient errors, torn/short reads, and stalls over any inner store, so
+  tier-1 exercises every failure path without a network.
+
+On top, :func:`plan_coalesced` merges adjacent column-chunk ranges (gap
+threshold ``TPQ_IO_COALESCE_GAP``) and :class:`CoalescedFetcher` fans the
+merged spans out on the existing prefetch pool — the io lane issues fewer,
+larger, individually-retryable requests.  The degradation ladder on
+failure: coalesced span → per-member single ranges → error
+(:class:`~tpu_parquet.errors.RetryExhaustedError` carrying the attempt
+log).  Observability rides the PR 4-6 machinery: per-store
+:class:`IOStats` fold into ``obs.StatsRegistry`` (the ``io`` section), the
+``progress()`` counters feed an ``io_retries`` sampler track and a
+watchdog heartbeat lane, and every store registers as a flight source so a
+stalled fetch's dump names the in-flight range (``pq_tool autopsy``
+verdict ``network-stall``).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .errors import ParquetError, RetryExhaustedError, TransientIOError
+from .obs import env_float, env_int, register_flight_source
+
+__all__ = [
+    "ByteStore", "CoalescedFetcher", "FaultInjectingStore", "FaultSpec",
+    "GenericRangeStore", "IOConfig", "IOStats", "LocalStore", "RetryBudget",
+    "plan_coalesced", "require_full", "resolve_store",
+]
+
+# ceiling on one coalesced span: bounds the extra bytes a merged fetch can
+# hold beyond its members (column chunks are ~1 MB; a 16-column row group
+# merges to tens of MB, well under this)
+MAX_COALESCED_SPAN = 64 << 20
+# a coalesced span failing this many times in one scan disables coalescing
+# for the REST of the scan (ladder step: the store is evidently unhappy
+# with large reads; stop paying a failed big fetch per row group)
+COALESCE_DISABLE_AFTER = 2
+
+
+def require_full(buf: bytes, offset: int, size: int,
+                 context: str = "") -> bytes:
+    """Raise a clear ``ParquetError`` when a range read came back short.
+
+    The page-read callsites use this instead of letting a silently-short
+    buffer reach the decoder (where it dies as a confusing CRC/structure
+    error): a truncated file is named as such, with offset/got/want.
+    """
+    if len(buf) != size:
+        where = f" reading {context}" if context else ""
+        raise ParquetError(
+            f"truncated file{where}: wanted {size} bytes at offset "
+            f"{offset}, got {len(buf)} — the file is shorter than its "
+            f"metadata claims")
+    return buf
+
+
+# ---------------------------------------------------------------------------
+# config + stats + retry budget
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class IOConfig:
+    """Robustness knobs for :class:`GenericRangeStore` (env-resolved once
+    per store at construction, so tests can flip the env per store).
+
+    - ``deadline_s``     per-request wall ceiling across all of a read's
+      attempts (0 = none): a fetch that cannot finish by then raises
+      ``RetryExhaustedError`` instead of pinning a worker forever.
+    - ``retries``        max re-attempts per range after the first try.
+    - ``backoff_ms``     base backoff; actual sleeps use decorrelated
+      jitter (``sleep = uniform(base, prev * 3)``, capped at 64× base) so
+      a fleet of readers hitting one throttled store doesn't re-arrive in
+      lockstep.
+    - ``retry_budget``   per-SCAN cap on total retries (0 = unlimited): a
+      store failing everywhere should fail the scan after a bounded amount
+      of wheel-spinning, not after retries × chunks sleeps.
+    - ``coalesce_gap``   merge adjacent ranges when the hole between them
+      is at most this many bytes (0 disables coalescing).
+    """
+
+    deadline_s: float = 0.0
+    retries: int = 4
+    backoff_ms: float = 25.0
+    retry_budget: int = 64
+    coalesce_gap: int = 1 << 16
+
+    @classmethod
+    def from_env(cls) -> "IOConfig":
+        return cls(
+            deadline_s=env_float("TPQ_IO_DEADLINE_S", 0.0, lo=0.0),
+            retries=env_int("TPQ_IO_RETRIES", 4, lo=0),
+            backoff_ms=env_float("TPQ_IO_BACKOFF_MS", 25.0, lo=0.0),
+            retry_budget=env_int("TPQ_IO_RETRY_BUDGET", 64, lo=0),
+            coalesce_gap=env_int("TPQ_IO_COALESCE_GAP", 1 << 16, lo=0),
+        )
+
+
+class RetryBudget:
+    """Per-scan cap on total retries (thread-safe; 0 = unlimited)."""
+
+    def __init__(self, max_retries: int = 0):
+        self.max_retries = int(max_retries)
+        self.spent = 0
+        self._lock = threading.Lock()
+
+    def spend(self) -> bool:
+        """Take one retry from the budget; False when it is exhausted."""
+        with self._lock:
+            if 0 < self.max_retries <= self.spent:
+                return False
+            self.spent += 1
+            return True
+
+
+class IOStats:
+    """Retry/backoff/coalescing counters for one store (thread-safe).
+
+    ``as_dict()`` is the ``io`` section of ``obs.StatsRegistry`` — all
+    flows, so multi-store scans compose by addition.  ``sample()`` adds the
+    point-in-time in-flight range for flight dumps (the fact a hang autopsy
+    needs: WHICH range was being fetched when everything froze).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reads = 0
+        self.bytes_read = 0
+        self.retries = 0
+        self.backoff_seconds = 0.0
+        self.transient_errors = 0
+        self.short_reads = 0
+        self.deadline_hits = 0
+        self.exhausted = 0
+        self.coalesced_spans = 0
+        self.coalesced_bytes = 0
+        self.coalesce_fallbacks = 0
+        # thread ident -> (offset, size, started) of the fetch in flight
+        self._inflight: dict[int, tuple[int, int, float]] = {}
+
+    def add(self, field: str, n=1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def enter(self, offset: int, size: int) -> None:
+        with self._lock:
+            self._inflight[threading.get_ident()] = (
+                offset, size, time.monotonic())
+
+    def exit(self) -> None:
+        with self._lock:
+            self._inflight.pop(threading.get_ident(), None)
+
+    def progress(self) -> dict:
+        """Monotonic counters only — the watchdog heartbeat contract: they
+        FREEZE while a fetch is stalled (so the dog can fire) and keep
+        advancing while the store is merely retrying (a retry loop with
+        backoff is working as designed, not a hang — the deadline and the
+        retry budget bound it, not the watchdog)."""
+        with self._lock:
+            return {
+                "reads": self.reads,
+                "bytes_read": self.bytes_read,
+                "retries": self.retries,
+                "transient_errors": self.transient_errors,
+                "short_reads": self.short_reads,
+            }
+
+    def sample(self) -> dict:
+        out = self.progress()
+        with self._lock:
+            if self._inflight:
+                now = time.monotonic()
+                off, size, t0 = max(self._inflight.values(),
+                                    key=lambda v: now - v[2])
+                out["inflight_offset"] = off
+                out["inflight_size"] = size
+                out["inflight_age_s"] = round(now - t0, 3)
+        return out
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {
+                "reads": self.reads,
+                "bytes_read": self.bytes_read,
+                "retries": self.retries,
+                "backoff_seconds": round(self.backoff_seconds, 6),
+                "transient_errors": self.transient_errors,
+                "short_reads": self.short_reads,
+                "deadline_hits": self.deadline_hits,
+                "exhausted": self.exhausted,
+                "coalesced_spans": self.coalesced_spans,
+                "coalesced_bytes": self.coalesced_bytes,
+                "coalesce_fallbacks": self.coalesce_fallbacks,
+            }
+
+
+# ---------------------------------------------------------------------------
+# the store interface + the zero-overhead local default
+# ---------------------------------------------------------------------------
+
+class ByteStore:
+    """Positioned byte source under :class:`~tpu_parquet.pipeline
+    .SharedReader`: ``read_range``/``size`` plus capability flags.
+
+    ``read_range`` returns UP TO ``size`` bytes — short only when the
+    underlying object genuinely ends early (callers surface that as a
+    truncated-file :func:`require_full` ParquetError).  ``parallel`` says
+    concurrent ``read_range`` calls are safe; ``prefers_coalescing`` opts
+    the store into the range-merging planner (local fds say no: the page
+    cache already does it better).
+    """
+
+    parallel = True
+    prefers_coalescing = False
+    coalesce_gap = 0
+    stats: "IOStats | None" = None
+
+    def read_range(self, offset: int, size: int,
+                   deadline: "float | None" = None) -> bytes:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        """Total object size.  Consulted on the read path (EOF vs torn-read
+        classification) — implementations must cache it, not re-stat a
+        remote object per read."""
+        raise NotImplementedError
+
+    def begin_scan(self) -> None:
+        """Scan boundary hook: resets the per-scan retry budget and the
+        coalescing degradation state (no-op for plain stores)."""
+
+    def abort(self, exc: BaseException) -> None:
+        """Poison the store: in-flight and future reads raise ``exc``.
+
+        The watchdog's raise-policy hook (same contract as
+        ``InFlightBudget.abort``): a fetch stalled inside the transport
+        would otherwise pin its worker — and the consumer blocked on that
+        worker's future — past any deadline the watchdog enforces.  No-op
+        for plain local stores (their reads cannot stall).
+        """
+
+    def close(self) -> None:
+        pass
+
+
+class LocalStore(ByteStore):
+    """The current local path, unchanged in behavior: ``os.pread`` on real
+    files (fully parallel, never touches the shared fd position), a lock
+    around seek+read for fd-less sources (BytesIO, wrapped streams).  Does
+    NOT own the file object."""
+
+    def __init__(self, f):
+        self._f = f
+        self._lock = threading.Lock()
+        self._size: "int | None" = None
+        self._fd: Optional[int] = None
+        try:
+            self._fd = f.fileno()
+        except Exception:  # noqa: BLE001 — io.UnsupportedOperation et al.
+            self._fd = None
+        if self._fd is not None:
+            # some file-likes expose a fileno that pread cannot serve (a
+            # pipe), and some platforms lack os.pread entirely (Windows);
+            # probe once and fall back to the locked path forever
+            try:
+                os.pread(self._fd, 0, 0)
+            except (OSError, AttributeError):
+                self._fd = None
+
+    @property
+    def parallel(self) -> bool:
+        return self._fd is not None
+
+    def read_range(self, offset: int, size: int,
+                   deadline: "float | None" = None) -> bytes:
+        if self._fd is not None:
+            parts = []
+            pos = offset
+            remaining = size
+            while remaining > 0:
+                b = os.pread(self._fd, remaining, pos)
+                if not b:
+                    break
+                parts.append(b)
+                pos += len(b)
+                remaining -= len(b)
+            return b"".join(parts) if len(parts) != 1 else parts[0]
+        with self._lock:
+            self._f.seek(offset)
+            return self._f.read(size)
+
+    def size(self) -> int:
+        if self._size is None:
+            if self._fd is not None:
+                self._size = os.fstat(self._fd).st_size
+            else:
+                with self._lock:
+                    pos = self._f.tell()
+                    self._f.seek(0, os.SEEK_END)
+                    self._size = self._f.tell()
+                    self._f.seek(pos)
+        return self._size
+
+
+# ---------------------------------------------------------------------------
+# the robustness core
+# ---------------------------------------------------------------------------
+
+_store_seq = iter(range(1, 1 << 62))
+
+
+class GenericRangeStore(ByteStore):
+    """Retry/backoff/deadline core for unreliable range-read transports.
+
+    Subclasses implement :meth:`_fetch_once` — one attempt, which may
+    return short/torn bytes or raise :class:`~tpu_parquet.errors
+    .TransientIOError` (or ``OSError``/``TimeoutError``) for retryable
+    faults.  ``read_range`` wraps it with:
+
+    - a per-request deadline (``TPQ_IO_DEADLINE_S`` / the ``deadline``
+      argument, an absolute ``time.monotonic()`` point) spanning all
+      attempts;
+    - bounded retries with exponential backoff + decorrelated jitter,
+      spending from the per-scan :class:`RetryBudget`;
+    - short/torn-read detection with a VERIFIED re-read: a short buffer not
+      at EOF retries, and the re-read's prefix must match what the torn
+      attempt returned (a mismatch means the transport is returning
+      garbage, which is itself a transient fault);
+    - an attempt log carried on the terminal
+      :class:`~tpu_parquet.errors.RetryExhaustedError`.
+
+    A genuine EOF (``offset + got >= size()``) returns the short buffer
+    as-is — truncation is the CALLER's diagnosis (:func:`require_full`
+    names offset/got/want), not a retry loop's.
+    """
+
+    prefers_coalescing = True
+
+    def __init__(self, config: "IOConfig | None" = None, seed: int = 0):
+        self.config = config if config is not None else IOConfig.from_env()
+        self.coalesce_gap = self.config.coalesce_gap
+        self.stats = IOStats()
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        self._scan_budget = RetryBudget(self.config.retry_budget)
+        self._coalesce_failures = 0
+        self.coalesce_disabled = self.coalesce_gap <= 0
+        # watchdog abort plumbing (see ByteStore.abort): checked between
+        # attempts, and implementations poll it inside long waits
+        self._abort_exc: "BaseException | None" = None
+        self._abort_event = threading.Event()
+        # flight-source registration (weak): a hang dump must name the
+        # range in flight at the moment of the wedge — see obs.autopsy_dump
+        register_flight_source(f"iostore[{next(_store_seq)}]", self.stats,
+                               "sample")
+
+    # -- the one method subclasses provide ------------------------------------
+
+    def _fetch_once(self, offset: int, size: int,
+                    timeout: "float | None") -> bytes:
+        """One fetch attempt.  ``timeout`` is the seconds left under the
+        request's deadline (None = unbounded); implementations honor it as
+        well as their transport allows."""
+        raise NotImplementedError
+
+    # -- scan lifecycle -------------------------------------------------------
+
+    def begin_scan(self) -> None:
+        self._scan_budget = RetryBudget(self.config.retry_budget)
+        self._coalesce_failures = 0
+        self.coalesce_disabled = self.coalesce_gap <= 0
+        self._abort_exc = None
+        self._abort_event.clear()
+
+    def abort(self, exc: BaseException) -> None:
+        self._abort_exc = exc
+        self._abort_event.set()
+
+    def note_coalesce_failure(self) -> None:
+        """A coalesced span exhausted its retries: after
+        ``COALESCE_DISABLE_AFTER`` of these in one scan, stop planning
+        coalesced fetches entirely (ladder: coalesced → single-range)."""
+        self.stats.add("coalesce_fallbacks")
+        self._coalesce_failures += 1
+        if self._coalesce_failures >= COALESCE_DISABLE_AFTER:
+            self.coalesce_disabled = True
+
+    # -- the retry loop -------------------------------------------------------
+
+    def read_range(self, offset: int, size: int,
+                   deadline: "float | None" = None) -> bytes:
+        cfg = self.config
+        if deadline is None and cfg.deadline_s > 0:
+            deadline = time.monotonic() + cfg.deadline_s
+        attempts: list[dict] = []
+        torn_prefix: "bytes | None" = None
+        backoff = cfg.backoff_ms / 1e3
+        stats = self.stats
+        stats.enter(offset, size)
+        try:
+            for attempt in range(cfg.retries + 1):
+                if self._abort_exc is not None:
+                    raise self._abort_exc
+                t0 = time.monotonic()
+                try:
+                    timeout = None
+                    if deadline is not None:
+                        timeout = deadline - t0
+                        if timeout <= 0:
+                            raise TransientIOError(
+                                f"deadline exceeded before attempt "
+                                f"{attempt} of range [{offset}, "
+                                f"{offset + size})")
+                    buf = self._fetch_once(offset, size, timeout)
+                    if len(buf) == size and offset + size > self.size():
+                        # a full-length response for a range that provably
+                        # extends past EOF is fabricated bytes (a store
+                        # padding its EOF reads) — never serve them
+                        raise TransientIOError(
+                            f"full-length read for range [{offset}, "
+                            f"{offset + size}) past EOF at {self.size()}")
+                    if len(buf) == size:
+                        if torn_prefix is not None and not buf.startswith(
+                                torn_prefix):
+                            # verified re-read failed: the transport is
+                            # returning DIFFERENT bytes for the same range
+                            torn_prefix = None
+                            raise TransientIOError(
+                                f"re-read of range [{offset}, "
+                                f"{offset + size}) does not match the torn "
+                                f"attempt's prefix")
+                        stats.add("reads")
+                        stats.add("bytes_read", size)
+                        return buf
+                    if len(buf) > size:
+                        raise TransientIOError(
+                            f"overlong read: got {len(buf)} bytes for a "
+                            f"{size}-byte range at {offset}")
+                    if offset + len(buf) >= self.size():
+                        # genuine EOF: return short; the caller names the
+                        # truncation (require_full), retrying can't help
+                        stats.add("reads")
+                        stats.add("bytes_read", len(buf))
+                        return buf
+                    stats.add("short_reads")
+                    if len(buf) > (len(torn_prefix or b"")):
+                        torn_prefix = bytes(buf)
+                    raise TransientIOError(
+                        f"short read: got {len(buf)} of {size} bytes at "
+                        f"{offset} (torn read, not EOF)")
+                except RetryExhaustedError:
+                    raise
+                except (TransientIOError, TimeoutError, OSError) as e:
+                    if self._abort_exc is not None:
+                        # the watchdog fired mid-attempt: its error (with
+                        # the dump path) outranks the transport's
+                        raise self._abort_exc from e
+                    stats.add("transient_errors")
+                    attempts.append({
+                        "attempt": attempt,
+                        "error": f"{type(e).__name__}: {e}",
+                        "elapsed_ms": round(
+                            (time.monotonic() - t0) * 1e3, 3),
+                    })
+                    # deadline checked BEFORE retry exhaustion so one
+                    # expiry counts exactly once, whichever branch noticed
+                    # it (the pre-attempt raise lands here too)
+                    if deadline is not None and time.monotonic() >= deadline:
+                        stats.add("deadline_hits")
+                        stats.add("exhausted")
+                        raise RetryExhaustedError(
+                            f"range [{offset}, {offset + size}) deadline "
+                            f"exceeded after {attempt + 1} attempt(s)",
+                            attempts=attempts, offset=offset, size=size,
+                        ) from e
+                    if attempt >= cfg.retries:
+                        stats.add("exhausted")
+                        raise RetryExhaustedError(
+                            f"range [{offset}, {offset + size}) failed "
+                            f"after {attempt + 1} attempt(s): {e}",
+                            attempts=attempts, offset=offset, size=size,
+                        ) from e
+                    if not self._scan_budget.spend():
+                        stats.add("exhausted")
+                        raise RetryExhaustedError(
+                            f"range [{offset}, {offset + size}): per-scan "
+                            f"retry budget "
+                            f"({self._scan_budget.max_retries}) exhausted",
+                            attempts=attempts, offset=offset, size=size,
+                        ) from e
+                    # decorrelated jitter: sleep ~U(base, prev*3), capped
+                    if backoff > 0:
+                        with self._rng_lock:
+                            backoff = min(
+                                self._rng.uniform(cfg.backoff_ms / 1e3,
+                                                  backoff * 3),
+                                cfg.backoff_ms / 1e3 * 64)
+                        if deadline is not None:
+                            backoff = min(
+                                backoff,
+                                max(deadline - time.monotonic(), 0.0))
+                        attempts[-1]["backoff_ms"] = round(backoff * 1e3, 3)
+                        stats.add("retries")
+                        stats.add("backoff_seconds", backoff)
+                        time.sleep(backoff)
+                    else:
+                        stats.add("retries")
+            raise AssertionError("unreachable: the retry loop always "
+                                 "returns or raises")  # pragma: no cover
+        finally:
+            stats.exit()
+
+
+# ---------------------------------------------------------------------------
+# deterministic fault injection
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """What :class:`FaultInjectingStore` injects, per matched range.
+
+    Attempt-indexed (the i-th attempt at a given offset), so a spec like
+    ``fail_first=2`` means "the first two attempts fail, the third
+    succeeds" — deterministic under concurrency because the decision keys
+    on ``(offset, attempt#)``, never on global call order.
+
+    - ``latency_s``   fixed extra latency per matched fetch;
+    - ``fail_first``  first N attempts raise a TransientIOError;
+    - ``torn_first``  the N attempts AFTER the failures return a torn
+      (half-length) prefix — the injected sequence per range is errors,
+      then torn reads, then healthy;
+    - ``stall_first`` first N attempts block for ``stall_s`` (or until the
+      store's :meth:`FaultInjectingStore.release` — the injected "network
+      stall" the watchdog must catch);
+    - ``match``       predicate ``(offset, size) -> bool`` choosing which
+      ranges are faulty (None = all).
+    """
+
+    latency_s: float = 0.0
+    fail_first: int = 0
+    torn_first: int = 0
+    stall_first: int = 0
+    stall_s: float = 30.0
+    match: "Callable[[int, int], bool] | None" = None
+
+
+class FaultInjectingStore(GenericRangeStore):
+    """Seeded, deterministic fault injection over any inner store.
+
+    The tier-1 test vehicle for the whole failure matrix: every injected
+    transient fault must recover to bit-identical output; exhausted retries
+    must raise ``RetryExhaustedError`` with the attempt log; an injected
+    stall must fire the watchdog.  ``release()`` unblocks any in-progress
+    stalls (tests call it in teardown so a joined pool never waits the full
+    ``stall_s``).
+    """
+
+    def __init__(self, inner: ByteStore, spec: "FaultSpec | None" = None,
+                 config: "IOConfig | None" = None, seed: int = 0):
+        super().__init__(config=config, seed=seed)
+        self.inner = inner
+        self.spec = spec if spec is not None else FaultSpec()
+        self._attempts: dict[int, int] = {}  # offset -> attempts so far
+        self._attempts_lock = threading.Lock()
+        self._unstall = threading.Event()
+
+    def release(self) -> None:
+        """Unblock every current and future injected stall."""
+        self._unstall.set()
+
+    def size(self) -> int:
+        return self.inner.size()
+
+    def _fetch_once(self, offset: int, size: int,
+                    timeout: "float | None") -> bytes:
+        spec = self.spec
+        if spec.match is not None and not spec.match(offset, size):
+            return self.inner.read_range(offset, size)
+        with self._attempts_lock:
+            n = self._attempts.get(offset, 0)
+            self._attempts[offset] = n + 1
+        if spec.latency_s > 0:
+            wait = spec.latency_s
+            if timeout is not None and wait > timeout:
+                time.sleep(max(timeout, 0.0))
+                raise TransientIOError(
+                    f"injected latency {spec.latency_s:g}s exceeded the "
+                    f"deadline for range [{offset}, {offset + size})")
+            time.sleep(wait)
+        if n < spec.stall_first:
+            deadline = time.monotonic() + (spec.stall_s if timeout is None
+                                           else min(spec.stall_s, timeout))
+            # sliced wait: wakes promptly on release() AND on a watchdog
+            # abort (two events can't be waited on together)
+            while not self._unstall.is_set() and self._abort_exc is None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._unstall.wait(min(left, 0.05))
+            raise TransientIOError(
+                f"injected stall at range [{offset}, {offset + size}) "
+                f"(attempt {n})")
+        if n < spec.fail_first:
+            raise TransientIOError(
+                f"injected transient error #{n} at range "
+                f"[{offset}, {offset + size})")
+        buf = self.inner.read_range(offset, size)
+        if n < spec.fail_first + spec.torn_first and len(buf) > 1:
+            return buf[: max(len(buf) // 2, 1)]
+        return buf
+
+
+# ---------------------------------------------------------------------------
+# range coalescing
+# ---------------------------------------------------------------------------
+
+class _Group:
+    """One planned coalesced span: ``[offset, offset+size)`` covering
+    ``members`` (a multiset of the input ``(offset, size)`` ranges)."""
+
+    __slots__ = ("offset", "size", "members", "buf", "remaining",
+                 "degraded", "lock")
+
+    def __init__(self, offset: int, size: int, members: dict):
+        self.offset = offset
+        self.size = size
+        self.members = members          # (offset, size) -> count
+        self.remaining = sum(members.values())
+        self.buf: "bytes | None" = None
+        self.degraded = False
+        self.lock = threading.Lock()
+
+    def key(self) -> tuple:
+        return (self.offset, self.size, tuple(sorted(self.members.items())))
+
+
+def plan_coalesced(ranges, gap: int,
+                   max_span: int = MAX_COALESCED_SPAN) -> "list[_Group]":
+    """Merge ``(offset, size)`` ranges whose holes are at most ``gap``.
+
+    Deterministic (pure function of the multiset of inputs), covering
+    (every nonzero input range lands in exactly one group, with
+    multiplicity), and bounded: groups are sorted and DISJOINT (a range
+    overlapping the current group always joins it — fetching the overlap
+    twice in two groups would be the one shape worse than either
+    alternative), no group bridges a hole wider than ``gap``, and a group
+    merged across holes never exceeds ``max_span`` (a lone range larger
+    than that forms its own group — it must be fetched regardless; forced
+    overlap-merges may also exceed it).  Zero/negative-size ranges are
+    dropped (they read zero bytes regardless).
+    """
+    items = sorted((int(o), int(s)) for o, s in ranges if int(s) > 0)
+    groups: list[_Group] = []
+    cur: "dict | None" = None
+    cur_off = cur_end = 0
+    for off, size in items:
+        end = off + size
+        if cur is not None and (off < cur_end or (
+                off - cur_end <= gap
+                and max(end, cur_end) - cur_off <= max_span)):
+            cur[(off, size)] = cur.get((off, size), 0) + 1
+            cur_end = max(cur_end, end)
+            continue
+        if cur is not None:
+            groups.append(_Group(cur_off, cur_end - cur_off, cur))
+        cur = {(off, size): 1}
+        cur_off, cur_end = off, end
+    if cur is not None:
+        groups.append(_Group(cur_off, cur_end - cur_off, cur))
+    return groups
+
+
+class CoalescedFetcher:
+    """Serve member ranges of one coalescing plan from merged fetches.
+
+    Built per row group on the consumer thread; the FIRST worker to touch a
+    group pays its one big ``read_range`` on its own pool thread (that is
+    how coalesced spans fan out on the existing prefetch pool), every other
+    member slices the cached buffer.  The buffer drops as soon as its last
+    member is consumed.  Failure ladder: a span whose fetch exhausts its
+    retries (or comes back the wrong length — a store lying about sizes)
+    marks the group degraded, and its members fall back to individual
+    single-range reads; repeated span failures disable coalescing for the
+    rest of the scan (``GenericRangeStore.note_coalesce_failure``).
+    """
+
+    def __init__(self, store: ByteStore, ranges,
+                 gap: "int | None" = None,
+                 max_span: int = MAX_COALESCED_SPAN):
+        self.store = store
+        g = store.coalesce_gap if gap is None else gap
+        self._by_member: dict[tuple, _Group] = {}
+        for grp in plan_coalesced(ranges, g, max_span):
+            if len(grp.members) <= 1:
+                continue  # lone range: a merged fetch buys nothing
+            for m in grp.members:
+                self._by_member[m] = grp
+        self.groups = len({id(g) for g in self._by_member.values()})
+
+    def read(self, offset: int, size: int) -> bytes:
+        grp = self._by_member.get((offset, size))
+        if grp is None:
+            return self.store.read_range(offset, size)
+        with grp.lock:
+            if grp.buf is None and not grp.degraded:
+                try:
+                    buf = self.store.read_range(grp.offset, grp.size)
+                    if len(buf) != grp.size:
+                        # short span: EOF mid-group or a lying store —
+                        # per-member reads diagnose precisely
+                        raise TransientIOError(
+                            f"coalesced span [{grp.offset}, "
+                            f"{grp.offset + grp.size}) returned "
+                            f"{len(buf)} bytes")
+                    grp.buf = buf
+                    st = self.store.stats
+                    if st is not None:
+                        st.add("coalesced_spans")
+                        st.add("coalesced_bytes", grp.size)
+                except (RetryExhaustedError, TransientIOError, OSError):
+                    grp.degraded = True
+                    note = getattr(self.store, "note_coalesce_failure",
+                                   None)
+                    if note is not None:
+                        note()
+            if grp.buf is not None:
+                lo = offset - grp.offset
+                out = grp.buf[lo: lo + size]
+                grp.remaining -= 1
+                if grp.remaining <= 0:
+                    grp.buf = None  # last member consumed: drop the span
+                return out
+        # degraded: individual single-range fetch (outside the group lock,
+        # so members recover in parallel); its own retries still apply, and
+        # ITS failure is the ladder's final rung — the error propagates
+        return self.store.read_range(offset, size)
+
+
+# ---------------------------------------------------------------------------
+# store selection
+# ---------------------------------------------------------------------------
+
+def resolve_store(f, store: "ByteStore | Callable | None") -> ByteStore:
+    """Resolve a reader's ``store=`` option against its open file.
+
+    ``None`` → :class:`LocalStore` over ``f`` (the zero-overhead default);
+    a :class:`ByteStore` → itself (single-file use; the caller owns it);
+    a callable → ``store(f)`` — the factory form multi-file scans need
+    (each file gets its own store, e.g.
+    ``lambda f: FaultInjectingStore(LocalStore(f), spec)``).
+    """
+    if store is None:
+        return LocalStore(f)
+    if isinstance(store, ByteStore):
+        return store
+    if callable(store):
+        st = store(f)
+        if not isinstance(st, ByteStore):
+            raise TypeError(
+                f"store factory returned {type(st).__name__}, "
+                f"not a ByteStore")
+        return st
+    raise TypeError(f"store must be None, a ByteStore, or a factory "
+                    f"callable; got {type(store).__name__}")

@@ -80,7 +80,8 @@ __all__ = [
     "FLIGHT_VERSION", "OBS_VERSION", "ConsumerLane", "FlightRecorder",
     "LatencyHistogram",
     "Sampler", "StatsRegistry", "Tracer", "Watchdog", "autopsy_dump",
-    "current_tracer", "doctor_registry", "flight_dump_path",
+    "current_tracer", "doctor_registry", "env_float", "env_int",
+    "flight_dump_path",
     "flight_recorder", "install_flight_hooks", "note_worker_crash",
     "register_flight_registry", "register_flight_source",
     "resolve_hang_s", "resolve_sample_ms", "resolve_tracer",
@@ -91,6 +92,51 @@ __all__ = [
 # file's otherData, the histogram dict) — bench parsers and the driver key
 # on it, and the golden-key tests in tests/test_obs.py pin the key sets
 OBS_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# env knob parsing: malformed values degrade, never raise
+# ---------------------------------------------------------------------------
+
+# (name, raw) pairs already warned about — one line per bad value, not one
+# per reader construction (a scan_files over 1000 shards must not log 1000x)
+_env_warned: "set[tuple[str, str]]" = set()
+
+
+def _env_num(name: str, default, cast, lo=None, hi=None):
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        v = cast(raw)
+    except (TypeError, ValueError):
+        key = (name, raw)
+        if key not in _env_warned:
+            _env_warned.add(key)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s=%r is not a valid %s; using the default %r",
+                name, raw, cast.__name__, default)
+        return default
+    if lo is not None and v < lo:
+        v = lo
+    if hi is not None and v > hi:
+        v = hi
+    return v
+
+
+def env_float(name: str, default: float, lo=None, hi=None) -> float:
+    """``float(os.environ[name])`` with the TPQ_HANG_POLICY degradation
+    contract: unset → default; malformed → default plus ONE warning line
+    (an env typo must never turn every reader construction into a raise);
+    out-of-range values clamp to ``[lo, hi]``."""
+    return _env_num(name, default, float, lo, hi)
+
+
+def env_int(name: str, default: int, lo=None, hi=None) -> int:
+    """Integer twin of :func:`env_float`, same degradation contract."""
+    return _env_num(name, default, int, lo, hi)
 
 
 # ---------------------------------------------------------------------------
@@ -256,10 +302,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: "int | None" = None):
         if capacity is None:
-            try:
-                capacity = int(os.environ.get("TPQ_RING_EVENTS", "") or 256)
-            except ValueError:
-                capacity = 256
+            capacity = env_int("TPQ_RING_EVENTS", 256, lo=0)
         self.capacity = max(int(capacity), 0)
         self._lock = threading.Lock()
         self._threads: "dict[int, tuple[str, deque]]" = {}
@@ -700,11 +743,7 @@ def resolve_sample_ms(sample_ms=None) -> float:
             return max(float(sample_ms), 0.0)
         except (TypeError, ValueError):
             return 0.0
-    env = os.environ.get("TPQ_SAMPLE_MS", "")
-    try:
-        return max(float(env), 0.0) if env else 0.0
-    except ValueError:
-        return 0.0
+    return env_float("TPQ_SAMPLE_MS", 0.0, lo=0.0)
 
 
 class Sampler:
@@ -825,11 +864,7 @@ def resolve_hang_s(hang_s=None) -> float:
             return max(float(hang_s), 0.0)
         except (TypeError, ValueError):
             return 0.0
-    env = os.environ.get("TPQ_HANG_S", "")
-    try:
-        return max(float(env), 0.0) if env else 0.0
-    except ValueError:
-        return 0.0
+    return env_float("TPQ_HANG_S", 0.0, lo=0.0)
 
 
 class ConsumerLane:
@@ -1157,6 +1192,7 @@ class StatsRegistry:
         self._pipeline: "dict | None" = None
         self._reader: "dict | None" = None
         self._loader: "dict | None" = None
+        self._io: "dict | None" = None
         self._alloc_peak = 0
         self._hists: dict[str, LatencyHistogram] = {}
 
@@ -1200,6 +1236,16 @@ class StatsRegistry:
         if pipe is not None:
             self.add_pipeline(lstats.pipeline)
 
+    def add_io(self, iostats) -> None:
+        """Fold a :class:`~tpu_parquet.iostore.IOStats` in (retry/backoff/
+        coalescing counters of one store; all flows, so multi-file scans
+        compose by addition).  Raw dicts accepted for tests."""
+        d = iostats if isinstance(iostats, dict) else iostats.as_dict()
+        with self._lock:
+            if self._io is None:
+                self._io = {}
+            _merge_num_tree(self._io, d)
+
     def note_alloc_peak(self, tracker) -> None:
         """Record an :class:`~tpu_parquet.alloc.AllocTracker`'s high-water
         mark (its ``peak`` attribute; raw ints accepted for tests)."""
@@ -1212,11 +1258,12 @@ class StatsRegistry:
             pipeline = dict(other._pipeline) if other._pipeline else None
             reader = dict(other._reader) if other._reader else None
             loader = dict(other._loader) if other._loader else None
+            io = dict(other._io) if other._io else None
             peak = other._alloc_peak
             hists = dict(other._hists)
         with self._lock:
             for name, src in (("_pipeline", pipeline), ("_reader", reader),
-                              ("_loader", loader)):
+                              ("_loader", loader), ("_io", io)):
                 if src is None:
                     continue
                 dst = getattr(self, name)
@@ -1233,7 +1280,7 @@ class StatsRegistry:
             raise ValueError(
                 f"obs_version {tree.get('obs_version')!r} != {OBS_VERSION}")
         for key, attr in (("pipeline", "_pipeline"), ("reader", "_reader"),
-                          ("loader", "_loader")):
+                          ("loader", "_loader"), ("io", "_io")):
             src = tree.get(key)
             if src is None:
                 continue
@@ -1304,6 +1351,7 @@ class StatsRegistry:
                 "pipeline": dict(self._pipeline) if self._pipeline else None,
                 "reader": dict(self._reader) if self._reader else None,
                 "loader": dict(self._loader) if self._loader else None,
+                "io": dict(self._io) if self._io else None,
                 "alloc": {"peak_bytes": self._alloc_peak},
                 "histograms": {n: h.as_dict()
                                for n, h in sorted(self._hists.items())},
@@ -1552,8 +1600,9 @@ def doctor_registry(tree: dict) -> "dict | None":
 # rule table below walks each dumped stack innermost-out and returns the
 # first matching class (obs/threading frames are skipped, not classified —
 # a signal handler's own frames sit on top of the interrupted wait)
-AUTOPSY_CLASSES = ("budget-wait", "queue-get", "future-wait", "device-sync",
-                   "worker-idle", "lock-wait", "obs", "running")
+AUTOPSY_CLASSES = ("io-wait", "budget-wait", "queue-get", "future-wait",
+                   "device-sync", "worker-idle", "lock-wait", "obs",
+                   "running")
 
 
 def _classify_frames(frames) -> str:
@@ -1563,6 +1612,12 @@ def _classify_frames(frames) -> str:
     for f in reversed(frames or []):  # innermost first
         path = str(f.get("file", "")).replace("\\", "/")
         func = str(f.get("func", ""))
+        if path.endswith("tpu_parquet/iostore.py"):
+            # blocked inside the IO backend (a stalled fetch, an injected
+            # stall, a backoff sleep): the network-stall verdict's signal —
+            # checked before the generic waits because the stalled worker's
+            # INNERMOST frames are an Event/sleep in threading.py
+            return "io-wait"
         if path.endswith("tpu_parquet/alloc.py") and func in (
                 "acquire", "try_acquire"):
             return "budget-wait"
@@ -1630,8 +1685,30 @@ def autopsy_dump(doc: dict) -> dict:
                   default=0.0)
     dead = [t["name"] for t in threads_out.values() if not t["alive"]]
     stalled_first = wd.get("stalled_first")
+    # the in-flight range of any IO store at dump time (iostore.IOStats
+    # registers itself as a flight source) — a stalled fetch's single most
+    # diagnostic fact
+    io_inflight = None
+    for label, s in sorted((doc.get("samples") or {}).items()):
+        if (label.startswith("iostore") and isinstance(s, dict)
+                and s.get("inflight_age_s")):
+            if io_inflight is None or (s["inflight_age_s"]
+                                       > io_inflight["age_s"]):
+                io_inflight = {"offset": s.get("inflight_offset"),
+                               "size": s.get("inflight_size"),
+                               "age_s": s.get("inflight_age_s")}
     # the rule table, most specific first
-    if classes.get("budget-wait") or waiters:
+    if classes.get("io-wait") or (io_inflight is not None
+                                  and wd.get("stalled_first")):
+        verdict = "network-stall"
+        where = (f" (offset {io_inflight['offset']}, "
+                 f"{io_inflight['size']} bytes, in flight "
+                 f"{io_inflight['age_s']:g}s)" if io_inflight else "")
+        cause = (f"a range fetch stalled in the IO backend{where} — the "
+                 f"store never returned and every lane behind it froze; "
+                 f"check the transport, or bound the fetch with "
+                 f"TPQ_IO_DEADLINE_S so retries can take over")
+    elif classes.get("budget-wait") or waiters:
         verdict = "budget-wait"
         cause = (f"submitter starved on InFlightBudget "
                  f"({max(waiters, classes.get('budget-wait', 0))} waiter(s), "
@@ -1667,6 +1744,7 @@ def autopsy_dump(doc: dict) -> dict:
         "threads": threads_out,
         "budget": {"waiters": waiters,
                    "longest_wait_s": round(longest, 3)} if budgets else None,
+        "io": io_inflight,
         "error": doc.get("error"),
         "verdict": verdict,
         "probable_cause": cause,
@@ -1689,6 +1767,14 @@ def note_worker_crash(exc: BaseException) -> None:
     rec = flight_recorder()
     rec.record("i", "worker_crash", time.perf_counter(), 0.0,
                {"type": type(exc).__name__, "msg": str(exc)[:200]})
+    from .errors import HangError
+
+    if isinstance(exc, HangError):
+        # the watchdog's own abort propagating through a worker: it
+        # already wrote the hang dump (mid-stall state, the one autopsy
+        # wants) — a second dump here would OVERWRITE it with a
+        # post-mortem taken after the stall cleared
+        return
     if os.environ.get("TPQ_FLIGHT") and not _crash_dump_done:
         _crash_dump_done = True
         try:

@@ -250,36 +250,31 @@ class PipelineStats:
 
 
 class SharedReader:
-    """Thread-safe positioned reads over one open byte source.
+    """Thread-safe positioned reads over one byte source, via a ByteStore.
 
-    Real files read via ``os.pread`` — fully parallel, and the shared fd's
-    position is never touched, so a main thread interleaving its own
-    seek+read (the page-pruning planner) stays correct.  Sources without a
-    usable fd (BytesIO, wrapped streams) fall back to a lock around
-    seek+read; ``parallel`` is False there so callers that ALSO seek the raw
-    object outside this class know to stay sequential.
+    Every read delegates to a :class:`tpu_parquet.iostore.ByteStore` —
+    :class:`~tpu_parquet.iostore.LocalStore` by default (``os.pread`` on
+    real files: fully parallel, the shared fd's position is never touched,
+    so a main thread interleaving its own seek+read — the page-pruning
+    planner — stays correct; a lock around seek+read for fd-less sources).
+    Passing a :class:`~tpu_parquet.iostore.GenericRangeStore` slots the
+    fault-tolerant retry/backoff/deadline core underneath the SAME reader
+    and pipeline stack — no decode layer sees the difference.
+    ``parallel`` is False on the locked path so callers that ALSO seek the
+    raw object outside this class know to stay sequential.
     """
 
-    def __init__(self, f):
+    def __init__(self, f, store=None):
         self._f = f
-        self._lock = threading.Lock()
-        self._fd: Optional[int] = None
-        try:
-            self._fd = f.fileno()
-        except Exception:  # noqa: BLE001 — io.UnsupportedOperation et al.
-            self._fd = None
-        if self._fd is not None:
-            # some file-likes expose a fileno that pread cannot serve (a
-            # pipe), and some platforms lack os.pread entirely (Windows);
-            # probe once and fall back to the locked path forever
-            try:
-                os.pread(self._fd, 0, 0)
-            except (OSError, AttributeError):
-                self._fd = None
+        if store is None:
+            from .iostore import LocalStore
+
+            store = LocalStore(f)
+        self.store = store
 
     @property
     def parallel(self) -> bool:
-        return self._fd is not None
+        return self.store.parallel
 
     def as_file(self) -> "_PReadFile":
         """A minimal file-like (seek/read pairs) whose every read goes
@@ -289,21 +284,7 @@ class SharedReader:
         return _PReadFile(self)
 
     def pread(self, offset: int, size: int) -> bytes:
-        if self._fd is not None:
-            parts = []
-            pos = offset
-            remaining = size
-            while remaining > 0:
-                b = os.pread(self._fd, remaining, pos)
-                if not b:
-                    break
-                parts.append(b)
-                pos += len(b)
-                remaining -= len(b)
-            return b"".join(parts) if len(parts) != 1 else parts[0]
-        with self._lock:
-            self._f.seek(offset)
-            return self._f.read(size)
+        return self.store.read_range(offset, size)
 
 
 class _PReadFile:

@@ -21,6 +21,7 @@ from .chunk_decode import ChunkDecoder, read_chunk, validate_chunk_meta
 from .column import ByteArrayData, ColumnData
 from .footer import ParquetError, read_file_metadata
 from .format import FileMetaData, Type
+from .iostore import CoalescedFetcher, require_full, resolve_store
 from .pipeline import PipelineStats, SharedReader, prefetch_map
 from .schema.core import Schema, SchemaNode
 
@@ -60,6 +61,7 @@ class FileReader:
         row_filter=None,
         prefetch: int = 0,
         trace=None,
+        store=None,
     ):
         from .obs import resolve_tracer
 
@@ -78,6 +80,14 @@ class FileReader:
         try:
             self.metadata = (metadata if metadata is not None
                              else read_file_metadata(self._f))
+            # the IO backend every chunk byte enters through (iostore.py):
+            # LocalStore by default (zero-overhead pread), a
+            # GenericRangeStore for fault-tolerant/remote reads.  A factory
+            # callable gets this reader's open file; an instance is the
+            # caller's (single-file use, caller owns/closes it).
+            self._owns_store = store is None or callable(store)
+            self._store = resolve_store(self._f, store)
+            self._sr = SharedReader(self._f, store=self._store)
             self.schema = Schema.from_file_metadata(self.metadata)
             self._preloaded: Optional[dict[str, ColumnData]] = None
             if columns is not None:
@@ -148,6 +158,8 @@ class FileReader:
     # -- context management ---------------------------------------------------
 
     def close(self):
+        if getattr(self, "_owns_store", False):
+            self._store.close()
         if self._owns_file:
             self._f.close()
         if self._owns_tracer:
@@ -156,12 +168,15 @@ class FileReader:
 
     def obs_registry(self):
         """This reader's unified metrics tree (obs.StatsRegistry): the
-        pipeline's per-stage sums + histograms and the alloc peak."""
+        pipeline's per-stage sums + histograms, the alloc peak, and the IO
+        backend's retry/coalescing counters when the store keeps any."""
         from .obs import StatsRegistry
 
         reg = StatsRegistry()
         reg.add_pipeline(self._pipe_stats)
         reg.note_alloc_peak(self.alloc)
+        if self._store.stats is not None:
+            reg.add_io(self._store.stats)
         return reg
 
     def __enter__(self):
@@ -227,7 +242,9 @@ class FileReader:
                               tracer=self._tracer)
         self._pipe_stats = stats
         budget = InFlightBudget(self.alloc.max_size)
-        sr = SharedReader(self._f)
+        sr = self._sr
+        store = self._store
+        store.begin_scan()  # fresh per-scan retry budget + coalescing state
         pending: dict[int, dict] = {}  # rg index -> regrouping slot
 
         def gen_items():
@@ -245,7 +262,21 @@ class FileReader:
                     leaf = by_path.get(path)
                     if leaf is None:
                         continue  # unselected: never read its bytes
-                    items.append((i, path, chunk, leaf))
+                    items.append([i, path, chunk, leaf, None])
+                # range coalescing (iostore.py): adjacent chunk reads of
+                # this group merge into fewer, larger, individually-
+                # retryable fetches — only for stores that ask for it
+                # (remote/fault-injecting; the local path pays nothing,
+                # not even the range collection below)
+                if (store.prefers_coalescing
+                        and not store.coalesce_disabled and len(items) > 1):
+                    ranges = []
+                    for it in items:
+                        _md, offset = validate_chunk_meta(it[2], it[3])
+                        ranges.append((offset, _md.total_compressed_size))
+                    fetcher = CoalescedFetcher(store, ranges)
+                    for it in items:
+                        it[4] = fetcher
                 pending[i] = {
                     "expect": {".".join(p) for p in by_path},
                     "todo": max(len(items), 1),
@@ -253,11 +284,11 @@ class FileReader:
                 }
                 if not items:
                     # sentinel so an empty group still finalizes in order
-                    items.append((i, None, None, None))
-                yield from items
+                    items.append([i, None, None, None, None])
+                yield from map(tuple, items)
 
         def chunk_cost(item):
-            _i, _path, chunk, _leaf = item
+            _i, _path, chunk, _leaf, _fetcher = item
             if chunk is None:
                 return 0
             md = chunk.meta_data
@@ -265,19 +296,18 @@ class FileReader:
             return comp + max(md.total_uncompressed_size or 0, comp)
 
         def decode_item(item):
-            i, path, chunk, leaf = item
+            i, path, chunk, leaf, fetcher = item
             if chunk is None:
                 return i, None, None
             md, offset = validate_chunk_meta(chunk, leaf)
             alloc = AllocTracker(self.alloc.max_size)
             alloc.register(md.total_compressed_size)
             with stats.timed("io"):
-                buf = sr.pread(offset, md.total_compressed_size)
-            if len(buf) != md.total_compressed_size:
-                raise ParquetError(
-                    f"chunk truncated: wanted {md.total_compressed_size} "
-                    f"bytes at {offset}, got {len(buf)}"
-                )
+                buf = (fetcher.read(offset, md.total_compressed_size)
+                       if fetcher is not None
+                       else sr.pread(offset, md.total_compressed_size))
+            require_full(buf, offset, md.total_compressed_size,
+                         context=f"column {'.'.join(path)}")
             with stats.timed("decompress"):
                 dec = ChunkDecoder(leaf, validate_crc=self.validate_crc,
                                    alloc=alloc)
@@ -326,6 +356,14 @@ class FileReader:
         leaves = self.schema.selected_leaves()
         by_path = {l.path: l for l in leaves}
         out: dict[str, ColumnData] = {}
+        # every byte enters through the store, sequential path included —
+        # the fault-tolerance (and fault-injection) contract covers
+        # prefetch=0 bit-identically.  begin_scan here means the "scan"
+        # unit on this path is one row group (a looser retry-budget bound
+        # than the pipelined whole-iteration scan, but bounded) — and a
+        # watchdog abort from a previous scan never poisons this one.
+        self._store.begin_scan()
+        f = self._sr.as_file()
         for chunk in rg.columns or []:
             md = chunk.meta_data
             if md is None or md.path_in_schema is None:
@@ -335,7 +373,7 @@ class FileReader:
             if leaf is None:
                 continue  # unselected: never read its bytes (skipChunk parity)
             out[".".join(path)] = read_chunk(
-                self._f, chunk, leaf,
+                f, chunk, leaf,
                 validate_crc=self.validate_crc, alloc=self.alloc,
             )
         missing = set(".".join(p) for p in by_path) - set(out)

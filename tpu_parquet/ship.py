@@ -113,11 +113,9 @@ class ShipPlanner:
     def __init__(self, link_mbps: "float | None" = None,
                  force: "str | None" = None):
         if link_mbps is None:
-            env = os.environ.get("TPQ_LINK_MBPS", "")
-            try:
-                link_mbps = float(env) if env else DEFAULT_LINK_MBPS
-            except ValueError:
-                link_mbps = DEFAULT_LINK_MBPS
+            from .obs import env_float
+
+            link_mbps = env_float("TPQ_LINK_MBPS", DEFAULT_LINK_MBPS)
         self.link_mbps = max(float(link_mbps), 1.0)
         if force is None:
             force = os.environ.get("TPQ_FORCE_ROUTE", "").strip() or None
