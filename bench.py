@@ -131,6 +131,10 @@ def _writer(path, schema, **kw):
 
     kw.setdefault("codec", CompressionCodec.SNAPPY)
     kw.setdefault("row_group_size", 128 << 20)
+    # CRC every page: the round-13 default-on validation tier
+    # (validate="crc") must actually exercise on every bench read, and the
+    # data_faults section needs checksummed pages to corrupt
+    kw.setdefault("write_crc", True)
     return FileWriter(path, schema, **kw)
 
 
@@ -796,6 +800,78 @@ def bench_io_faults(path, rows, reps=3):
     return out
 
 
+def bench_data_faults(path, rows, reps=3):
+    """Corruption-containment bench (ISSUE 8 acceptance gate), two halves:
+
+    - the clean path: the lineitem16 host decode with validation OFF vs the
+      round-13 default (``validate="crc"``; bench files carry CRCs) —
+      ``validate_overhead_ratio`` is the <1.03x guard the default-on tier
+      must hold;
+    - the dirty path: a copy of the file with ~1 corrupt page per 100 is
+      read under ``skip_unit`` — ``quarantined`` proves the faults fired
+      and were contained, ``faulty_s`` what a degraded scan costs.
+    """
+    import shutil
+
+    from tpu_parquet.reader import FileReader
+    from tpu_parquet.writer import corrupt_page
+
+    out = {"rows": rows}
+    for tag, validate in (("novalidate", False), ("validate", "crc")):
+        best = float("inf")
+        for i in range(reps):
+            t0 = time.perf_counter()
+            with FileReader(path, prefetch=4, validate_crc=validate) as r:
+                r.read_all()
+            dt = time.perf_counter() - t0
+            log(f"  data_faults {tag} rep {i}: {dt:.3f}s "
+                f"({rows/dt/1e6:.2f} M rows/s)")
+            best = min(best, dt)
+        out[f"{tag}_s"] = round(best, 3)
+        out[f"{tag}_rows_per_sec"] = round(rows / best, 1)
+    out["validate_overhead_ratio"] = round(
+        out["validate_s"] / out["novalidate_s"], 3)
+
+    dirty = path + ".corrupt"
+    shutil.copyfile(path, dirty)
+    try:
+        from tpu_parquet.footer import read_file_metadata
+
+        with open(dirty, "rb") as f:
+            md = read_file_metadata(f)
+        n_cols = len(md.row_groups[0].columns or [])
+        corrupted = 0
+        for gi in range(len(md.row_groups)):
+            # ~1 corrupt page per 100 columns-chunks, deterministic spread
+            for ci in range(n_cols):
+                if (gi * n_cols + ci) % 100 == 0:
+                    corrupt_page(dirty, row_group=gi, column=ci,
+                                 mode="bitflip", seed=gi * 131 + ci)
+                    corrupted += 1
+        best, q = float("inf"), None
+        for i in range(reps):
+            t0 = time.perf_counter()
+            with FileReader(dirty, prefetch=4,
+                            on_data_error="skip_unit") as r:
+                r.read_all()
+                q = r.quarantine
+            dt = time.perf_counter() - t0
+            log(f"  data_faults skip_unit rep {i}: {dt:.3f}s "
+                f"({q.units_skipped} unit(s) skipped)")
+            best = min(best, dt)
+        out["faulty_s"] = round(best, 3)
+        out["pages_corrupted"] = corrupted
+        out["quarantined"] = len(q.log)
+        out["units_skipped"] = q.units_skipped
+    finally:
+        os.unlink(dirty)
+    log(f"data_faults: validate overhead "
+        f"{out['validate_overhead_ratio']:.3f}x (gate <= 1.03), "
+        f"{out['quarantined']}/{out['pages_corrupted']} corruptions "
+        f"quarantined under skip_unit")
+    return out
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache (one implementation: the library's —
     device_reader._enable_compile_cache defers to an app-configured dir /
@@ -1063,7 +1139,7 @@ def main(argv=None):
         RESAMPLE = int(os.environ.get("BENCH_RESAMPLE", "0"))
         WHICH = os.environ.get("BENCH_CONFIGS", "1").split(",")
         for knob in ("BENCH_PIPELINE", "BENCH_LOADER", "BENCH_WRITES",
-                     "BENCH_PALLAS", "BENCH_IOFAULTS"):
+                     "BENCH_PALLAS", "BENCH_IOFAULTS", "BENCH_DATAFAULTS"):
             os.environ.setdefault(knob, "0")
         # the smoke/tier-1 gate path runs with the hang watchdog ARMED (a
         # generous deadline: it must never fire on a slow box, only on a
@@ -1319,6 +1395,15 @@ def main(argv=None):
             results["io_faults"] = bench_io_faults(ppath, prows)
         except Exception as e:  # noqa: BLE001
             log(f"io_faults bench FAILED: {e!r}")
+
+    # Corruption containment: default-on validation overhead (<1.03x gate)
+    # + seeded-corruption skip_unit accounting.  Skip with BENCH_DATAFAULTS=0.
+    if os.environ.get("BENCH_DATAFAULTS", "1") != "0" and not over_budget():
+        try:
+            ppath, prows = _config_file("4")
+            results["data_faults"] = bench_data_faults(ppath, prows)
+        except Exception as e:  # noqa: BLE001
+            log(f"data_faults bench FAILED: {e!r}")
 
     # Writer throughput (host encode; ~10s).  Skip with BENCH_WRITES=0.
     if os.environ.get("BENCH_WRITES", "1") != "0" and not over_budget():

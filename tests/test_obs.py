@@ -285,8 +285,9 @@ def _full_registry():
 def test_registry_tree_golden_keys():
     tree = _full_registry().as_dict()
     assert set(tree) == {"obs_version", "pipeline", "reader", "loader",
-                         "io", "alloc", "histograms"}
+                         "io", "data_errors", "alloc", "histograms"}
     assert tree["io"] is None  # no IO-backend stats were folded in
+    assert tree["data_errors"] is None  # no quarantine engine folded in
     assert tree["obs_version"] == OBS_VERSION
     assert tree["alloc"] == {"peak_bytes": 4096}
     assert set(tree["histograms"]) == {"stage.io", "stage.stage"}
@@ -428,6 +429,7 @@ def test_loader_stats_as_dict_golden_keys():
     assert set(d) == {
         "batches", "rows", "epochs_completed", "padded_batches",
         "wall_seconds", "decode_wait_seconds", "window_peak_rows",
+        "data_errors", "units_skipped", "rows_skipped",
         "rows_per_sec", "batches_per_sec", "pipeline",
     }
 

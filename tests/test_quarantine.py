@@ -1,0 +1,831 @@
+"""Corruption-containment test family (ISSUE 8).
+
+The contract under test: a data fault (corrupt page, bad CRC, truncated
+chunk) is (1) DETECTED by the default-on integrity tier, (2) NAMED — file,
+column, row group, page ordinal, byte offset ride the exception and the
+quarantine record, (3) CONTAINED under the error policy — skipped units
+with exact accounting, bounded by the error budget, (4) DETERMINISTIC —
+surviving rows are bit-identical to the clean read of the unaffected
+units at every prefetch depth, and a mid-epoch loader checkpoint taken
+after a skip resumes bit-identically.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_parquet.errors import DataIntegrityError, ParquetError
+from tpu_parquet.quarantine import (
+    ErrorBudget, Quarantine, QuarantineLog, annotate_data_error,
+    corrupt_bytes, error_context, resolve_policy, resolve_validate,
+    summarize_quarantine_log,
+)
+
+
+# ---------------------------------------------------------------------------
+# fixtures: a small CRC'd multi-group file (+ a corrupted copy helper)
+# ---------------------------------------------------------------------------
+
+N_GROUPS = 5
+ROWS_PER_GROUP = 400
+
+
+def _write_file(path, codec=None, write_crc=True, groups=N_GROUPS,
+                rows=ROWS_PER_GROUP, seed=0):
+    from tpu_parquet.format import (
+        CompressionCodec, FieldRepetitionType as FRT, Type,
+    )
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.writer import FileWriter
+
+    codec = CompressionCodec.SNAPPY if codec is None else codec
+    rng = np.random.default_rng(seed)
+    schema = build_schema([
+        data_column("a", Type.INT64, FRT.REQUIRED),
+        data_column("b", Type.INT32, FRT.REQUIRED),
+    ])
+    with FileWriter(str(path), schema, codec=codec, write_crc=write_crc,
+                    use_dictionary=False) as w:
+        for _ in range(groups):
+            w.write_columns({
+                "a": rng.integers(0, 1 << 50, rows),
+                "b": rng.integers(0, 1 << 20, rows).astype(np.int32),
+            })
+            w.flush_row_group()
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def clean_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("quarantine")
+    path = _write_file(d / "clean.parquet")
+    from tpu_parquet.reader import FileReader
+
+    with FileReader(path) as r:
+        groups = [{k: np.asarray(v.values)
+                   for k, v in r.read_row_group(i).items()}
+                  for i in range(r.num_row_groups)]
+    return path, groups
+
+
+def _corrupted_copy(src, tmp_path, row_groups=(2,), mode="bitflip"):
+    import shutil
+
+    from tpu_parquet.writer import corrupt_page
+
+    dst = str(tmp_path / "corrupt.parquet")
+    shutil.copyfile(src, dst)
+    for gi in row_groups:
+        corrupt_page(dst, row_group=gi, column=0, page=0, mode=mode,
+                     seed=gi)
+    return dst
+
+
+# ---------------------------------------------------------------------------
+# policy / validate / budget resolution
+# ---------------------------------------------------------------------------
+
+def test_resolve_policy_kwarg_and_env(monkeypatch):
+    assert resolve_policy(None) == "raise"
+    assert resolve_policy("skip_unit") == "skip_unit"
+    with pytest.raises(ValueError):
+        resolve_policy("skip_units")  # kwarg typos are strict
+    monkeypatch.setenv("TPQ_ON_DATA_ERROR", "skip_file")
+    assert resolve_policy(None) == "skip_file"
+    monkeypatch.setenv("TPQ_ON_DATA_ERROR", "bogus")
+    assert resolve_policy(None) == "raise"  # env typos degrade
+
+
+def test_resolve_validate(monkeypatch):
+    assert resolve_validate(None) is True  # the round-13 default: crc
+    assert resolve_validate(False) is False
+    assert resolve_validate(True) is True
+    assert resolve_validate("off") is False
+    assert resolve_validate("crc") is True
+    with pytest.raises(ValueError):
+        resolve_validate("maybe")
+    monkeypatch.setenv("TPQ_VALIDATE", "off")
+    assert resolve_validate(None) is False
+    monkeypatch.setenv("TPQ_VALIDATE", "nonsense")
+    assert resolve_validate(None) is True  # env typos degrade to default
+
+
+def test_error_budget_env(monkeypatch):
+    b = ErrorBudget.from_env()
+    assert b.max_errors == 64 and b.max_fraction == 0.5
+    monkeypatch.setenv("TPQ_DATA_ERROR_BUDGET", "10")
+    assert ErrorBudget.from_env().max_errors == 10
+    monkeypatch.setenv("TPQ_DATA_ERROR_BUDGET", "10,0.25")
+    b = ErrorBudget.from_env()
+    assert b.max_errors == 10 and b.max_fraction == 0.25
+    assert b.allowed(100) == 10
+    assert b.allowed(8) == 2
+    assert b.allowed(None) == 10
+    monkeypatch.setenv("TPQ_DATA_ERROR_BUDGET", "garbage")
+    assert ErrorBudget.from_env().max_errors == 64
+
+
+# ---------------------------------------------------------------------------
+# annotation + corruption primitives
+# ---------------------------------------------------------------------------
+
+def test_annotate_nests_once_inner_wins():
+    e = ParquetError("page CRC mismatch: header 0x1, data 0x2")
+    annotate_data_error(e, page=3, offset=100)
+    annotate_data_error(e, file="f.parquet", column="a", page=999)
+    msg = str(e)
+    assert msg.count("[") == 1  # ONE suffix, not one per annotation
+    assert "page=3" in msg and "page=999" not in msg  # inner wins
+    assert "file=f.parquet" in msg and "column=a" in msg
+    assert e.data_context["offset"] == 100
+
+
+def test_error_context_passthrough():
+    with pytest.raises(ParquetError) as ei:
+        with error_context(file="x", row_group=1):
+            raise ParquetError("boom")
+    assert ei.value.data_context == {"file": "x", "row_group": 1}
+    # non-ParquetError passes through untouched
+    with pytest.raises(KeyError):
+        with error_context(file="x"):
+            raise KeyError("y")
+
+
+def test_corrupt_bytes_deterministic_and_modes():
+    data = bytes(range(256)) * 4
+    for mode in ("bitflip", "zero", "truncate"):
+        a = corrupt_bytes(data, mode, seed=7)
+        b = corrupt_bytes(data, mode, seed=7)
+        assert a == b and len(a) == len(data)
+    assert corrupt_bytes(data, "bitflip", 1) != corrupt_bytes(data, "bitflip", 2)
+    assert corrupt_bytes(data, "bitflip", 1) != data  # always changes
+    assert corrupt_bytes(b"", "bitflip", 1) == b""
+    with pytest.raises(ValueError):
+        corrupt_bytes(data, "nuke", 0)
+
+
+def test_quarantine_budget_exhaustion_carries_records():
+    q = Quarantine("skip_unit", budget=ErrorBudget(2, 1.0))
+    q.begin_scan(100)
+    q.note(ParquetError("one"), file="f", row_group=0)
+    q.note(ParquetError("two"), file="f", row_group=1)
+    with pytest.raises(DataIntegrityError) as ei:
+        q.note(ParquetError("three"), file="f", row_group=2)
+    assert len(ei.value.records) == 3
+    assert [r["row_group"] for r in ei.value.records] == [0, 1, 2]
+    assert "budget exhausted" in str(ei.value)
+
+
+def test_quarantine_jsonl_log(tmp_path):
+    p = str(tmp_path / "quarantine.jsonl")
+    q = Quarantine("skip_unit", log=QuarantineLog(p))
+    q.begin_scan(10)
+    e = annotate_data_error(ParquetError("bad page"), file="f.parquet",
+                            column="a", row_group=2, page=1, offset=1234)
+    q.note(e)
+    with open(p) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    assert recs == [{
+        "file": "f.parquet", "column": "a", "row_group": 2, "page": 1,
+        "offset": 1234, "error": "ParquetError",
+        "message": str(e)[:500],
+    }]
+
+
+# ---------------------------------------------------------------------------
+# default-on validation tier
+# ---------------------------------------------------------------------------
+
+def test_crc_default_on_catches_silent_flip(tmp_path):
+    """UNCOMPRESSED + a payload bitflip: without CRC the decode would
+    succeed silently with wrong data — the round-13 default catches it and
+    names file/column/row group/page in the message (the _check_crc
+    satellite)."""
+    from tpu_parquet.format import CompressionCodec
+    from tpu_parquet.reader import FileReader
+    from tpu_parquet.writer import corrupt_page
+
+    path = _write_file(tmp_path / "plain.parquet",
+                       codec=CompressionCodec.UNCOMPRESSED)
+    off, _n = corrupt_page(path, row_group=1, column=0, page=0,
+                           mode="bitflip", seed=3)
+    with pytest.raises(ParquetError) as ei:
+        with FileReader(path) as r:
+            r.read_all()
+    msg = str(ei.value)
+    assert "CRC mismatch" in msg
+    assert "plain.parquet" in msg and "column=a" in msg
+    assert "row_group=1" in msg and "page=0" in msg and "offset=" in msg
+    ctx = ei.value.data_context
+    assert ctx["row_group"] == 1 and ctx["column"] == "a"
+    # validate_crc=False: the flip decodes silently (proving the default
+    # actually changed behavior, not just the message)
+    with FileReader(path, validate_crc=False) as r:
+        out = r.read_all()
+    assert len(out["a"].values) == N_GROUPS * ROWS_PER_GROUP
+
+
+# ---------------------------------------------------------------------------
+# the corrupt-unit fault matrix: policy x prefetch, host reader
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+@pytest.mark.parametrize("policy", ["raise", "skip_unit", "skip_file"])
+def test_host_reader_fault_matrix(clean_file, tmp_path, policy, prefetch):
+    from tpu_parquet.reader import FileReader
+
+    src, clean_groups = clean_file
+    path = _corrupted_copy(src, tmp_path, row_groups=(2,))
+    if policy == "raise":
+        with pytest.raises(ParquetError) as ei:
+            with FileReader(path, prefetch=prefetch) as r:
+                list(r.iter_row_groups())
+        assert "row_group=2" in str(ei.value)
+        return
+    with FileReader(path, prefetch=prefetch, on_data_error=policy) as r:
+        got = list(r.iter_row_groups())
+        q = r.quarantine
+    expect = ([0, 1, 3, 4] if policy == "skip_unit" else [0, 1])
+    assert len(got) == len(expect)
+    for out, gi in zip(got, expect):
+        # surviving rows bit-identical to the clean read of that unit
+        for k, want in clean_groups[gi].items():
+            assert np.array_equal(np.asarray(out[k].values), want), (gi, k)
+    recs = q.log.snapshot()
+    assert len(recs) == 1 and recs[0]["row_group"] == 2
+    assert recs[0]["column"] == "a" and recs[0]["error"] == "ParquetError"
+    assert q.units_skipped == (1 if policy == "skip_unit" else 3)
+    assert q.files_skipped == (0 if policy == "skip_unit" else 1)
+
+
+def test_host_reader_budget_exhaustion(clean_file, tmp_path):
+    from tpu_parquet.reader import FileReader
+
+    src, _ = clean_file
+    path = _corrupted_copy(src, tmp_path, row_groups=(1, 3))
+    q = Quarantine("skip_unit", budget=ErrorBudget(1, 1.0))
+    with pytest.raises(DataIntegrityError) as ei:
+        with FileReader(path, prefetch=0, quarantine=q) as r:
+            list(r.iter_row_groups())
+    assert len(ei.value.records) == 2
+    assert [r["row_group"] for r in ei.value.records] == [1, 3]
+
+
+def test_registry_data_errors_section(clean_file, tmp_path):
+    from tpu_parquet.reader import FileReader
+
+    src, _ = clean_file
+    path = _corrupted_copy(src, tmp_path, row_groups=(2,))
+    with FileReader(path, on_data_error="skip_unit") as r:
+        r.read_all()
+        tree = r.obs_registry().as_dict()
+    de = tree["data_errors"]
+    assert de["errors"] == 1 and de["units_skipped"] == 1
+    assert de["rows_skipped"] == ROWS_PER_GROUP
+    assert de["by_class"] == {"ParquetError": 1}
+
+
+def test_explicit_read_row_group_always_raises(clean_file, tmp_path):
+    """The skip policy belongs to the ITERATION APIs: an explicitly
+    requested row group must raise, not silently skip itself."""
+    from tpu_parquet.reader import FileReader
+
+    src, _ = clean_file
+    path = _corrupted_copy(src, tmp_path, row_groups=(2,))
+    for prefetch in (0, 4):
+        with FileReader(path, on_data_error="skip_unit",
+                        prefetch=prefetch) as r:
+            assert len(r.read_row_group(1)["a"].values) == ROWS_PER_GROUP
+            with pytest.raises(ParquetError):
+                r.read_row_group(2)
+
+
+# ---------------------------------------------------------------------------
+# fault-injecting store corruption modes (no file mutation)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["bitflip", "zero", "truncate"])
+def test_store_corruption_modes_quarantined(clean_file, mode):
+    """FaultInjectingStore payload corruption: the transport sees a clean
+    full-length read, the integrity tier catches the damage, the policy
+    engine contains it — and unmatched ranges stay bit-identical."""
+    from tpu_parquet.iostore import FaultInjectingStore, FaultSpec, IOConfig, LocalStore
+    from tpu_parquet.reader import FileReader
+
+    src, clean_groups = clean_file
+    # target row group 2's byte span via the footer
+    from tpu_parquet.chunk_decode import validate_chunk_meta
+    from tpu_parquet.footer import read_file_metadata
+    from tpu_parquet.schema.core import Schema
+
+    with open(src, "rb") as f:
+        md = read_file_metadata(f)
+    schema = Schema.from_file_metadata(md)
+    leaves = {l.path: l for l in schema.leaves}
+    spans = []
+    for rg in md.row_groups:
+        lo, hi = 1 << 62, 0
+        for cc in rg.columns:
+            cmd, off = validate_chunk_meta(
+                cc, leaves[tuple(cc.meta_data.path_in_schema)])
+            lo, hi = min(lo, off), max(hi, off + cmd.total_compressed_size)
+        spans.append((lo, hi))
+    lo2, hi2 = spans[2]
+    spec = FaultSpec(corrupt=mode, corrupt_seed=5,
+                     match=lambda off, size: lo2 <= off < hi2)
+    cfg = IOConfig(retries=0, backoff_ms=0, retry_budget=0, coalesce_gap=0)
+    for prefetch in (0, 4):
+        store = None
+        with FileReader(src, prefetch=prefetch, on_data_error="skip_unit",
+                        store=lambda f: FaultInjectingStore(
+                            LocalStore(f), spec, config=cfg)) as r:
+            got = list(r.iter_row_groups())
+            q = r.quarantine
+        assert len(got) == 4, mode
+        for out, gi in zip(got, [0, 1, 3, 4]):
+            for k, want in clean_groups[gi].items():
+                assert np.array_equal(np.asarray(out[k].values), want)
+        assert [rec["row_group"] for rec in q.log.snapshot()] == [2]
+
+
+# ---------------------------------------------------------------------------
+# device reader + scan_files
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("prefetch", [0, 2])
+def test_device_reader_skip_unit(clean_file, tmp_path, prefetch):
+    from tpu_parquet.device_reader import DeviceFileReader
+
+    src, clean_groups = clean_file
+    path = _corrupted_copy(src, tmp_path, row_groups=(1,))
+    with DeviceFileReader(path, on_data_error="skip_unit",
+                          prefetch=prefetch) as r:
+        got = list(r.iter_row_groups())
+        q = r.quarantine
+    assert len(got) == N_GROUPS - 1
+    for out, gi in zip(got, [0, 2, 3, 4]):
+        for k, want in clean_groups[gi].items():
+            arr = np.asarray(out[k].values)[:out[k].num_leaf_slots]
+            assert np.array_equal(arr, want), (gi, k)
+    recs = q.log.snapshot()
+    assert len(recs) == 1 and recs[0]["row_group"] == 1
+    assert q.units_skipped == 1
+
+
+def test_scan_files_skip_file_and_shared_engine(clean_file, tmp_path):
+    """Multi-file scan: one engine spans files; skip_file drops the bad
+    file's REMAINING groups and the other file survives bit-identically."""
+    from tpu_parquet.device_reader import scan_files
+
+    src, clean_groups = clean_file
+    bad = _corrupted_copy(src, tmp_path, row_groups=(1,))
+    good = src
+    q = Quarantine("skip_file")
+    got = list(scan_files([bad, good], with_path=True, quarantine=q))
+    by_path = {}
+    for pp, out in got:
+        by_path.setdefault(pp, []).append(out)
+    # bad file: group 0 survived, 1..4 dropped (1 failed, rest collateral)
+    assert len(by_path.get(bad, [])) == 1
+    assert len(by_path.get(good, [])) == N_GROUPS
+    for out, want in zip(by_path[good], clean_groups):
+        for k, arr in want.items():
+            got_arr = np.asarray(out[k].values)[:out[k].num_leaf_slots]
+            assert np.array_equal(got_arr, arr)
+    assert len(q.log) == 1 and q.files_skipped == 1
+    assert q.units_skipped == N_GROUPS - 1  # 1 failed + 3 collateral + 0
+
+
+# ---------------------------------------------------------------------------
+# DataLoader: the e2e containment proof
+# ---------------------------------------------------------------------------
+
+BS = 128
+
+
+@pytest.fixture(scope="module")
+def loader_dataset(tmp_path_factory):
+    """4 files x 4 row groups (16 units, ~1% of pages corrupted = 2 of
+    ~32 pages across 2 distinct units) + the per-unit clean arrays."""
+    d = tmp_path_factory.mktemp("loader_q")
+    paths = [
+        _write_file(d / f"part{fi}.parquet", groups=4, rows=300, seed=fi)
+        for fi in range(4)
+    ]
+    from tpu_parquet.reader import FileReader
+
+    clean_units = {}
+    for fi, p in enumerate(paths):
+        with FileReader(p) as r:
+            for gi in range(r.num_row_groups):
+                clean_units[(fi, gi)] = {
+                    k: np.asarray(v.values)
+                    for k, v in r.read_row_group(gi).items()}
+    return paths, clean_units
+
+
+def _corrupt_loader_copy(paths, tmp_path, bad=((1, 2), (3, 0))):
+    import shutil
+
+    from tpu_parquet.writer import corrupt_page
+
+    out = []
+    for fi, p in enumerate(paths):
+        dst = str(tmp_path / os.path.basename(p))
+        shutil.copyfile(p, dst)
+        out.append(dst)
+    for fi, gi in bad:
+        corrupt_page(out[fi], row_group=gi, column=0, page=0,
+                     mode="bitflip", seed=fi * 7 + gi)
+    return out
+
+
+def _loader(paths, **kw):
+    from tpu_parquet.data import DataLoader
+
+    kw.setdefault("seed", 11)
+    kw.setdefault("shuffle", True)
+    kw.setdefault("shuffle_window", 512)
+    return DataLoader(paths, BS, **kw)
+
+
+def test_loader_e2e_containment_proof(loader_dataset, tmp_path):
+    """The ISSUE 8 acceptance e2e: a seeded dataset with corrupted pages
+    completes a full epoch under skip_unit with (a) exact quarantine
+    accounting, (b) clean-unit batches bit-identical to an uncorrupted
+    run's corresponding batches, (c) save->restore mid-epoch after a skip
+    replaying identically — at prefetch {0, 4}."""
+    paths, clean_units = loader_dataset
+    bad = ((1, 2), (3, 0))
+    dirty = _corrupt_loader_copy(paths, tmp_path, bad=bad)
+    bad_rows = sum(len(clean_units[u]["a"]) for u in bad)
+
+    # the reference stream: the CLEAN dataset with the bad units' rows
+    # surgically excluded — what a contained run must reproduce exactly.
+    # Same file basenames (the digest is path-independent) so the plan and
+    # the block permutations match the dirty run's.
+    runs = {}
+    for prefetch in (0, 4):
+        ld = _loader(dirty, prefetch=prefetch, on_data_error="skip_unit")
+        batches = list(ld)
+        st = ld.stats()
+        # (a) exact accounting: both injected corruptions recorded, nothing
+        # else; skipped rows match the two units' footers
+        recs = ld._quarantine.log.snapshot()
+        assert sorted((r["file"], r["row_group"]) for r in recs) == sorted(
+            (dirty[fi], gi) for fi, gi in bad)
+        assert all(r["error"] == "ParquetError" and r["page"] == 0
+                   for r in recs)
+        assert st.units_skipped == 2 and st.rows_skipped == bad_rows
+        assert st.data_errors == 2
+        assert st.rows == 16 * 300 - bad_rows
+        runs[prefetch] = batches
+    # deterministic across prefetch depths
+    assert len(runs[0]) == len(runs[4])
+    for a, b in zip(runs[0], runs[4]):
+        for k in a:
+            assert np.array_equal(np.asarray(a[k]), np.asarray(b[k]))
+    # (b) every surviving row is a clean-unit row, bit-identical: the
+    # multiset of yielded 'a' values == the clean units' minus the bad ones
+    got = np.concatenate([np.asarray(b["a"])[np.asarray(b["mask"])]
+                          for b in runs[0]])
+    want = np.concatenate([arr["a"] for u, arr in sorted(clean_units.items())
+                           if u not in bad])
+    assert np.array_equal(np.sort(got), np.sort(want))
+
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+def test_loader_resume_after_skip_bit_identical(loader_dataset, tmp_path,
+                                                prefetch):
+    paths, _clean = loader_dataset
+    dirty = _corrupt_loader_copy(paths, tmp_path)
+    ld = _loader(dirty, prefetch=prefetch, on_data_error="skip_unit")
+    it = iter(ld)
+    pre = [next(it) for _ in range(24)]  # far enough to pass a skip
+    state = ld.state_blob()
+    skips_at_ckpt = ld.state()["skipped_units"]
+    rest = list(it)
+    ld2 = _loader(dirty, prefetch=prefetch, on_data_error="skip_unit")
+    ld2.restore(state)
+    assert sorted(ld2._skipped_units) == skips_at_ckpt
+    rest2 = list(ld2)
+    assert len(rest) == len(rest2)
+    for a, b in zip(rest, rest2):
+        for k in a:
+            assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), k
+    # the epoch after the resumed one also lines up with the original's
+    nxt, nxt2 = list(ld), list(ld2)
+    assert len(nxt) == len(nxt2)
+    for a, b in zip(nxt, nxt2):
+        for k in a:
+            assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), k
+
+
+def test_loader_skip_file_checkpoint_carries_bad_files(loader_dataset,
+                                                       tmp_path):
+    """skip_file mid-epoch: the blob carries the bad-file marking, so a
+    restored run drops the bad file's LATER units exactly like the
+    uninterrupted one."""
+    paths, _clean = loader_dataset
+    dirty = _corrupt_loader_copy(paths, tmp_path, bad=((1, 2),))
+    ld = _loader(dirty, on_data_error="skip_file")
+    it = iter(ld)
+    pre = []
+    # step until the skip happened, then a couple more batches
+    while ld.stats().units_skipped == 0:
+        pre.append(next(it))
+    pre.append(next(it))
+    state = ld.state()
+    assert state["skipped_files"] == [1]
+    rest = list(it)
+    ld2 = _loader(dirty, on_data_error="skip_file")
+    ld2.restore(ld.state() if False else state)  # dict form round-trip
+    assert ld2._bad_files == {1}
+    rest2 = list(ld2)
+    assert len(rest) == len(rest2)
+    for a, b in zip(rest, rest2):
+        for k in a:
+            assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), k
+
+
+def test_loader_skip_file_wholly_corrupt_costs_one_record(loader_dataset,
+                                                          tmp_path):
+    """skip_file over a file whose EVERY unit is corrupt: one record (the
+    first failure), the rest are collateral skips — no budget charge, so
+    even a tiny budget survives (review finding: later failing units of an
+    already-bad file must not re-note)."""
+    paths, _clean = loader_dataset
+    dirty = _corrupt_loader_copy(paths, tmp_path,
+                                 bad=tuple((1, g) for g in range(4)))
+    q = Quarantine("skip_file", budget=ErrorBudget(1, 1.0))
+    ld = _loader(dirty, on_data_error=None, quarantine=q)
+    list(ld)
+    assert len(q.log) == 1
+    assert ld.stats().units_skipped == 4
+    assert ld.stats().rows == 12 * 300
+
+
+def test_loader_contains_corruption_surfacing_as_typeerror(loader_dataset,
+                                                           tmp_path,
+                                                           monkeypatch):
+    """A corruption the CRC tier cannot see can surface as the null-free
+    contract TypeError in _decode_unit — it must be contained, not kill
+    the epoch (review finding: the seam caught only ParquetError)."""
+    from tpu_parquet import reader as reader_mod
+
+    paths, _clean = loader_dataset
+    real = reader_mod.FileReader.read_row_group
+    state = {"fired": False}
+
+    def fake(self, index, prefetch=None):
+        if not state["fired"]:
+            state["fired"] = True  # the first-decoded unit "has nulls"
+            raise TypeError(
+                "DataLoader needs null-free columns; 'a' has 3 nulls")
+        return real(self, index, prefetch=prefetch)
+
+    monkeypatch.setattr(reader_mod.FileReader, "read_row_group", fake)
+    ld = _loader(paths, on_data_error="skip_unit")
+    list(ld)
+    assert ld.stats().units_skipped == 1
+    recs = ld._quarantine.log.snapshot()
+    assert len(recs) == 1 and recs[0]["error"] == "TypeError"
+
+
+def test_loader_budget_exhaustion_aborts(loader_dataset, tmp_path):
+    paths, _clean = loader_dataset
+    dirty = _corrupt_loader_copy(paths, tmp_path)  # 2 corrupt units
+    q = Quarantine("skip_unit", budget=ErrorBudget(1, 1.0))
+    ld = _loader(dirty, on_data_error=None, quarantine=q)
+    with pytest.raises(DataIntegrityError) as ei:
+        list(ld)
+    assert len(ei.value.records) == 2
+
+
+def test_loader_raise_policy_unchanged(loader_dataset, tmp_path):
+    paths, _clean = loader_dataset
+    dirty = _corrupt_loader_copy(paths, tmp_path)
+    with pytest.raises(ParquetError):
+        list(_loader(dirty))
+
+
+def test_checkpoint_skip_fields_validation(loader_dataset, tmp_path):
+    """Tampered skip fields refuse loudly (CheckpointError), and
+    pre-round-13 blobs (no skip fields) still restore."""
+    from tpu_parquet.data.checkpoint import pack_state, unpack_state
+    from tpu_parquet.errors import CheckpointError
+
+    paths, _clean = loader_dataset
+    ld = _loader(paths, on_data_error="skip_unit")
+    st = ld.state()
+    # pre-round-13 blob shape: no skip fields at all
+    legacy = {k: v for k, v in st.items()
+              if k not in ("skipped_units", "skipped_rows", "skipped_files")}
+    ld2 = _loader(paths, on_data_error="skip_unit")
+    ld2.restore(pack_state(legacy))
+    assert ld2._skipped_units == set()
+    for tamper in (
+        {"skipped_units": [3, 1]},                  # unsorted
+        {"skipped_units": [1, 1]},                  # duplicate
+        {"skipped_units": [99999]},                 # out of range
+        {"skipped_units": ["1"]},                   # wrong type
+        {"skipped_units": [1], "skipped_rows": 7},  # row-sum mismatch
+        {"skipped_rows": -1},
+        {"skipped_files": [2, 0]},                  # unsorted
+        {"skipped_files": [99]},                    # no such file
+    ):
+        bad = dict(st)
+        bad.update(tamper)
+        with pytest.raises(CheckpointError):
+            _loader(paths).restore(bad)
+    # a cursor at shard_rows - skipped_rows (epoch tail after a skip) packs
+    u0 = int(ld._my_units[0])
+    rows0 = int(ld._unit_rows_all[u0])
+    tail = dict(st)
+    tail.update(skipped_units=[u0], skipped_rows=rows0,
+                rows_taken=st["shard_rows"] - rows0)
+    unpack_state(pack_state(tail))
+
+
+# ---------------------------------------------------------------------------
+# kwarg propagation: validate_crc / on_data_error reach every decode seam
+# ---------------------------------------------------------------------------
+
+def _host_read(path, **kw):
+    from tpu_parquet.reader import FileReader
+
+    with FileReader(path, **kw) as r:
+        groups = list(r.iter_row_groups())
+        return sum(len(g["a"].values) for g in groups), r.quarantine
+
+
+def _host_read_prefetch(path, **kw):
+    return _host_read(path, prefetch=4, **kw)
+
+
+def _device_read(path, **kw):
+    from tpu_parquet.device_reader import DeviceFileReader
+
+    with DeviceFileReader(path, **kw) as r:
+        groups = list(r.iter_row_groups())
+        return sum(g["a"].num_leaf_slots for g in groups), r.quarantine
+
+
+def _device_read_prefetch(path, **kw):
+    return _device_read(path, prefetch=2, **kw)
+
+
+def _scan(path, **kw):
+    from tpu_parquet.device_reader import scan_files
+
+    q = Quarantine(kw.pop("on_data_error", None))
+    groups = list(scan_files([path], quarantine=q, **kw))
+    return sum(g["a"].num_leaf_slots for g in groups), q
+
+
+def _loader_read(path, **kw):
+    from tpu_parquet.data import DataLoader
+
+    ld = DataLoader(path, 64, shuffle=False, **kw)
+    list(ld)
+    return ld.stats().rows, ld._quarantine
+
+
+@pytest.mark.parametrize("api", [
+    _host_read, _host_read_prefetch, _device_read, _device_read_prefetch,
+    _scan, _loader_read,
+], ids=["host", "host_prefetch", "device", "device_prefetch", "scan",
+        "loader"])
+def test_kwarg_propagation_table(tmp_path, api):
+    """Table-driven: every public decode surface (1) validates CRCs by
+    default, (2) decodes the corruption silently with validate_crc=False
+    (UNCOMPRESSED flips are undetectable without the checksum), and
+    (3) honors on_data_error=skip_unit end to end."""
+    from tpu_parquet.format import CompressionCodec
+    from tpu_parquet.writer import corrupt_page
+
+    path = _write_file(tmp_path / "plain.parquet",
+                       codec=CompressionCodec.UNCOMPRESSED, groups=3,
+                       rows=200)
+    corrupt_page(path, row_group=1, column=0, page=0, mode="bitflip",
+                 seed=1)
+    with pytest.raises(ParquetError):
+        api(path)
+    rows, _q = api(path, validate_crc=False)
+    assert rows == 600  # silent: only the CRC tier could have caught it
+    rows, q = api(path, on_data_error="skip_unit")
+    assert rows == 400
+    assert [r["row_group"] for r in q.log.snapshot()] == [1]
+
+
+# ---------------------------------------------------------------------------
+# observability: flight dump + autopsy verdict + pq_tool quarantine
+# ---------------------------------------------------------------------------
+
+def test_autopsy_data_corruption_verdict(clean_file, tmp_path):
+    """A dump taken after quarantined failures autopsies to the
+    data-corruption verdict naming the first bad (file, column, page).
+    (Engines register as WEAK flight sources, so other live engines from
+    this test session may contribute counts — the named first-bad record
+    is asserted structurally, not by exact identity.)"""
+    import io
+
+    from tpu_parquet.cli import pq_tool
+    from tpu_parquet.obs import autopsy_dump, flight_recorder
+    from tpu_parquet.reader import FileReader
+
+    src, _ = clean_file
+    path = _corrupted_copy(src, tmp_path, row_groups=(2,))
+    with FileReader(path, on_data_error="skip_unit") as r:
+        r.read_all()
+        doc = flight_recorder().snapshot(reason="test")
+    rep = autopsy_dump(doc)
+    assert rep["verdict"] == "data-corruption"
+    assert rep["data_errors"]["errors"] >= 1
+    first = rep["data_errors"]["first"]
+    assert first and first["column"] in ("a", "b")
+    assert "row_group" in first and first["error"] == "ParquetError"
+    assert "first bad" in rep["probable_cause"]
+    # the CLI prints the data line + verdict
+    dump_path = str(tmp_path / "dump.json")
+    with open(dump_path, "w") as f:
+        json.dump(doc, f, default=repr)
+    out = io.StringIO()
+    rc = pq_tool.cmd_autopsy(type("A", (), {"file": dump_path})(), out=out)
+    assert rc == 0
+    text = out.getvalue()
+    assert "verdict: data-corruption" in text
+    assert "quarantined error(s)" in text
+
+
+def test_pq_tool_quarantine_summary(tmp_path):
+    import io
+
+    from tpu_parquet.cli import pq_tool
+
+    p = str(tmp_path / "q.jsonl")
+    log = QuarantineLog(p)
+    q = Quarantine("skip_unit", log=log)
+    q.begin_scan(10)
+    for gi, col in ((1, "a"), (1, "b"), (4, "a")):
+        e = annotate_data_error(ParquetError(f"bad {gi}.{col}"),
+                                file=f"part{gi % 2}.parquet", column=col,
+                                row_group=gi, page=0, offset=10)
+        q.note(e)
+    out = io.StringIO()
+    rc = pq_tool.cmd_quarantine(type("A", (), {"file": p})(), out=out)
+    assert rc == 0
+    text = out.getvalue()
+    assert "3 record(s) across 2 file(s)" in text
+    assert "first bad: file 'part1.parquet' column 'a' row_group 1" in text
+    assert "by column" in text and "by error" in text
+    # summarize_quarantine_log shape
+    rep = summarize_quarantine_log(log.snapshot())
+    assert rep["records"] == 3 and rep["by_class"] == {"ParquetError": 3}
+    # unreadable path: exit 1
+    out = io.StringIO()
+    assert pq_tool.cmd_quarantine(
+        type("A", (), {"file": str(tmp_path / "nope.jsonl")})(),
+        out=out) == 1
+
+
+def test_quarantine_flight_instant(clean_file, tmp_path):
+    """Each contained failure emits a `quarantine` instant into the
+    always-on ring (the black-box trail a post-mortem replays)."""
+    from tpu_parquet.obs import flight_recorder
+    from tpu_parquet.reader import FileReader
+
+    src, _ = clean_file
+    path = _corrupted_copy(src, tmp_path, row_groups=(2,))
+    with FileReader(path, on_data_error="skip_unit") as r:
+        r.read_all()
+        doc = flight_recorder().snapshot(reason="test")
+    events = [ev for t in doc["threads"].values()
+              for ev in t["events"] if ev["name"] == "quarantine"]
+    assert events, "no quarantine instant in the ring"
+    assert any(ev.get("args", {}).get("row_group") == 2
+               and ev.get("args", {}).get("column") == "a"
+               for ev in events)
+
+
+# ---------------------------------------------------------------------------
+# writer helper
+# ---------------------------------------------------------------------------
+
+def test_corrupt_page_targets_named_page(tmp_path):
+    from tpu_parquet.reader import FileReader
+    from tpu_parquet.writer import corrupt_page
+
+    path = _write_file(tmp_path / "t.parquet", groups=3, rows=100)
+    off, n = corrupt_page(path, row_group=2, column="b", page=0,
+                          mode="zero", seed=4)
+    assert n > 0
+    with FileReader(path, on_data_error="skip_unit") as r:
+        r.read_all()
+        recs = r.quarantine.log.snapshot()
+    assert len(recs) == 1
+    assert recs[0]["row_group"] == 2 and recs[0]["column"] == "b"
+    with pytest.raises(KeyError):
+        corrupt_page(path, column="nope")

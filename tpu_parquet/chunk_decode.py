@@ -152,17 +152,28 @@ def _byte_stream_split_decode(raw: bytes, ptype: Type, count: int, type_length: 
 
 
 class ChunkDecoder:
-    """Decodes one column chunk into a ColumnData."""
+    """Decodes one column chunk into a ColumnData.
+
+    ``context`` carries the decode site's coordinates ({file, column,
+    row_group, chunk_offset}) — every raise out of :meth:`decode` is
+    annotated with them plus the failing page's ordinal and absolute byte
+    offset (quarantine.error_context), so a CRC mismatch names WHERE at
+    fleet scale instead of printing two hashes.
+    """
 
     def __init__(
         self,
         leaf: SchemaNode,
         validate_crc: bool = False,
         alloc: Optional[AllocTracker] = None,
+        context: Optional[dict] = None,
     ):
         self.leaf = leaf
         self.validate_crc = validate_crc
         self.alloc = alloc or AllocTracker(0)
+        self.context = dict(context or {})
+        if "column" not in self.context and leaf.path:
+            self.context["column"] = ".".join(leaf.path)
         self.dictionary = None  # decoded dict values (np array or ByteArrayData)
 
     # -- value decoding dispatch (getValuesDecoder, chunk_reader.go:106-159) --
@@ -265,6 +276,16 @@ class ChunkDecoder:
                 raw[pos:], bitpack.bit_width(max_def), num_values
             )
             pos += used
+        # structural sanity tier (always on, O(1)): a level run table
+        # yielding the wrong count means the page lies about itself
+        if rlv is not None and len(rlv) != num_values:
+            raise ParquetError(
+                f"page declares {num_values} values, repetition levels "
+                f"decode {len(rlv)}")
+        if dlv is not None and len(dlv) != num_values:
+            raise ParquetError(
+                f"page declares {num_values} values, definition levels "
+                f"decode {len(dlv)}")
         defined = int(np.count_nonzero(dlv == max_def)) if dlv is not None else num_values
         values = self._decode_values(dh.encoding, raw[pos:], defined)
         return values, dlv, rlv, num_values
@@ -295,6 +316,14 @@ class ChunkDecoder:
                 bitpack.bit_width(max_def),
                 num_values,
             )
+        if rlv is not None and len(rlv) != num_values:
+            raise ParquetError(
+                f"v2 page declares {num_values} values, repetition levels "
+                f"decode {len(rlv)}")
+        if dlv is not None and len(dlv) != num_values:
+            raise ParquetError(
+                f"v2 page declares {num_values} values, definition levels "
+                f"decode {len(dlv)}")
         values_block = payload[rep_len + def_len :]
         uncompressed_values = (
             header.uncompressed_page_size - rep_len - def_len
@@ -318,22 +347,33 @@ class ChunkDecoder:
     # -- whole chunk -----------------------------------------------------------
 
     def decode(self, buf: bytes, codec: int, total_values: int) -> ColumnData:
-        pages = walk_pages(buf, total_values)
+        from .quarantine import error_context
+
+        ctx = dict(self.context)
+        chunk_offset = ctx.pop("chunk_offset", 0) or 0
+        with error_context(**ctx):
+            pages = walk_pages(buf, total_values)
         values_parts = []
         def_parts = []
         rep_parts = []
         slots = 0
+        page_ordinal = 0  # data pages only (the quarantine record key)
         for ps in pages:
             pt = ps.header.type
-            if pt == PageType.DICTIONARY_PAGE:
-                self._decode_dict_page(ps, buf, codec)
-                continue
-            if pt == PageType.DATA_PAGE:
-                v, d, r, n = self._decode_data_page_v1(ps, buf, codec)
-            elif pt == PageType.DATA_PAGE_V2:
-                v, d, r, n = self._decode_data_page_v2(ps, buf, codec)
-            else:
-                continue  # index/unknown pages: ignore
+            with error_context(
+                    page=(page_ordinal if pt != PageType.DICTIONARY_PAGE
+                          else None),
+                    offset=chunk_offset + ps.payload_start, **ctx):
+                if pt == PageType.DICTIONARY_PAGE:
+                    self._decode_dict_page(ps, buf, codec)
+                    continue
+                if pt == PageType.DATA_PAGE:
+                    v, d, r, n = self._decode_data_page_v1(ps, buf, codec)
+                elif pt == PageType.DATA_PAGE_V2:
+                    v, d, r, n = self._decode_data_page_v2(ps, buf, codec)
+                else:
+                    continue  # index/unknown pages: ignore
+                page_ordinal += 1
             values_parts.append(v)
             slots += n
             if d is not None:
@@ -349,10 +389,11 @@ class ChunkDecoder:
         rep_levels = (
             np.concatenate(rep_parts).astype(np.int32) if rep_parts else None
         )
-        if def_levels is not None and len(def_levels) != slots:
-            raise ParquetError("definition level count mismatch")
-        if rep_levels is not None and len(rep_levels) != slots:
-            raise ParquetError("repetition level count mismatch")
+        with error_context(**ctx):
+            if def_levels is not None and len(def_levels) != slots:
+                raise ParquetError("definition level count mismatch")
+            if rep_levels is not None and len(rep_levels) != slots:
+                raise ParquetError("repetition level count mismatch")
         return ColumnData(
             values=values,
             def_levels=def_levels,
@@ -424,17 +465,25 @@ def read_chunk(
     leaf: SchemaNode,
     validate_crc: bool = False,
     alloc: Optional[AllocTracker] = None,
+    context: Optional[dict] = None,
 ) -> ColumnData:
     """Read + decode one column chunk from an open file (readChunk parity)."""
     from .iostore import require_full
+    from .quarantine import error_context
 
     md, offset = validate_chunk_meta(chunk, leaf)
     size = md.total_compressed_size
     if alloc is not None:
         alloc.register(size)
-    f.seek(offset)
-    buf = f.read(size)
-    require_full(buf, offset, size,
-                 context=f"column {'.'.join(leaf.path)}")
-    dec = ChunkDecoder(leaf, validate_crc=validate_crc, alloc=alloc)
+    ctx = dict(context or {})
+    ctx.setdefault("chunk_offset", offset)
+    with error_context(offset=offset,
+                       **{k: v for k, v in ctx.items()
+                          if k != "chunk_offset"}):
+        f.seek(offset)
+        buf = f.read(size)
+        require_full(buf, offset, size,
+                     context=f"column {'.'.join(leaf.path)}")
+    dec = ChunkDecoder(leaf, validate_crc=validate_crc, alloc=alloc,
+                       context=ctx)
     return dec.decode(buf, md.codec, md.num_values)

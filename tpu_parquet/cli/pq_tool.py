@@ -415,11 +415,66 @@ def cmd_autopsy(args, out=sys.stdout) -> int:
     if io:
         out.write(f"io: range at offset {io['offset']} ({io['size']} bytes) "
                   f"in flight for {io['age_s']:g}s\n")
+    de = rep.get("data_errors")
+    if de:
+        first = de.get("first") or {}
+        where = (f" — first bad: file {first.get('file')!r} column "
+                 f"{first.get('column')!r} row_group "
+                 f"{first.get('row_group')} page {first.get('page')}"
+                 if first else "")
+        out.write(f"data: {de['errors']} quarantined error(s){where}\n")
     err = rep.get("error")
     if err:
         out.write(f"error: {err.get('type')}: {err.get('message')}\n")
     out.write(f"verdict: {rep['verdict']}\n")
     out.write(f"probable cause: {rep['probable_cause']}\n")
+    return 0
+
+
+def cmd_quarantine(args, out=sys.stdout) -> int:
+    """Summarize a run's quarantine ledger (the JSONL ``TPQ_QUARANTINE_LOG``
+    wrote, one record per contained data error): totals, per-file /
+    per-column / per-error-class breakdowns, and the first bad
+    (file, column, page) — the fleet-scale view of a degraded run."""
+    from ..quarantine import summarize_quarantine_log
+
+    records = []
+    try:
+        with open(args.file) as f:
+            for ln, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError as e:
+                    out.write(f"pq-tool quarantine: {args.file}:{ln}: "
+                              f"bad record: {e}\n")
+                    return 1
+    except OSError as e:
+        out.write(f"pq-tool quarantine: {args.file}: {e}\n")
+        return 1
+    rep = summarize_quarantine_log(records)
+    if not rep["records"]:
+        out.write(f"quarantine: {args.file}: no records — the run "
+                  f"contained no data errors\n")
+        return 0
+    out.write(f"quarantine: {args.file}: {rep['records']} record(s) "
+              f"across {rep['files']} file(s)\n")
+    first = rep["first"] or {}
+    out.write(f"first bad: file {first.get('file')!r} column "
+              f"{first.get('column')!r} row_group {first.get('row_group')} "
+              f"page {first.get('page')} ({first.get('error')}: "
+              f"{str(first.get('message'))[:120]})\n")
+    for title, key in (("by file", "by_file"), ("by column", "by_column"),
+                       ("by error", "by_class")):
+        rows = rep[key]
+        if rows:
+            out.write(f"{title}:\n")
+            for name, n in list(rows.items())[:12]:
+                out.write(f"  {n:>6}  {name}\n")
+            if len(rows) > 12:
+                out.write(f"  ... and {len(rows) - 12} more\n")
     return 0
 
 
@@ -571,6 +626,12 @@ def build_parser() -> argparse.ArgumentParser:
              "stalled lane, blocked-thread classes, probable cause")
     au.add_argument("file")
     au.set_defaults(func=cmd_autopsy)
+
+    qa = sub.add_parser(
+        "quarantine",
+        help="summarize a quarantine ledger (TPQ_QUARANTINE_LOG JSONL)")
+    qa.add_argument("file", help="quarantine JSONL path")
+    qa.set_defaults(func=cmd_quarantine)
 
     be = sub.add_parser(
         "bench", help="run-ledger tools: compare and list recorded runs")

@@ -90,11 +90,42 @@ def validate_state(state) -> dict:
             f"loader state cursor {state['rows_taken']} past the shard's "
             f"{state['shard_rows']} rows"
         )
+    # quarantine skips (round 13, optional — pre-round-13 blobs carry
+    # none): a sorted duplicate-free unit list, its row total, and the
+    # skip_file-marked file ordinals.  Structural rails only; the
+    # restoring loader cross-checks membership and the row sum.
+    skipped = state.get("skipped_units", [])
+    if not isinstance(skipped, list) or any(
+            type(u) is not int or not 0 <= u < state["n_units"]
+            for u in skipped):
+        raise CheckpointError(
+            "loader state field 'skipped_units' must be a list of unit "
+            "ordinals in [0, n_units)")
+    if sorted(set(skipped)) != skipped:
+        raise CheckpointError(
+            "loader state field 'skipped_units' must be sorted and "
+            "duplicate-free")
+    sr = state.get("skipped_rows", 0)
+    if type(sr) is not int or not 0 <= sr <= state["shard_rows"]:
+        raise CheckpointError(
+            "loader state field 'skipped_rows' out of [0, shard_rows]")
+    sf = state.get("skipped_files", [])
+    if not isinstance(sf, list) or any(
+            type(f) is not int or not 0 <= f < 1 << 32 for f in sf):
+        raise CheckpointError(
+            "loader state field 'skipped_files' must be a list of file "
+            "ordinals")
+    if sorted(set(sf)) != sf:
+        raise CheckpointError(
+            "loader state field 'skipped_files' must be sorted and "
+            "duplicate-free")
     # state() only ever emits batch boundaries (k * batch_size) or the
-    # epoch-tail cursor (shard_rows); anything else is a tampered blob whose
-    # adoption would shift every subsequent batch by a fraction of a batch
+    # epoch-tail cursor (the shard's rows MINUS the quarantined units');
+    # anything else is a tampered blob whose adoption would shift every
+    # subsequent batch by a fraction of a batch
     rt = state["rows_taken"]
-    if rt % state["batch_size"] != 0 and rt != state["shard_rows"]:
+    if (rt % state["batch_size"] != 0
+            and rt != state["shard_rows"] - sr):
         raise CheckpointError(
             f"loader state cursor {rt} is not a batch boundary "
             f"(batch_size {state['batch_size']})"

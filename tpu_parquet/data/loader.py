@@ -75,6 +75,11 @@ class LoaderStats:
         self.decode_wait_seconds = 0.0
         self.window_peak_rows = 0
         self.wall_seconds = 0.0
+        # data-error containment accounting (quarantine.py): quarantined
+        # failures and the units/rows the skip policy dropped for them
+        self.data_errors = 0
+        self.units_skipped = 0
+        self.rows_skipped = 0
         self._t0: Optional[float] = None
 
     def touch_wall(self) -> None:
@@ -100,6 +105,9 @@ class LoaderStats:
             "wall_seconds": round(self.wall_seconds, 6),
             "decode_wait_seconds": round(self.decode_wait_seconds, 6),
             "window_peak_rows": self.window_peak_rows,
+            "data_errors": self.data_errors,
+            "units_skipped": self.units_skipped,
+            "rows_skipped": self.rows_skipped,
             "rows_per_sec": round(self.rows_per_sec, 1),
             "batches_per_sec": round(self.batches_per_sec, 3),
             "pipeline": self.pipeline.as_dict(),
@@ -108,6 +116,20 @@ class LoaderStats:
 
 def _as_dotted(col: Union[str, Sequence[str]]) -> str:
     return col if isinstance(col, str) else ".".join(col)
+
+
+class _UnitSkipped:
+    """In-band marker for a quarantined unit riding the ordered decode
+    stream (a worker raise would kill the epoch's prefetch pool).  Carries
+    the annotated exception; the consumer notes the quarantine record —
+    once, in stream order — so the ledger and the skip set are identical
+    at every prefetch depth."""
+
+    __slots__ = ("unit", "exc")
+
+    def __init__(self, unit: int, exc: BaseException):
+        self.unit = unit
+        self.exc = exc
 
 
 class DataLoader:
@@ -148,14 +170,17 @@ class DataLoader:
         to_device: bool = False,
         mask_key: str = "mask",
         max_memory: int = 0,
-        validate_crc: bool = False,
+        validate_crc=None,
         trace=None,
         sample_ms=None,
         hang_s=None,
         hang_policy=None,
+        on_data_error=None,
+        quarantine=None,
     ):
         from ..obs import (register_flight_registry, resolve_hang_s,
                            resolve_sample_ms, resolve_tracer)
+        from ..quarantine import Quarantine, resolve_validate
 
         # span tracer (obs.py): batch/decode-wait spans + window-occupancy
         # counters; None = the TPQ_TRACE process tracer (no-op without the
@@ -199,7 +224,19 @@ class DataLoader:
         self._to_device = bool(to_device)
         self._mask_key = mask_key
         self._max_memory = int(max_memory)
-        self._validate_crc = bool(validate_crc)
+        self._validate_crc = resolve_validate(validate_crc)
+        # data-error containment (quarantine.py, TPQ_ON_DATA_ERROR):
+        # under skip_unit/skip_file a corrupt unit is quarantined and
+        # DROPPED from the epoch stream deterministically — the skip set
+        # rides the checkpoint blob so save→restore→iterate replays the
+        # identical batch stream, skips included
+        self._quarantine = (quarantine if quarantine is not None
+                            else Quarantine(on_data_error))
+        # ONE inert raise-policy engine shared by every per-unit inner
+        # reader (a fresh engine per unit would re-parse the env and take
+        # the flight-registry lock thousands of times per epoch)
+        self._inner_quarantine = Quarantine("raise")
+        self._skipped_units: set[int] = set()  # this epoch's quarantined units
         self._columns = (None if columns is None
                          else [_as_dotted(c) for c in columns])
 
@@ -262,6 +299,7 @@ class DataLoader:
         # -- cursor + stats ---------------------------------------------------
         self._epoch = 0
         self._rows_taken = 0
+        self._bad_files: set[int] = set()  # skip_file marks, this epoch
         self._pstats = PipelineStats(prefetch=self._prefetch,
                                      budget_bytes=self._max_memory,
                                      tracer=self._tracer)
@@ -350,6 +388,9 @@ class DataLoader:
 
         reg = StatsRegistry()
         reg.add_loader(self._stats)
+        if (len(self._quarantine.log)
+                or self._quarantine.units_skipped):
+            reg.add_data_errors(self._quarantine)
         return reg
 
     # -- checkpoint ------------------------------------------------------------
@@ -371,6 +412,13 @@ class DataLoader:
             "total_rows": self._total_rows,
             "shard_rows": self._shard_rows,
             "dataset_digest": self._dataset_digest,
+            # the CURRENT epoch's quarantine skips: restore replays them
+            # proactively, so the resumed batch stream is bit-identical to
+            # the uninterrupted one — skips included (quarantine.py)
+            "skipped_units": sorted(self._skipped_units),
+            "skipped_rows": sum(int(self._unit_rows_all[u])
+                                for u in self._skipped_units),
+            "skipped_files": sorted(self._bad_files),
         }
 
     def state_blob(self) -> bytes:
@@ -391,12 +439,69 @@ class DataLoader:
                                    "drop_remainder", "shard", "n_units",
                                    "total_rows", "shard_rows",
                                    "dataset_digest")})
+        # quarantine skips (absent in pre-round-13 blobs: no skips then).
+        # Cross-checks beyond validate_state's structural ones: the units
+        # must belong to THIS shard and their rows must sum to the blob's
+        # skipped_rows — a tampered skip set must never silently mis-seek.
+        skipped = st.get("skipped_units", [])
+        mine = set(int(u) for u in self._my_units)
+        bad = [u for u in skipped if u not in mine]
+        if bad:
+            raise CheckpointError(
+                f"loader state skipped_units {bad[:8]} not in this "
+                f"loader's shard")
+        rows = sum(int(self._unit_rows_all[u]) for u in skipped)
+        if rows != st.get("skipped_rows", 0):
+            raise CheckpointError(
+                f"loader state skipped_rows {st.get('skipped_rows', 0)} "
+                f"does not match the named units' {rows} rows")
+        n_files = len(self._paths)
+        bad_files = [f for f in st.get("skipped_files", [])
+                     if not 0 <= f < n_files]
+        if bad_files:
+            raise CheckpointError(
+                f"loader state skipped_files {bad_files[:8]} out of range "
+                f"({n_files} files)")
         self._seed = st["seed"]
         self._epoch = st["epoch"]
         self._rows_taken = st["rows_taken"]
+        self._skipped_units = set(int(u) for u in skipped)
+        self._bad_files = set(int(f) for f in st.get("skipped_files", []))
         return self
 
     # -- decode ----------------------------------------------------------------
+
+    def _note_unit_skip(self, unit: int) -> None:
+        """Account one quarantined/dropped unit (idempotent): the skip set
+        (checkpointed), LoaderStats, the engine's counters, and a
+        flight-recorder instant naming the unit."""
+        if unit in self._skipped_units:
+            return
+        self._skipped_units.add(unit)
+        rows = int(self._unit_rows_all[unit])
+        self._stats.units_skipped += 1
+        self._stats.rows_skipped += rows
+        self._quarantine.note_unit_skipped(rows)
+        tr = self._tracer
+        if tr.active:
+            fi, gi = self._unit_map[unit]
+            tr.instant("unit_skipped", unit=unit, file=self._paths[fi],
+                       row_group=gi, rows=rows)
+
+    def _adjusted_plan(self, plan):
+        """Zero the quarantined units' rows in an epoch plan, so the cursor
+        math (locate/starts) matches the stream that will actually flow —
+        the restore half of the deterministic-skip contract."""
+        if not self._skipped_units:
+            return plan
+        gids = np.asarray([int(self._my_units[o]) for o in plan.order],
+                          dtype=np.int64)
+        rows = plan.unit_rows.copy()
+        rows[np.isin(gids, np.fromiter(self._skipped_units, dtype=np.int64))] = 0
+        starts = np.zeros(len(rows) + 1, dtype=np.int64)
+        np.cumsum(rows, out=starts[1:])
+        return plan.__class__(epoch=plan.epoch, order=plan.order,
+                              unit_rows=rows, starts=starts)
 
     def _decode_unit(self, unit: int) -> dict[str, np.ndarray]:
         """One (file, row group) unit -> {column: np.ndarray} host arrays.
@@ -409,47 +514,77 @@ class DataLoader:
         work).  Output is bit-identical at every depth (the PR-1 contract).
         Each call opens its own fd; the cached footer skips the reparse.
         """
+        from ..errors import DataIntegrityError
+        from ..quarantine import annotate_data_error
         from ..reader import FileReader  # deferred: reader pulls numpy chains
 
         fi, gi = self._unit_map[unit]
-        with FileReader(self._paths[fi], columns=self._columns,
-                        metadata=self._metas[fi],
-                        validate_crc=self._validate_crc,
-                        prefetch=self._prefetch) as r:
-            if self._prefetch > 0:
-                cols = r.read_row_group(gi)
-                self._pstats.merge_from(r.pipeline_stats())
-            else:
-                # the sequential path has no per-stage instrumentation, so
-                # the WHOLE read (IO included) books under "decompress" —
-                # loader-level timing lives in LoaderStats.decode_wait_seconds
-                # either way; the io/decompress split is only meaningful at
-                # prefetch > 0 (PipelineStats contract)
-                with self._pstats.timed("decompress"):
-                    cols = r.read_row_group(gi, prefetch=0)
-                # the pipelined branch counts groups/chunks via the merge
-                self._pstats.count_row_group()
-        n = self._unit_rows_all[unit]
-        out = {}
-        for name in self._colnames:
-            cd = cols[name]
-            if isinstance(cd.values, ByteArrayData) or cd.max_rep > 0:
-                # construction validates the schema; reaching here means the
-                # file's data contradicts its own footer
-                raise ParquetError(f"column {name!r} is not fixed-width flat")
-            if cd.def_levels is not None and cd.num_defined != cd.num_leaf_slots:
-                raise TypeError(
-                    f"DataLoader needs null-free columns; {name!r} has "
-                    f"{cd.num_leaf_slots - cd.num_defined} nulls"
-                )
-            arr = np.asarray(cd.values)
-            if len(arr) != n:
-                raise ParquetError(
-                    f"column {name!r} decoded {len(arr)} rows, footer "
-                    f"declares {n}"
-                )
-            out[name] = arr
-        return out
+        if fi in self._bad_files:
+            # fast path for a skip_file-marked file: the consumer would
+            # drop this unit's rows regardless (consumer-order decision),
+            # so don't pay its decode.  Safe under lookahead: the flag is
+            # only ever SET by the consumer, so a worker seeing it implies
+            # the consumer will see it too.
+            return _UnitSkipped(unit, None)
+        try:
+            # the inner reader must RAISE (never skip internally): the
+            # loader's own seam owns unit granularity, the checkpointed
+            # skip set, and the one shared budget/ledger
+            with FileReader(self._paths[fi], columns=self._columns,
+                            metadata=self._metas[fi],
+                            validate_crc=self._validate_crc,
+                            prefetch=self._prefetch,
+                            quarantine=self._inner_quarantine) as r:
+                if self._prefetch > 0:
+                    cols = r.read_row_group(gi)
+                    self._pstats.merge_from(r.pipeline_stats())
+                else:
+                    # the sequential path has no per-stage instrumentation, so
+                    # the WHOLE read (IO included) books under "decompress" —
+                    # loader-level timing lives in LoaderStats.decode_wait_seconds
+                    # either way; the io/decompress split is only meaningful at
+                    # prefetch > 0 (PipelineStats contract)
+                    with self._pstats.timed("decompress"):
+                        cols = r.read_row_group(gi, prefetch=0)
+                    # the pipelined branch counts groups/chunks via the merge
+                    self._pstats.count_row_group()
+            n = self._unit_rows_all[unit]
+            out = {}
+            for name in self._colnames:
+                cd = cols[name]
+                if isinstance(cd.values, ByteArrayData) or cd.max_rep > 0:
+                    # construction validates the schema; reaching here means
+                    # the file's data contradicts its own footer
+                    raise ParquetError(
+                        f"column {name!r} is not fixed-width flat")
+                if (cd.def_levels is not None
+                        and cd.num_defined != cd.num_leaf_slots):
+                    raise TypeError(
+                        f"DataLoader needs null-free columns; {name!r} has "
+                        f"{cd.num_leaf_slots - cd.num_defined} nulls"
+                    )
+                arr = np.asarray(cd.values)
+                if len(arr) != n:
+                    raise ParquetError(
+                        f"column {name!r} decoded {len(arr)} rows, footer "
+                        f"declares {n}"
+                    )
+                out[name] = arr
+            return out
+        except (ParquetError, TypeError) as e:
+            # containment seam (quarantine.py): the unit becomes an in-band
+            # skip marker instead of an epoch-killing raise; the CONSUMER
+            # (_blocks) notes the record in stream order.  TypeError is
+            # included because a corruption the CRC tier cannot see (no
+            # checksum written) can surface as the null-free/fixed-width
+            # contract check above.  Budget exhaustion (DataIntegrityError)
+            # always propagates.
+            if not self._quarantine.contains or isinstance(
+                    e, DataIntegrityError):
+                raise
+            return _UnitSkipped(unit, annotate_data_error(
+                e, file=self._paths[fi], row_group=gi, unit=unit,
+                epoch=self._epoch))
 
     def _blocks(self, plan, first_block: int, skip_rows: int):
         """Yield (block_index, {col: raw rows}, permutation|None) shuffle
@@ -459,10 +594,33 @@ class DataLoader:
         Blocks are yielded UNPERMUTED with their seeded permutation: the
         batcher gathers each batch's rows straight through the permutation
         slice (one copy per row) instead of materializing a permuted block
-        and copying batch slices out of it (two)."""
+        and copying batch slices out of it (two).
+
+        Containment (quarantine.py): a unit arriving as a
+        :class:`_UnitSkipped` marker is recorded and dropped — the block
+        stream simply never sees its rows, so blocking/permutation over the
+        SURVIVING rows is identical whether the skip was discovered live or
+        replayed proactively from a restored checkpoint.  ``skip_file``
+        marks the file bad; later units of a bad file are dropped on
+        arrival even when their own decode succeeded in the lookahead (the
+        decision is made in CONSUMER order, so it is deterministic at every
+        prefetch depth)."""
         window = self._shuffle_window
+        q = self._quarantine
         unit_ids = [int(self._my_units[plan.order[k]])
                     for k in range(len(plan.order))]
+        # proactive skips: units already quarantined this epoch (a restored
+        # skip set, or a bad file's not-yet-reached units) are never decoded
+        # — their rows are zeroed in the caller's plan, so the cursor math
+        # and this stream agree
+        decode_ids = []
+        for u in unit_ids:
+            if u in self._skipped_units:
+                continue
+            if self._unit_map[u][0] in self._bad_files:
+                self._note_unit_skip(u)
+                continue
+            decode_ids.append(u)
         # locate() already skipped fully-consumed units via first_block's
         # start row; the caller passes the permuted ordinal to start at
         budget = (InFlightBudget(self._max_memory)
@@ -479,13 +637,14 @@ class DataLoader:
         # fan-out only oversubscribes the cores the chunk pipeline already
         # uses (0.95x measured at depth 4 on 2 cores); the real depth knob
         # is the chunk pipeline inside _decode_unit.
-        stream = prefetch_map(iter(unit_ids), self._decode_unit,
+        stream = prefetch_map(iter(decode_ids), self._decode_unit,
                               min(self._prefetch, 1), budget=budget,
                               cost=cost, stats=self._pstats)
         names = self._colnames
         parts: dict[str, list] = {c: [] for c in names}
         buffered = 0
         bidx = first_block
+        pos = 0  # index into decode_ids, so each result names its unit
         tr = self._tracer
         try:
             while True:
@@ -495,11 +654,30 @@ class DataLoader:
                 except StopIteration:
                     break
                 t1 = time.perf_counter()
+                uid = decode_ids[pos]
+                pos += 1
                 self._stats.decode_wait_seconds += t1 - t0
                 if tr.active:
                     # consumer time blocked on the decode stream — the span
                     # that shrinks toward zero as prefetch hides the decode
                     tr.complete("decode_wait", t0, t1)
+                if self._unit_map[uid][0] in self._bad_files:
+                    # collateral skip of an already-bad file's unit —
+                    # whether its decode succeeded in the lookahead, failed,
+                    # or was fast-pathed away: dropped with NO new record
+                    # and no budget charge (consumer-order decision =
+                    # deterministic at every prefetch depth)
+                    self._note_unit_skip(uid)
+                    continue
+                if isinstance(arrays, _UnitSkipped):
+                    # quarantined: record (budget may raise), drop the unit
+                    q.note(arrays.exc)
+                    self._stats.data_errors += 1
+                    self._note_unit_skip(uid)
+                    if q.policy == "skip_file":
+                        q.note_file_skipped()
+                        self._bad_files.add(self._unit_map[uid][0])
+                    continue
                 if skip_rows:
                     arrays = {c: a[skip_rows:] for c, a in arrays.items()}
                     skip_rows = 0
@@ -568,8 +746,9 @@ class DataLoader:
 
     def _batches(self, epoch: int, start_row: int):
         """Yield (batch, rows_consumed) for one epoch from ``start_row``."""
-        plan = plan_epoch(self._seed, epoch, self._shard[0],
-                          self._shard_unit_rows, self._shuffle)
+        plan = self._adjusted_plan(
+            plan_epoch(self._seed, epoch, self._shard[0],
+                       self._shard_unit_rows, self._shuffle))
         total = plan.total_rows
         if start_row >= total:
             return
@@ -640,6 +819,9 @@ class DataLoader:
             sampler.add_source("pipeline_lanes", self._pstats.sample)
             sampler.add_source("budget_waiters", lambda: (
                 self._budget.snapshot() if self._budget is not None else {}))
+            # quarantined-unit accounting as a live curve: a corruption
+            # burst is visible next to the lanes it degraded
+            sampler.add_source("data_errors", self._quarantine.progress)
             sampler.start()
         watchdog = Watchdog(self._hang_s, policy=self._hang_policy)
         lane = None
@@ -654,6 +836,9 @@ class DataLoader:
             lane = watchdog.watch_consumer()
             self._watchdog = watchdog  # _blocks registers its budget's abort
             watchdog.start()
+        # per-epoch error-budget scope: the fraction denominator is this
+        # shard's unit count; the ledger and skip counters are cumulative
+        self._quarantine.begin_scan(len(self._my_units))
         gen = self._batches(epoch, self._rows_taken)
         try:
             while True:
@@ -697,6 +882,11 @@ class DataLoader:
         # epoch complete (also when resumed exactly at its end)
         self._epoch = epoch + 1
         self._rows_taken = 0
+        # the skip set is an EPOCH fact: the next epoch re-attempts every
+        # unit (a transient corruption heals; a persistent one re-records
+        # under the fresh per-epoch budget)
+        self._skipped_units = set()
+        self._bad_files = set()
         stats.epochs_completed += 1
 
     def epochs(self, n: int):

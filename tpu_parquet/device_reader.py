@@ -2775,14 +2775,22 @@ class _ChunkAssembler:
 def _collect_chunk(
     buf: bytes, codec: int, total_values: int, leaf: SchemaNode,
     deferred_checks: list, validate_crc: bool = False, alloc=None,
-    statistics=None, skip_pages=None,
+    statistics=None, skip_pages=None, context=None,
 ) -> Optional[_ChunkAssembler]:
     """Walk a chunk's pages into an assembler (host phase); None if no data.
 
     ``skip_pages``: data-page ordinals pruned by page-level predicate
-    pushdown — their payloads are never decompressed, parsed, or staged."""
+    pushdown — their payloads are never decompressed, parsed, or staged.
+    ``context``: decode-site coordinates ({file, column, row_group,
+    chunk_offset}) stamped onto every raise (quarantine.error_context),
+    plus the failing page's ordinal and byte offset."""
     from .format import CompressionCodec
+    from .quarantine import error_context
 
+    ctx = dict(context or {})
+    if "column" not in ctx and leaf.path:
+        ctx["column"] = ".".join(leaf.path)
+    chunk_offset = ctx.pop("chunk_offset", 0) or 0
     asm = _ChunkAssembler(leaf, deferred_checks)
     asm.stats_span = _int_stats_span(statistics, leaf)
     asm.alloc = alloc
@@ -2799,17 +2807,21 @@ def _collect_chunk(
         from . import native
 
         lazy = native.available()
-    for ps in walk_pages(buf, total_values):
+    with error_context(**ctx):
+        pages = walk_pages(buf, total_values)
+    for ps in pages:
         header = ps.header
         pt = header.type
         if pt == PageType.DICTIONARY_PAGE:
-            payload = buf[ps.payload_start : ps.payload_end]
-            _check_crc(header, payload, validate_crc)
-            if alloc is not None:
-                alloc.register(max(header.uncompressed_page_size or 0, 0))
-            raw = decompress_block(payload, codec, header.uncompressed_page_size)
-            dh = header.dictionary_page_header
-            asm.set_dictionary(raw, dh.encoding, dh.num_values or 0)
+            with error_context(offset=chunk_offset + ps.payload_start, **ctx):
+                payload = buf[ps.payload_start : ps.payload_end]
+                _check_crc(header, payload, validate_crc)
+                if alloc is not None:
+                    alloc.register(max(header.uncompressed_page_size or 0, 0))
+                raw = decompress_block(payload, codec,
+                                       header.uncompressed_page_size)
+                dh = header.dictionary_page_header
+                asm.set_dictionary(raw, dh.encoding, dh.num_values or 0)
             if codec == CompressionCodec.SNAPPY:
                 # keep the compressed payload: the ship planner may send the
                 # dictionary VALUE TABLE over the link compressed and expand
@@ -2822,11 +2834,14 @@ def _collect_chunk(
                 asm.pages_pruned += 1
                 data_ordinal += 1
                 continue
-            asm.pages.append(
-                parse_data_page(ps, buf, codec, leaf, validate_crc=validate_crc,
-                                alloc=alloc, decode_levels=False,
-                                lazy_decompress=lazy)
-            )
+            with error_context(page=data_ordinal,
+                               offset=chunk_offset + ps.payload_start, **ctx):
+                asm.pages.append(
+                    parse_data_page(ps, buf, codec, leaf,
+                                    validate_crc=validate_crc,
+                                    alloc=alloc, decode_levels=False,
+                                    lazy_decompress=lazy)
+                )
             data_ordinal += 1
             continue
         # index/unknown pages: skip
@@ -3012,14 +3027,15 @@ class DeviceFileReader:
     transfer entirely.
     """
 
-    def __init__(self, source, columns=None, validate_crc: bool = False,
+    def __init__(self, source, columns=None, validate_crc=None,
                  profile_dir: "str | None" = None, max_memory: int = 0,
                  row_filter=None, prefetch: int = 0, trace=None,
                  sample_ms=None, hang_s=None, hang_policy=None,
-                 store=None):
+                 store=None, on_data_error=None, quarantine=None):
         from .obs import (Sampler, Watchdog, register_flight_registry,
                           resolve_hang_s, resolve_sample_ms, resolve_tracer)
         from .pipeline import PipelineStats
+        from .quarantine import resolve_validate
         from .reader import FileReader
 
         _enable_compile_cache()
@@ -3028,11 +3044,17 @@ class DeviceFileReader:
         # disabled no-op without the env); a path = per-reader tracer whose
         # trace file (+ embedded registry) is written at close()
         self._tracer, self._owns_tracer = resolve_tracer(trace)
+        validate_crc = resolve_validate(validate_crc)
         self._host = FileReader(source, columns=columns,
                                 validate_crc=validate_crc,
                                 max_memory=max_memory,
                                 row_filter=row_filter,
-                                trace=self._tracer, store=store)
+                                trace=self._tracer, store=store,
+                                on_data_error=on_data_error,
+                                quarantine=quarantine)
+        # data-error containment engine, SHARED with the host half so the
+        # budget and quarantine ledger span both decode paths
+        self.quarantine = self._host.quarantine
         # the IO backend all chunk bytes enter through (iostore.py) —
         # shared with the host reader so both paths see one retry budget
         self._store = self._host._store
@@ -3085,6 +3107,9 @@ class DeviceFileReader:
                 # retry/backoff curves next to the lanes they stall
                 self._sampler.add_source("io_retries",
                                          self._store.stats.progress)
+            # quarantined-unit accounting as a live curve: a corruption
+            # burst is visible next to the lane it degraded
+            self._sampler.add_source("data_errors", self.quarantine.progress)
             self._sampler.start()
         # hang watchdog (obs.Watchdog, TPQ_HANG_S / hang_s=): fires a
         # flight dump (and, policy "raise", aborts the chunk feed's budget
@@ -3150,6 +3175,8 @@ class DeviceFileReader:
         reg.note_alloc_peak(self.alloc)
         if self._store.stats is not None:
             reg.add_io(self._store.stats)
+        if len(self.quarantine.log) or self.quarantine.units_skipped:
+            reg.add_data_errors(self.quarantine)
         return reg
 
     def __enter__(self):
@@ -3382,11 +3409,19 @@ class DeviceFileReader:
                         f"{'.'.join(path)}"
                     )
                 md, asm = entry
+                if isinstance(asm, _FailedChunk):
+                    # a quarantined chunk from the prefetch feed: re-raise
+                    # its (already annotated + recorded) error here so the
+                    # consumer-side containment in _scan_pipeline handles
+                    # the sequential and pipelined paths identically
+                    raise asm.exc
                 self._stats.chunks += 1
                 self._stats.compressed_bytes += md.total_compressed_size
                 self.alloc.register(md.total_compressed_size)
             else:
                 md, offset = validate_chunk_meta(chunk, leaf)
+                ctx = {"file": self._host._source_name, "row_group": index,
+                       "column": ".".join(path), "chunk_offset": offset}
                 buf = planned_bufs.get(path)
                 if buf is None:
                     f.seek(offset)
@@ -3401,6 +3436,7 @@ class DeviceFileReader:
                     validate_crc=self.validate_crc, alloc=self.alloc,
                     statistics=md.statistics,
                     skip_pages=(skip_pages or {}).get(path),
+                    context=ctx,
                 )
                 if asm is not None:
                     asm.preship(self._ship_planner, self._pipe_stats)
@@ -3642,6 +3678,7 @@ class DeviceFileReader:
         self._store.begin_scan()
         indices = [i for i in range(self.num_row_groups)
                    if self._host.row_group_selected(i)]
+        self.quarantine.begin_scan(len(indices))
         if not indices:
             self.finalize()
             return
@@ -3654,6 +3691,7 @@ class DeviceFileReader:
                 prefetch=self._prefetch,
                 budget_bytes=self.alloc.max_size,
                 watchdog=self._watchdog,
+                quarantine=self.quarantine,
             ):
                 yield out
 
@@ -3693,6 +3731,19 @@ def _timed_stage(reader: DeviceFileReader, stager: _RowGroupStager):
     with reader._stats_lock:
         reader._stats.device_seconds += dt
     return buf_dev
+
+
+class _FailedChunk:
+    """In-band marker for a quarantined chunk riding the ordered chunk
+    feed (a worker raise would kill the whole multi-file pool).  Carries
+    the annotated exception; ``_prepare_row_group`` re-raises it so the
+    consumer-side containment in ``_scan_pipeline`` records exactly one
+    quarantine entry per failed unit on every path."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
 
 
 def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
@@ -3837,23 +3888,39 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
         if md is None:
             return (id(r), i), None, None
         stats = r._pipe_stats
-        tracker = AllocTracker(r.alloc.max_size)
-        tracker.register(md.total_compressed_size)
-        if buf0 is not None:
-            buf = buf0  # the pruning planner already paid this chunk's IO
-        else:
-            with stats.timed("io"):
-                buf = (fetcher.read(offset, md.total_compressed_size)
-                       if fetcher is not None
-                       else sr.pread(offset, md.total_compressed_size))
-        require_full(buf, offset, md.total_compressed_size,
-                     context=f"column {'.'.join(p)}")
-        with stats.timed("decompress"):
-            asm = _collect_chunk(
-                buf, md.codec, md.num_values, leaf, r._deferred,
-                validate_crc=r.validate_crc, alloc=tracker,
-                statistics=md.statistics, skip_pages=skip,
-            )
+        ctx = {"file": r._host._source_name, "row_group": i,
+               "column": ".".join(p), "chunk_offset": offset}
+        try:
+            tracker = AllocTracker(r.alloc.max_size)
+            tracker.register(md.total_compressed_size)
+            if buf0 is not None:
+                buf = buf0  # the pruning planner already paid this chunk's IO
+            else:
+                with stats.timed("io"):
+                    buf = (fetcher.read(offset, md.total_compressed_size)
+                           if fetcher is not None
+                           else sr.pread(offset, md.total_compressed_size))
+            require_full(buf, offset, md.total_compressed_size,
+                         context=f"column {'.'.join(p)}")
+            with stats.timed("decompress"):
+                asm = _collect_chunk(
+                    buf, md.codec, md.num_values, leaf, r._deferred,
+                    validate_crc=r.validate_crc, alloc=tracker,
+                    statistics=md.statistics, skip_pages=skip,
+                    context=ctx,
+                )
+        except ParquetError as e:
+            # containment seam (quarantine.py): wrap instead of raise so
+            # the feed keeps flowing; the consumer notes the record
+            q = r.quarantine
+            from .errors import DataIntegrityError
+            from .quarantine import annotate_data_error
+
+            if not q.contains or isinstance(e, DataIntegrityError):
+                raise
+            return (id(r), i), p, (md, _FailedChunk(
+                annotate_data_error(e, **{k: v for k, v in ctx.items()
+                                          if k != "chunk_offset"})))
         # ship planning on the SAME worker thread (outside the decompress
         # timer: its compression seconds land in the `recompress` stage) —
         # the link-recompression work overlaps the consumer's stage/dispatch
@@ -3895,7 +3962,7 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
                    close_finished: bool = False,
                    defer_finalize: bool = False,
                    prefetch: int = 0, budget_bytes: int = 0,
-                   watchdog=None):
+                   watchdog=None, quarantine=None):
     """The one-deep prepare/stage/dispatch pipeline shared by
     ``DeviceFileReader.iter_row_groups`` (one reader) and :func:`scan_files`
     (many).  ``work`` yields ``(reader, path, row_group_index)``; this yields
@@ -3927,6 +3994,9 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
     # read as a hang (obs.ConsumerLane)
     lane = (watchdog.watch_consumer()
             if watchdog is not None and watchdog.enabled else None)
+    from .errors import DataIntegrityError
+
+    dead: set = set()  # readers quarantined whole (policy skip_file)
     try:
         if lane is not None:
             lane.producing()
@@ -3935,8 +4005,29 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
             if watchdog is not None:
                 watchdog.check()  # surface a fired raise-policy HangError
                 # even when no budget wait existed to interrupt (prefetch=0)
-            prepared = r._prepare_row_group(i, executor=ex,
-                                            collected=collected)
+            q = quarantine if quarantine is not None else r.quarantine
+            if id(r) in dead:
+                # collateral skip: a later unit of a skip_file-quarantined
+                # file — accounted, never decoded, never a new record
+                q.note_unit_skipped(
+                    int(r.metadata.row_groups[i].num_rows or 0))
+                continue
+            try:
+                prepared = r._prepare_row_group(i, executor=ex,
+                                                collected=collected)
+            except ParquetError as e:
+                # containment seam (quarantine.py): record + skip the unit
+                # instead of aborting the scan; DataIntegrityError (budget
+                # exhausted) always propagates
+                if not q.contains or isinstance(e, DataIntegrityError):
+                    raise
+                q.note(e, file=r._host._source_name, row_group=i)
+                q.note_unit_skipped(
+                    int(r.metadata.row_groups[i].num_rows or 0))
+                if q.policy == "skip_file":
+                    q.note_file_skipped()
+                    dead.add(id(r))
+                continue
             fut = (ex.submit(_timed_stage, r, prepared[2])
                    if prepared[1] else None)
             if prev is not None:
@@ -3978,10 +4069,11 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
             lane.idle()
 
 
-def scan_files(paths, columns=None, validate_crc: bool = False,
+def scan_files(paths, columns=None, validate_crc=None,
                max_memory: int = 0, row_filter=None, with_path: bool = False,
                prefetch: int = 0, trace=None, sample_ms=None, hang_s=None,
-               hang_policy=None, store=None):
+               hang_policy=None, store=None, on_data_error=None,
+               quarantine=None):
     """Scan several files' row groups through ONE continuous transfer pipeline.
 
     ``prefetch=K`` additionally runs chunk IO + decompression K-deep on a
@@ -4026,11 +4118,17 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
     from concurrent.futures import ThreadPoolExecutor
 
     from .obs import Watchdog, resolve_hang_s, resolve_tracer
+    from .quarantine import Quarantine
 
     # one tracer spans the whole scan (per-file tracers would shred the
     # timeline Perfetto is supposed to show); with a path, the trace + the
     # merged registry of every reader are written when the scan ends
     tracer, owns_tracer = resolve_tracer(trace)
+    # ONE containment engine spans the whole scan: the error budget and the
+    # quarantine ledger are per-SCAN facts, not per-file ones (the unit
+    # total is unknown up front, so only the absolute budget binds)
+    q = quarantine if quarantine is not None else Quarantine(on_data_error)
+    q.begin_scan()
     readers: list[DeviceFileReader] = []
 
     # ONE watchdog spans the whole scan (per-reader watchdogs would call a
@@ -4074,7 +4172,7 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
             r = DeviceFileReader(
                 path, columns=columns, validate_crc=validate_crc,
                 max_memory=max_memory, row_filter=row_filter, trace=tracer,
-                sample_ms=sample_ms, hang_s=0, store=store,
+                sample_ms=sample_ms, hang_s=0, store=store, quarantine=q,
             )
             readers.append(r)
             if watchdog.enabled:
@@ -4091,7 +4189,7 @@ def scan_files(paths, columns=None, validate_crc: bool = False,
                                           defer_finalize=True,
                                           prefetch=int(prefetch),
                                           budget_bytes=int(max_memory),
-                                          watchdog=watchdog):
+                                          watchdog=watchdog, quarantine=q):
                 yield (pp, out) if with_path else out
         _finalize_many(readers)
     finally:

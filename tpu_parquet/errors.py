@@ -65,6 +65,26 @@ class RetryExhaustedError(IOError):
         self.size = size
 
 
+class DataIntegrityError(ParquetError):
+    """A scan's data-error budget is exhausted: corruption is no longer
+    containable.
+
+    Raised by :class:`tpu_parquet.quarantine.Quarantine` when the number of
+    contained data errors exceeds the budget (``TPQ_DATA_ERROR_BUDGET``:
+    absolute count and fraction-of-units) — a file set failing *everywhere*
+    must abort the run with the full evidence, not silently skip itself to
+    an empty epoch.  ``records`` carries the structured quarantine records
+    (one dict per failure: file, row group, column, page, offset, error
+    class, message) noted during the scan, so the error itself is the
+    complete diagnosis.  Rooted at ParquetError: the input data really is
+    malformed, and the fuzz harness's crash oracle should classify it so.
+    """
+
+    def __init__(self, message: str, records: "list | None" = None):
+        super().__init__(message)
+        self.records = list(records or [])
+
+
 class CheckpointError(ParquetError):
     """Malformed, incompatible, or version-mismatched loader checkpoint state.
 

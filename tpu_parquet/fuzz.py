@@ -637,6 +637,170 @@ def fuzz_io_ranges(data: bytes) -> None:
                 f"lying store corrupted range [{off}, {off + size})")
 
 
+_PAGE_CORRUPT_BASE = None
+
+
+def _page_corrupt_base():
+    """A small CRC'd 2-column × 3-row-group parquet image + oracle, built
+    once: (file bytes, per-row-group byte spans, clean per-group decodes).
+    The spans let the target tell which row groups a blob's flips touched —
+    the untouched ones are the wrong-data oracle."""
+    global _PAGE_CORRUPT_BASE
+    if _PAGE_CORRUPT_BASE is None:
+        import io as _io
+
+        from .chunk_decode import validate_chunk_meta
+        from .footer import read_file_metadata
+        from .format import CompressionCodec, FieldRepetitionType as FRT, Type
+        from .reader import FileReader
+        from .schema.core import Schema, build_schema, data_column
+        from .writer import FileWriter
+
+        rng = np.random.default_rng(5)
+        sink = _io.BytesIO()
+        schema = build_schema([
+            data_column("a", Type.INT64, FRT.REQUIRED),
+            data_column("b", Type.INT32, FRT.REQUIRED),
+        ])
+        with FileWriter(sink, schema, codec=CompressionCodec.SNAPPY,
+                        write_crc=True) as w:
+            for _ in range(3):
+                w.write_columns({
+                    "a": rng.integers(0, 1 << 40, 150),
+                    "b": rng.integers(0, 1 << 20, 150).astype(np.int32),
+                })
+                w.flush_row_group()
+        whole = sink.getvalue()
+        md = read_file_metadata(_io.BytesIO(whole))
+        fschema = Schema.from_file_metadata(md)
+        leaves = {l.path: l for l in fschema.leaves}
+        spans = []
+        for rg in md.row_groups:
+            lo, hi = 1 << 62, 0
+            for cc in rg.columns:
+                cmd, off = validate_chunk_meta(
+                    cc, leaves[tuple(cc.meta_data.path_in_schema)])
+                lo = min(lo, off)
+                hi = max(hi, off + cmd.total_compressed_size)
+            spans.append((lo, hi))
+        clean = []
+        with FileReader(whole) as r:
+            for i in range(r.num_row_groups):
+                clean.append({k: np.asarray(v.values)
+                              for k, v in r.read_row_group(i).items()})
+        _PAGE_CORRUPT_BASE = (whole, spans, clean)
+    return _PAGE_CORRUPT_BASE
+
+
+def fuzz_page_corrupt(data: bytes) -> None:
+    """Fuzz target #15: crafted page corruption through the policy engine.
+
+    Blob layout: byte 0 picks the error policy, byte 1 the validate mode,
+    byte 2 the budget, byte 3 the prefetch depth; then 4-byte records
+    (3-byte position, 1-byte xor mask) flip bytes of the DATA region of a
+    small CRC'd file (the footer is left alone — the footer's own fuzz
+    surface is the file_reader target).  Invariants:
+
+    - no hang, no unclassified crash: every outcome is a clean read, a
+      ``ParquetError``-rooted raise (``DataIntegrityError`` included), or
+      a clean skip — the crash oracle (run_fuzz) enforces the type;
+    - no wrong data: row groups whose byte span is UNTOUCHED decode
+      bit-identically to the clean image, under every policy;
+    - exact accounting: under a skip policy, every quarantine record names
+      a row group whose span was actually touched — nothing else is ever
+      quarantined.
+    """
+    from .errors import DataIntegrityError
+    from .quarantine import ErrorBudget, Quarantine
+    from .reader import FileReader
+
+    if len(data) < 8:
+        return
+    whole, spans, clean = _page_corrupt_base()
+    policy = ("raise", "skip_unit", "skip_file")[data[0] % 3]
+    validate = (None, False)[data[1] % 2]
+    tiny_budget = data[2] % 4 == 0
+    prefetch = (0, 2)[data[3] % 2]
+    payload = data[4:]
+    data_lo = min(lo for lo, _hi in spans)
+    data_hi = max(hi for _lo, hi in spans)
+    buf = bytearray(whole)
+    touched: set[int] = set()
+    n_flips = 0
+    for i in range(0, len(payload) - 3, 4):
+        if n_flips >= 32:
+            break
+        pos = data_lo + (int.from_bytes(payload[i : i + 3], "little")
+                         % (data_hi - data_lo))
+        xor = payload[i + 3] or 0xFF
+        buf[pos] ^= xor
+        n_flips += 1
+        for gi, (lo, hi) in enumerate(spans):
+            if lo <= pos < hi:
+                touched.add(gi)
+    q = Quarantine(policy, budget=(ErrorBudget(1, 1.0) if tiny_budget
+                                   else ErrorBudget()))
+    try:
+        with FileReader(bytes(buf), validate_crc=validate,
+                        prefetch=prefetch, quarantine=q) as r:
+            list(r.iter_row_groups())
+    except DataIntegrityError as e:
+        if not touched:
+            raise AssertionError(
+                "budget exhausted with no touched row group")
+        for rec in e.records:
+            if rec.get("row_group") not in touched:
+                raise AssertionError(
+                    f"quarantined untouched row group {rec}")
+        return
+    except ParquetError:
+        return  # classified raise: the accepted failure mode
+    for rec in q.log.snapshot():
+        if rec.get("row_group") not in touched:
+            raise AssertionError(f"quarantined untouched row group {rec}")
+    # untouched row groups must decode bit-identically on a fresh reader
+    with FileReader(bytes(buf)) as r:
+        for gi in range(r.num_row_groups):
+            if gi in touched:
+                continue
+            out = r.read_row_group(gi, prefetch=0)
+            for k, want in clean[gi].items():
+                got = np.asarray(out[k].values)
+                if got.shape != want.shape or not np.array_equal(got, want):
+                    raise AssertionError(
+                        f"untouched row group {gi} column {k} diverged")
+
+
+def crafted_page_corrupt_blobs() -> "list[bytes]":
+    """Hand-crafted ``page_corrupt`` inputs (and corpus blobs): one flip in
+    a CRC-covered payload (skip_unit), a page-header flip (raise), a
+    dictionary/zero-region multi-flip (skip_file), budget exhaustion under
+    a tiny budget, and a validate-off single flip (the sanity tier alone)."""
+    whole, spans, _clean = _page_corrupt_base()
+    data_lo = min(lo for lo, _hi in spans)
+    data_hi = max(hi for _lo, hi in spans)
+
+    def rec(pos, xor):
+        return (pos - data_lo).to_bytes(3, "little") + bytes([xor])
+
+    mid0 = (spans[0][0] + spans[0][1]) // 2
+    mid1 = (spans[1][0] + spans[1][1]) // 2
+    mid2 = (spans[2][0] + spans[2][1]) // 2
+    return [
+        # one payload flip, skip_unit, default validate+budget, prefetch 2
+        bytes([1, 0, 1, 1]) + rec(mid1, 0x40),
+        # page-header-ish flip right at a span start, raise policy
+        bytes([0, 0, 1, 0]) + rec(spans[2][0] + 2, 0xFF),
+        # multi-flip across two groups, skip_file
+        bytes([2, 0, 1, 1]) + rec(mid0, 0x10) + rec(mid2, 0x20),
+        # budget exhaustion: tiny budget, flips in every group
+        bytes([1, 0, 0, 0]) + rec(mid0, 0x01) + rec(mid1, 0x02)
+        + rec(mid2, 0x04),
+        # validate off: only the structural sanity tier stands
+        bytes([1, 1, 1, 0]) + rec(mid1, 0x80),
+    ]
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -652,6 +816,7 @@ TARGETS = {
     "narrow": fuzz_narrow,
     "loader_state": fuzz_loader_state,
     "io_ranges": fuzz_io_ranges,
+    "page_corrupt": fuzz_page_corrupt,
 }
 
 
@@ -847,6 +1012,8 @@ def _seed_inputs(target: str) -> list[bytes]:
         ]
     if target == "io_ranges":
         return crafted_io_range_blobs()
+    if target == "page_corrupt":
+        return crafted_page_corrupt_blobs()
     if target == "loader_state":
         from .data import checkpoint as ck
 

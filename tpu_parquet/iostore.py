@@ -562,6 +562,15 @@ class FaultSpec:
     - ``stall_first`` first N attempts block for ``stall_s`` (or until the
       store's :meth:`FaultInjectingStore.release` — the injected "network
       stall" the watchdog must catch);
+    - ``corrupt``     payload corruption mode (``bitflip`` | ``zero`` |
+      ``truncate``, see :func:`tpu_parquet.quarantine.corrupt_bytes`):
+      matched ranges return length-preserving CORRUPTED bytes — the same
+      bytes on every attempt (keyed by ``corrupt_seed ^ offset``, never by
+      attempt or call order), because data corruption is a property of the
+      stored object, not of the transport, and retries must not "heal" it.
+      This is the tier-1 vehicle for the integrity tier + policy engine
+      (quarantine.py): the transport sees a clean read, the CRC/decode
+      sanity checks catch the damage;
     - ``match``       predicate ``(offset, size) -> bool`` choosing which
       ranges are faulty (None = all).
     """
@@ -571,6 +580,8 @@ class FaultSpec:
     torn_first: int = 0
     stall_first: int = 0
     stall_s: float = 30.0
+    corrupt: "str | None" = None
+    corrupt_seed: int = 0
     match: "Callable[[int, int], bool] | None" = None
 
 
@@ -637,6 +648,13 @@ class FaultInjectingStore(GenericRangeStore):
         buf = self.inner.read_range(offset, size)
         if n < spec.fail_first + spec.torn_first and len(buf) > 1:
             return buf[: max(len(buf) // 2, 1)]
+        if spec.corrupt is not None:
+            from .quarantine import corrupt_bytes
+
+            # keyed per RANGE (offset), never per attempt: the same bytes
+            # come back on every retry — corruption lives in the object
+            buf = corrupt_bytes(bytes(buf), spec.corrupt,
+                                spec.corrupt_seed ^ offset)
         return buf
 
 

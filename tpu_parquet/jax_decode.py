@@ -804,9 +804,11 @@ class DeviceChunkDecoder:
     value streams), shipping their (offsets, heap) results to device.
     """
 
-    def __init__(self, leaf: SchemaNode, validate_crc: bool = False):
+    def __init__(self, leaf: SchemaNode, validate_crc: bool = False,
+                 context: "dict | None" = None):
         self.leaf = leaf
         self.validate_crc = validate_crc
+        self.context = dict(context or {})
         self.dict_u8: Optional[jax.Array] = None           # fixed-width dict, u8 rows
         self.dict_dtype: Optional[str] = None              # target dtype name
         self.dict_len: int = 0
@@ -1003,18 +1005,36 @@ class DeviceChunkDecoder:
 
     @scoped_x64
     def decode(self, buf: bytes, codec: int, total_values: int) -> DeviceColumnData:
-        pages = walk_pages(buf, total_values)
+        from .quarantine import error_context
+
+        ctx = dict(self.context)
+        if "column" not in ctx and self.leaf.path:
+            ctx["column"] = ".".join(self.leaf.path)
+        # absolute file offsets in the records, matching the host paths'
+        # (a ledger offset an operator seeks to must be the page's, not a
+        # chunk-relative one)
+        chunk_offset = ctx.pop("chunk_offset", 0) or 0
+        with error_context(**ctx):
+            pages = walk_pages(buf, total_values)
         vals_parts, off_parts, heap_parts = [], [], []
         def_parts, rep_parts = [], []
         slots = 0
+        page_ordinal = 0
         self._idx_maxima = []
         for ps in pages:
             pt = ps.header.type
             if pt == PageType.DICTIONARY_PAGE:
-                self._decode_dict_page(ps, buf, codec)
+                with error_context(offset=chunk_offset + ps.payload_start,
+                                   **ctx):
+                    self._decode_dict_page(ps, buf, codec)
                 continue
             if pt in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
-                v, off, heap, d, r, n = self._decode_data_page(ps, buf, codec)
+                with error_context(page=page_ordinal,
+                                   offset=chunk_offset + ps.payload_start,
+                                   **ctx):
+                    v, off, heap, d, r, n = self._decode_data_page(
+                        ps, buf, codec)
+                page_ordinal += 1
             else:
                 continue
             slots += n
@@ -1078,5 +1098,6 @@ def read_chunk_device(
             f"chunk truncated: wanted {md.total_compressed_size} bytes at {offset}, "
             f"got {len(buf)}"
         )
-    dec = DeviceChunkDecoder(leaf, validate_crc=validate_crc)
+    dec = DeviceChunkDecoder(leaf, validate_crc=validate_crc,
+                             context={"chunk_offset": offset})
     return dec.decode(buf, md.codec, md.num_values)

@@ -139,6 +139,20 @@ def env_int(name: str, default: int, lo=None, hi=None) -> int:
     return _env_num(name, default, int, lo, hi)
 
 
+def warn_env_once(name: str, raw: str, fallback) -> None:
+    """One warning line per bad (env var, value) pair — the shared
+    degradation mechanism for non-numeric knobs (TPQ_ON_DATA_ERROR,
+    TPQ_VALIDATE, TPQ_DATA_ERROR_BUDGET) so a typo never raises and never
+    floods the log (same `_env_warned` set as the numeric knobs)."""
+    key = (name, raw)
+    if key not in _env_warned:
+        _env_warned.add(key)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s=%r is not valid; using %r", name, raw, fallback)
+
+
 # ---------------------------------------------------------------------------
 # latency histograms
 # ---------------------------------------------------------------------------
@@ -1193,6 +1207,7 @@ class StatsRegistry:
         self._reader: "dict | None" = None
         self._loader: "dict | None" = None
         self._io: "dict | None" = None
+        self._data_errors: "dict | None" = None
         self._alloc_peak = 0
         self._hists: dict[str, LatencyHistogram] = {}
 
@@ -1246,6 +1261,18 @@ class StatsRegistry:
                 self._io = {}
             _merge_num_tree(self._io, d)
 
+    def add_data_errors(self, quarantine) -> None:
+        """Fold a :class:`~tpu_parquet.quarantine.Quarantine`'s counters in
+        (the ``data_errors`` section: errors / units_skipped / rows_skipped /
+        files_skipped / by_class — all flows, so multi-engine scans compose
+        by addition).  Raw dicts accepted for tests."""
+        d = (quarantine if isinstance(quarantine, dict)
+             else quarantine.as_dict())
+        with self._lock:
+            if self._data_errors is None:
+                self._data_errors = {}
+            _merge_num_tree(self._data_errors, d)
+
     def note_alloc_peak(self, tracker) -> None:
         """Record an :class:`~tpu_parquet.alloc.AllocTracker`'s high-water
         mark (its ``peak`` attribute; raw ints accepted for tests)."""
@@ -1259,11 +1286,14 @@ class StatsRegistry:
             reader = dict(other._reader) if other._reader else None
             loader = dict(other._loader) if other._loader else None
             io = dict(other._io) if other._io else None
+            data_errors = (dict(other._data_errors)
+                           if other._data_errors else None)
             peak = other._alloc_peak
             hists = dict(other._hists)
         with self._lock:
             for name, src in (("_pipeline", pipeline), ("_reader", reader),
-                              ("_loader", loader), ("_io", io)):
+                              ("_loader", loader), ("_io", io),
+                              ("_data_errors", data_errors)):
                 if src is None:
                     continue
                 dst = getattr(self, name)
@@ -1280,7 +1310,8 @@ class StatsRegistry:
             raise ValueError(
                 f"obs_version {tree.get('obs_version')!r} != {OBS_VERSION}")
         for key, attr in (("pipeline", "_pipeline"), ("reader", "_reader"),
-                          ("loader", "_loader"), ("io", "_io")):
+                          ("loader", "_loader"), ("io", "_io"),
+                          ("data_errors", "_data_errors")):
             src = tree.get(key)
             if src is None:
                 continue
@@ -1352,6 +1383,8 @@ class StatsRegistry:
                 "reader": dict(self._reader) if self._reader else None,
                 "loader": dict(self._loader) if self._loader else None,
                 "io": dict(self._io) if self._io else None,
+                "data_errors": (dict(self._data_errors)
+                                if self._data_errors else None),
                 "alloc": {"peak_bytes": self._alloc_peak},
                 "histograms": {n: h.as_dict()
                                for n, h in sorted(self._hists.items())},
@@ -1643,6 +1676,16 @@ def _classify_frames(frames) -> str:
     return waitish or "running"
 
 
+# exception class names the data-corruption autopsy rule recognizes: the
+# ParquetError family a decode raises for malformed INPUT (HangError /
+# RetryExhaustedError are deliberately absent — hangs and transport faults
+# have their own verdicts)
+_DATA_ERROR_TYPES = frozenset({
+    "ParquetError", "DataIntegrityError", "CompressionError", "RLEError",
+    "ThriftError", "CheckpointError",
+})
+
+
 def autopsy_dump(doc: dict) -> dict:
     """Attribute a flight-recorder dump: which lane stopped advancing
     first, which threads are blocked on what, the longest budget-wait age,
@@ -1685,6 +1728,24 @@ def autopsy_dump(doc: dict) -> dict:
                   default=0.0)
     dead = [t["name"] for t in threads_out.values() if not t["alive"]]
     stalled_first = wd.get("stalled_first")
+    # quarantine state at dump time (quarantine.Quarantine registers itself
+    # as a flight source): recorded data errors + the FIRST bad
+    # (file, column, page) — the data-corruption verdict's evidence
+    q_first = None
+    q_errors = 0
+    for label, s in sorted((doc.get("samples") or {}).items()):
+        if label.startswith("quarantine") and isinstance(s, dict):
+            q_errors += int(s.get("errors") or 0)
+            if q_first is None and isinstance(s.get("first"), dict):
+                q_first = s["first"]
+    err = doc.get("error") or {}
+    data_error = (isinstance(err, dict)
+                  and err.get("type") in _DATA_ERROR_TYPES)
+    # an explicit error of some OTHER class outranks contained quarantine
+    # records: errors the run already moved past must not mask the crash
+    # that actually killed it
+    unrelated_error = (isinstance(err, dict) and err.get("type")
+                       and not data_error)
     # the in-flight range of any IO store at dump time (iostore.IOStats
     # registers itself as a flight source) — a stalled fetch's single most
     # diagnostic fact
@@ -1697,9 +1758,26 @@ def autopsy_dump(doc: dict) -> dict:
                 io_inflight = {"offset": s.get("inflight_offset"),
                                "size": s.get("inflight_size"),
                                "age_s": s.get("inflight_age_s")}
-    # the rule table, most specific first
-    if classes.get("io-wait") or (io_inflight is not None
-                                  and wd.get("stalled_first")):
+    # the rule table, most specific first.  Data corruption never hangs —
+    # an explicit data-integrity error (or quarantined failures on a crash
+    # dump) outranks every stall inference.
+    if data_error or (q_errors and not stalled_first
+                      and not unrelated_error):
+        verdict = "data-corruption"
+        if q_first is not None:
+            where = (f" — first bad: file {q_first.get('file')!r}, column "
+                     f"{q_first.get('column')!r}, row group "
+                     f"{q_first.get('row_group')}, page {q_first.get('page')}")
+        elif isinstance(err, dict) and err.get("message"):
+            where = f" — {err['message']}"
+        else:
+            where = ""
+        cause = (f"the input data is malformed, not the pipeline"
+                 f"{where}; quarantine the named unit "
+                 f"(TPQ_ON_DATA_ERROR=skip_unit contains it, "
+                 f"pq_tool quarantine summarizes the ledger)")
+    elif classes.get("io-wait") or (io_inflight is not None
+                                    and wd.get("stalled_first")):
         verdict = "network-stall"
         where = (f" (offset {io_inflight['offset']}, "
                  f"{io_inflight['size']} bytes, in flight "
@@ -1745,6 +1823,8 @@ def autopsy_dump(doc: dict) -> dict:
         "budget": {"waiters": waiters,
                    "longest_wait_s": round(longest, 3)} if budgets else None,
         "io": io_inflight,
+        "data_errors": ({"errors": q_errors, "first": q_first}
+                        if q_errors or data_error else None),
         "error": doc.get("error"),
         "verdict": verdict,
         "probable_cause": cause,

@@ -19,6 +19,7 @@ import numpy as np
 from .alloc import AllocTracker, InFlightBudget
 from .chunk_decode import ChunkDecoder, read_chunk, validate_chunk_meta
 from .column import ByteArrayData, ColumnData
+from .errors import DataIntegrityError
 from .footer import ParquetError, read_file_metadata
 from .format import FileMetaData, Type
 from .iostore import CoalescedFetcher, require_full, resolve_store
@@ -30,6 +31,19 @@ def _as_path_tuple(col: Union[str, Sequence[str]]) -> tuple[str, ...]:
     if isinstance(col, str):
         return tuple(col.split("."))
     return tuple(col)
+
+
+class _ChunkFailed:
+    """In-band marker for a quarantined chunk riding the ordered prefetch
+    stream (the stream must keep flowing — a raise would kill the pool).
+    Carries the annotated exception; the CONSUMER notes exactly one
+    quarantine record per failed unit (the first failing chunk in column
+    order), so the ledger is identical at every prefetch depth."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
 
 
 class FileReader:
@@ -55,15 +69,18 @@ class FileReader:
         self,
         source: Union[str, os.PathLike, BinaryIO, bytes],
         columns: Optional[Iterable[Union[str, Sequence[str]]]] = None,
-        validate_crc: bool = False,
+        validate_crc=None,
         max_memory: int = 0,
         metadata: Optional[FileMetaData] = None,
         row_filter=None,
         prefetch: int = 0,
         trace=None,
         store=None,
+        on_data_error=None,
+        quarantine=None,
     ):
         from .obs import resolve_tracer
+        from .quarantine import Quarantine, resolve_validate
 
         # span tracer (obs.py): None = the TPQ_TRACE process tracer; a path
         # = per-reader tracer written (with the registry) at close()
@@ -71,12 +88,22 @@ class FileReader:
         if isinstance(source, (str, os.PathLike)):
             self._f: BinaryIO = open(source, "rb")
             self._owns_file = True
+            self._source_name = os.fspath(source)
         elif isinstance(source, (bytes, bytearray, memoryview)):
             self._f = io.BytesIO(bytes(source))
             self._owns_file = False
+            self._source_name = "<memory>"
         else:
             self._f = source
             self._owns_file = False
+            self._source_name = getattr(source, "name", None) or "<stream>"
+        # data-error containment (quarantine.py): ``on_data_error`` picks
+        # the policy (raise | skip_unit | skip_file, TPQ_ON_DATA_ERROR);
+        # ``quarantine=`` shares one engine across readers (scan_files,
+        # DeviceFileReader's host half) so the budget and ledger are global
+        self.quarantine = (quarantine if quarantine is not None
+                           else Quarantine(on_data_error))
+        validate_crc = resolve_validate(validate_crc)
         try:
             self.metadata = (metadata if metadata is not None
                              else read_file_metadata(self._f))
@@ -177,6 +204,8 @@ class FileReader:
         reg.note_alloc_peak(self.alloc)
         if self._store.stats is not None:
             reg.add_io(self._store.stats)
+        if len(self.quarantine.log) or self.quarantine.units_skipped:
+            reg.add_data_errors(self.quarantine)
         return reg
 
     def __enter__(self):
@@ -219,7 +248,7 @@ class FileReader:
         was never used.  See pipeline.PipelineStats.overlap_efficiency."""
         return self._pipe_stats
 
-    def _decode_row_groups(self, indices, k: int):
+    def _decode_row_groups(self, indices, k: int, contain: bool = True):
         """Chunk-granular overlapped decode (the prefetch pipeline).
 
         Work items are (row group, chunk) pairs FLATTENED across ``indices``
@@ -245,6 +274,10 @@ class FileReader:
         sr = self._sr
         store = self._store
         store.begin_scan()  # fresh per-scan retry budget + coalescing state
+        q = self.quarantine
+        contain = contain and q.contains
+        if contain:
+            q.begin_scan(len(indices) if hasattr(indices, "__len__") else None)
         pending: dict[int, dict] = {}  # rg index -> regrouping slot
 
         def gen_items():
@@ -299,19 +332,31 @@ class FileReader:
             i, path, chunk, leaf, fetcher = item
             if chunk is None:
                 return i, None, None
-            md, offset = validate_chunk_meta(chunk, leaf)
-            alloc = AllocTracker(self.alloc.max_size)
-            alloc.register(md.total_compressed_size)
-            with stats.timed("io"):
-                buf = (fetcher.read(offset, md.total_compressed_size)
-                       if fetcher is not None
-                       else sr.pread(offset, md.total_compressed_size))
-            require_full(buf, offset, md.total_compressed_size,
-                         context=f"column {'.'.join(path)}")
-            with stats.timed("decompress"):
-                dec = ChunkDecoder(leaf, validate_crc=self.validate_crc,
-                                   alloc=alloc)
-                cd = dec.decode(buf, md.codec, md.num_values)
+            ctx = {"file": self._source_name, "row_group": i,
+                   "column": ".".join(path)}
+            try:
+                md, offset = validate_chunk_meta(chunk, leaf)
+                alloc = AllocTracker(self.alloc.max_size)
+                alloc.register(md.total_compressed_size)
+                with stats.timed("io"):
+                    buf = (fetcher.read(offset, md.total_compressed_size)
+                           if fetcher is not None
+                           else sr.pread(offset, md.total_compressed_size))
+                require_full(buf, offset, md.total_compressed_size,
+                             context=f"column {'.'.join(path)}")
+                with stats.timed("decompress"):
+                    dec = ChunkDecoder(leaf, validate_crc=self.validate_crc,
+                                       alloc=alloc,
+                                       context={**ctx, "chunk_offset": offset})
+                    cd = dec.decode(buf, md.codec, md.num_values)
+            except ParquetError as e:
+                # containment seam (quarantine.py): under a skip policy the
+                # failure becomes a marker + a poisoned unit instead of an
+                # aborted scan; the CONSUMER notes the record (once per
+                # unit, ordered — so the ledger matches prefetch=0 exactly)
+                if not contain or isinstance(e, DataIntegrityError):
+                    raise
+                return i, ".".join(path), _ChunkFailed(e)
             stats.count_chunk()
             return i, ".".join(path), cd
 
@@ -321,15 +366,38 @@ class FileReader:
                                         stats=stats):
             slot = pending[i]
             if name is not None:
-                slot["out"][name] = cd
+                if isinstance(cd, _ChunkFailed):
+                    slot.setdefault("failed", cd)
+                else:
+                    slot["out"][name] = cd
             slot["todo"] -= 1
             if slot["todo"] == 0:
+                del pending[i]
+                failed = slot.get("failed")
+                if failed is not None:
+                    # a quarantined unit: ONE record (the first failing
+                    # chunk), nothing yielded, the skip accounted;
+                    # skip_file on a single-file reader ends the scan here.
+                    # note() raises DataIntegrityError on budget exhaustion.
+                    q.note(failed.exc, file=self._source_name, row_group=i)
+                    rg = self.metadata.row_groups[i]
+                    q.note_unit_skipped(int(rg.num_rows or 0))
+                    if q.policy == "skip_file":
+                        # collateral: the file's remaining groups are
+                        # accounted (results yield in order, so none of
+                        # them has been yielded yet)
+                        q.note_file_skipped()
+                        pos = list(indices).index(i)
+                        for j in list(indices)[pos + 1:]:
+                            q.note_unit_skipped(int(
+                                self.metadata.row_groups[j].num_rows or 0))
+                        break
+                    continue
                 missing = slot["expect"] - set(slot["out"])
                 if missing:
                     raise ParquetError(
                         f"row group {i} missing columns {sorted(missing)}"
                     )
-                del pending[i]
                 stats.count_row_group()
                 stats.note_peak(budget)
                 stats.touch_wall()
@@ -349,7 +417,10 @@ class FileReader:
             raise IndexError(f"row group {index} of {self.num_row_groups}")
         k = self.prefetch if prefetch is None else int(prefetch)
         if k > 0:
-            for _i, out in self._decode_row_groups([index], k):
+            # contain=False: an EXPLICITLY requested group must raise, not
+            # silently skip itself (the iteration APIs own the skip policy)
+            for _i, out in self._decode_row_groups([index], k,
+                                                   contain=False):
                 return out
         rg = self.metadata.row_groups[index]
         self.alloc.reset()
@@ -375,6 +446,7 @@ class FileReader:
             out[".".join(path)] = read_chunk(
                 f, chunk, leaf,
                 validate_crc=self.validate_crc, alloc=self.alloc,
+                context={"file": self._source_name, "row_group": index},
             )
         missing = set(".".join(p) for p in by_path) - set(out)
         if missing:
@@ -389,8 +461,30 @@ class FileReader:
             for _i, out in self._decode_row_groups(selected, k):
                 yield out
             return
+        q = self.quarantine
+        q.begin_scan(len(selected))
         for i in selected:
-            yield self.read_row_group(i, prefetch=0)
+            if not q.contains:
+                yield self.read_row_group(i, prefetch=0)
+                continue
+            try:
+                out = self.read_row_group(i, prefetch=0)
+            except ParquetError as e:
+                # containment (quarantine.py): the unit is recorded and
+                # skipped; a budget-exhausted DataIntegrityError aborts
+                if isinstance(e, DataIntegrityError):
+                    raise
+                q.note(e, file=self._source_name, row_group=i)
+                q.note_unit_skipped(
+                    int(self.metadata.row_groups[i].num_rows or 0))
+                if q.policy == "skip_file":
+                    q.note_file_skipped()
+                    for j in selected[selected.index(i) + 1:]:
+                        q.note_unit_skipped(int(
+                            self.metadata.row_groups[j].num_rows or 0))
+                    return
+                continue
+            yield out
 
     def read_all(self, prefetch: Optional[int] = None) -> dict[str, ColumnData]:
         """Concatenate all row groups' columns (convenience for small files).
