@@ -443,6 +443,17 @@ def bench_device(path, rows, name=""):
     if tracer is not None:
         log(f"  trace artifact: {tracer.write(registry=reg)}")
     ship["obs"] = reg.as_dict()
+    # the per-route device completion lane (TPQ_DEVICE_TIMING, default on):
+    # smoke exercises this section end to end, and the ledger diff
+    # attributes device regressions to a specific route from it
+    dev = ship["obs"].get("device")
+    if dev:
+        log(f"  device lanes: dispatches={dev.get('dispatches')} "
+            f"device_seconds={dev.get('device_seconds')} "
+            f"routes={sorted((dev.get('routes') or {}))} "
+            f"h2d_s={(dev.get('h2d') or {}).get('device_seconds')}")
+    else:
+        log("  device lanes: n/a (timing lane disabled)")
     return samples, ship
 
 
@@ -1460,7 +1471,8 @@ def main(argv=None):
     import threading
 
     leaked = [t.name for t in threading.enumerate()
-              if t.name.startswith(("tpq-sampler", "tpq-watchdog"))]
+              if t.name.startswith(("tpq-sampler", "tpq-watchdog",
+                                    "tpq-devtimer"))]
     if leaked:
         log(f"FAIL: obs daemon threads leaked after completion: {leaked}")
         sys.exit(3)

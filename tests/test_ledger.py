@@ -564,8 +564,14 @@ def test_bench_smoke_check_against_end_to_end(tmp_path):
     host = (g("io_seconds") + g("decompress_seconds")
             + g("recompress_seconds")) or float(
         (tree.get("reader") or {}).get("host_seconds") or 0.0)
+    dev = tree.get("device") or {}
+    dev_resolve = sum(float(c.get("device_seconds") or 0.0)
+                      for c in (dev.get("routes") or {}).values())
     lanes = {"link": g("stage_seconds"), "host_decompress": host,
-             "device_resolve": g("dispatch_seconds") + g("finalize_seconds"),
+             "device_resolve": dev_resolve or (g("dispatch_seconds")
+                                               + g("finalize_seconds")),
+             "h2d": float((dev.get("h2d") or {}).get("device_seconds")
+                          or 0.0),
              "stall": g("stall_seconds")}
     assert rep["dominant_lane"] == max(lanes, key=lambda k: (lanes[k], k))
     assert rep["dominant_share"] == pytest.approx(

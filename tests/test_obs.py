@@ -285,18 +285,22 @@ def _full_registry():
 def test_registry_tree_golden_keys():
     tree = _full_registry().as_dict()
     assert set(tree) == {"obs_version", "pipeline", "reader", "loader",
-                         "io", "data_errors", "alloc", "histograms"}
+                         "io", "data_errors", "device", "alloc",
+                         "histograms"}
     assert tree["io"] is None  # no IO-backend stats were folded in
     assert tree["data_errors"] is None  # no quarantine engine folded in
+    assert tree["device"] is None  # no device timing was folded in
     assert tree["obs_version"] == OBS_VERSION
-    assert tree["alloc"] == {"peak_bytes": 4096}
+    assert tree["alloc"] == {"peak_bytes": 4096, "device_peak_bytes": 0}
     assert set(tree["histograms"]) == {"stage.io", "stage.stage"}
     fb = tree["reader"]["ship_feedback"]
     assert set(fb) == {"link_bytes_per_sec", "routes"}
     assert set(fb["routes"]) == {"plain", "recompress"}
     r = fb["routes"]["recompress"]
     assert {"streams", "shipped_bytes", "predicted_seconds",
-            "measured_seconds", "error_ratio"} == set(r)
+            "measured_seconds", "error_ratio",
+            "device_predicted_seconds", "device_measured_seconds",
+            "device_error_ratio"} == set(r)
     # measured = shipped / (staged/stage_seconds); stage=0.02s over 220 bytes
     assert r["measured_seconds"] == pytest.approx(120 / (220 / 0.02), rel=1e-3)
     json.dumps(tree)  # artifact-ready
@@ -408,18 +412,21 @@ def test_reader_stats_as_dict_golden_keys():
     from tpu_parquet.device_reader import ReaderStats
 
     rs = ReaderStats()
-    rs.count_route("plain", 10, 10, 0.5)
+    rs.count_route("plain", 10, 10, 0.5, 0.25)
     d = rs.as_dict()
     assert set(d) == {
         "row_groups", "chunks", "pages", "pages_device_expanded",
         "pages_pruned", "rows", "compressed_bytes", "staged_bytes",
         "link_bytes_logical", "link_bytes_shipped", "ship_routes",
-        "planner_link_mbps", "host_seconds", "device_seconds",
+        "planner_link_mbps", "host_seconds", "stage_seconds",
+        "dispatch_seconds",
         "wall_seconds", "rows_per_sec", "bytes_per_sec", "pages_per_chunk",
     }
-    assert set(d["ship_routes"]["plain"]) == {"streams", "logical",
-                                             "shipped", "predicted_s"}
+    assert set(d["ship_routes"]["plain"]) == {
+        "streams", "logical", "shipped", "predicted_s",
+        "predicted_device_s"}
     assert d["ship_routes"]["plain"]["predicted_s"] == 0.5
+    assert d["ship_routes"]["plain"]["predicted_device_s"] == 0.25
 
 
 def test_loader_stats_as_dict_golden_keys():
@@ -1067,22 +1074,29 @@ def test_doctor_on_traced_run_matches_registry(tmp_path):
     tree = json.loads(open(tp).read())["otherData"]["registry"]
     rep = doctor_registry(tree)
     assert rep is not None
-    # recompute the four lanes independently from the embedded registry
+    # recompute the lanes independently from the embedded registry (the
+    # device lanes come from the measured `device` section when present)
     pipe = tree["pipeline"]
+    dev = tree.get("device") or {}
 
     def g(k):
         return float(pipe.get(k) or 0.0)
 
+    dev_resolve = sum(float(c.get("device_seconds") or 0.0)
+                      for c in (dev.get("routes") or {}).values())
     lanes = {
         "link": g("stage_seconds"),
         "host_decompress": (g("io_seconds") + g("decompress_seconds")
                             + g("recompress_seconds")),
-        "device_resolve": g("dispatch_seconds") + g("finalize_seconds"),
+        "device_resolve": dev_resolve or (g("dispatch_seconds")
+                                          + g("finalize_seconds")),
+        "h2d": float((dev.get("h2d") or {}).get("device_seconds") or 0.0),
         "stall": g("stall_seconds"),
     }
     dominant = max(lanes, key=lanes.get)
     assert rep["dominant_lane"] == dominant
-    assert rep["lanes"][dominant] == pytest.approx(lanes[dominant], rel=1e-6)
+    # doctor rounds lane seconds to 6 decimals for the report
+    assert rep["lanes"][dominant] == pytest.approx(lanes[dominant], abs=1e-6)
     assert rep["dominant_share"] == pytest.approx(
         lanes[dominant] / sum(lanes.values()), rel=0.10)
     # the CLI renders the same verdict from the artifact alone

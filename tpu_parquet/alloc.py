@@ -34,9 +34,12 @@ def tracker_snapshots() -> "list[dict]":
     out = []
     for t in list(_LIVE_TRACKERS):
         in_use, peak = t.snapshot()
-        if in_use or peak:
+        dev_in_use, dev_peak = t.device_snapshot()
+        if in_use or peak or dev_in_use or dev_peak:
             out.append({"in_use": in_use, "peak": peak,
-                        "max_size": t.max_size})
+                        "max_size": t.max_size,
+                        "device_in_use": dev_in_use,
+                        "device_peak": dev_peak})
     return out
 
 
@@ -53,12 +56,23 @@ class MemoryBudgetExceeded(MemoryError):
 
 
 class AllocTracker:
-    """Running byte counter with a hard cap (0 = unlimited)."""
+    """Running byte counter with a hard cap (0 = unlimited).
+
+    Alongside the HOST ledger (decompressed pages, value arrays) the
+    tracker carries a DEVICE-bytes ledger: staged HBM buffers register at
+    dispatch (:meth:`register_device`) and release on donation/finalize —
+    the residency accounting behind the ``device_bytes`` sampler track and
+    the flight dump's tracker section.  The device ledger is pure
+    bookkeeping: it never raises against ``max_size`` (HBM exhaustion is
+    the runtime's error to report, and the budget models host memory).
+    """
 
     def __init__(self, max_size: int = 0):
         self.max_size = int(max_size)
         self.total = 0
         self.peak = 0  # high-water mark (obs.StatsRegistry reports it)
+        self.device_total = 0  # staged HBM bytes currently resident
+        self.device_peak = 0   # HBM residency high-water mark
         self._lock = threading.Lock()
         _LIVE_TRACKERS.add(self)
 
@@ -94,12 +108,32 @@ class AllocTracker:
         with self._lock:
             self.total = 0
 
+    def register_device(self, nbytes: int) -> None:
+        """Account staged HBM bytes (a row-group buffer at dispatch).
+        Never raises — see the class docstring's device-ledger contract."""
+        with self._lock:
+            self.device_total += int(nbytes)
+            if self.device_total > self.device_peak:
+                self.device_peak = self.device_total
+
+    def release_device(self, nbytes: int) -> None:
+        """Release staged HBM bytes (donation consumed them, or finalize
+        proved every kernel that reads them has completed)."""
+        with self._lock:
+            self.device_total -= int(nbytes)
+
     def snapshot(self) -> "tuple[int, int]":
         """Consistent ``(in_use, peak)`` pair for the obs.Sampler's
         watermark track (reading the attributes separately can pair a new
         total with a stale peak mid-register)."""
         with self._lock:
             return self.total, self.peak
+
+    def device_snapshot(self) -> "tuple[int, int]":
+        """Consistent ``(device_in_use, device_peak)`` pair — the HBM
+        residency twin of :meth:`snapshot`."""
+        with self._lock:
+            return self.device_total, self.device_peak
 
 
 class InFlightBudget:

@@ -9,12 +9,14 @@ Command parity with the reference's parquet-tool (cmd/parquet-tool/cmds/):
     rowcount  number of rows from the footer       (rowcount.go:16-37)
     stats     per-row-group column min/max/null_count (beyond the reference)
     split     re-shard into parts of at most a given size (split.go:31-117)
-    trace     summarize a TPQ_TRACE run (per-stage p50/p95, overlap
-              efficiency, stall attribution, ship-route prediction error)
+    trace     summarize a TPQ_TRACE run (per-stage p50/p95 incl. the
+              device.<route> completion lanes, overlap efficiency, stall
+              attribution, ship-route prediction error)
     doctor    rule-based bottleneck attribution of a traced run (link- vs
-              host-decompress- vs stall- vs device-resolve-bound), with the
-              recalibrated TPQ_LINK_MBPS when the routes disagree with the
-              ship planner's cost model
+              host-decompress- vs stall- vs device-resolve- vs h2d-bound,
+              naming the dominant device route/kernel), with the
+              recalibrated TPQ_LINK_MBPS / TPQ_DEVICE_MBPS when the routes
+              disagree with the ship planner's cost model
     autopsy   post-mortem of a flight-recorder dump (the watchdog's or
               TPQ_DUMP_SIGNAL's hang/crash snapshot): stalled lane,
               blocked-thread classification, probable cause
@@ -367,6 +369,28 @@ def cmd_doctor(args, out=sys.stdout) -> int:
     if recal is not None:
         out.write(f"recalibrate: re-run with TPQ_LINK_MBPS={recal:g} "
                   f"(the measured staging rate) to align the planner\n")
+    dv = rep.get("device")
+    if dv:
+        err = dv.get("error_ratio")
+        out.write(
+            f"device: dominant route {dv['dominant_route']!r}"
+            + (f" (kernel {dv['dominant_kernel']})"
+               if dv.get("dominant_kernel") else "")
+            + f", measured {dv['measured_seconds']:.4f}s"
+            + (f", predicted {dv['predicted_seconds']:.4f}s "
+               f"(error {err:.2f}x)" if err is not None
+               else ", prediction n/a")
+            + "\n")
+        drecal = rep.get("recalibrate_device_mbps")
+        if drecal is not None:
+            out.write(f"recalibrate: re-run with TPQ_DEVICE_MBPS={drecal:g} "
+                      f"(the measured device-resolve rate) to align the "
+                      f"planner's device lane\n")
+    else:
+        # records predating the device registry section (or runs with
+        # TPQ_DEVICE_TIMING=0): explicitly n/a, never a KeyError
+        out.write("device: n/a (no device section — record predates device "
+                  "timing, or TPQ_DEVICE_TIMING=0)\n")
     return 0
 
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 import functools
 import os
 import threading
+import time
 from contextlib import nullcontext as _noop_ctx
 from dataclasses import dataclass, field
 from typing import Optional
@@ -59,8 +60,8 @@ from .ship import (
     default_planner,
 )
 
-__all__ = ["DeviceFileReader", "ReaderStats", "decode_chunk_batched",
-           "DeviceDictColumn", "scan_files"]
+__all__ = ["DeviceFileReader", "DeviceStats", "ReaderStats",
+           "decode_chunk_batched", "DeviceDictColumn", "scan_files"]
 
 
 @dataclass
@@ -1107,13 +1108,20 @@ class _Plan:
       returns it.
     """
 
-    __slots__ = ("key", "fn", "dyn", "build")
+    __slots__ = ("key", "fn", "dyn", "build", "route", "bytes_in",
+                 "bytes_staged")
 
     def __init__(self, key, fn, dyn, build):
         self.key = key
         self.fn = fn
         self.dyn = tuple(dyn)
         self.build = build
+        # device-timing attribution (set by _prepare_row_group from the
+        # chunk's ship records): the dominant ship route plus the column's
+        # logical/shipped byte totals — never part of the executable key
+        self.route = None
+        self.bytes_in = 0
+        self.bytes_staged = 0
 
 
 _FUSED_CACHE: dict = {}
@@ -1220,10 +1228,15 @@ def _fused_for(key, fns, arities):
         return jitted
 
 
-def _run_plans(plans, buf_dev):
+def _run_plans(plans, buf_dev, timer: "_DeviceTimer | None" = None):
     """Execute ``[(name, _Plan)]`` against the staged buffer: pass-throughs
     directly, everything else through per-plan cached jits with
-    device-memoized arguments (or one fused call under TPQ_FUSE_RG=1)."""
+    device-memoized arguments (or one fused call under TPQ_FUSE_RG=1).
+
+    With a ``timer`` (the reader's completion-timing lane), each traced
+    plan's raw jit outputs are handed to the worker with the dispatch
+    timestamp and the plan's ship-route attribution — the per-route device
+    seconds in the registry's ``device`` section."""
     out = {}
     traced = []
     for name, p in plans:
@@ -1233,6 +1246,7 @@ def _run_plans(plans, buf_dev):
             traced.append((name, p))
     if not traced:
         return out
+    timing = timer is not None and timer.enabled
     if _FUSE_RG:
         key = tuple(p.key for _, p in traced)
         fused = _fused_for(
@@ -1241,13 +1255,31 @@ def _run_plans(plans, buf_dev):
             tuple(len(p.dyn) for _, p in traced),
         )
         dyn = tuple(_memo_dev(x) for _, p in traced for x in p.dyn)
+        t0 = time.perf_counter() if timing else 0.0
         results = fused(buf_dev, dyn)
+        if timing:
+            # ONE executable ran: one timing entry, attributed to the
+            # dominant (most-bytes-in) plan — per-plan submissions with
+            # the shared t0 would each bank the whole fused wall and sum
+            # to ~N_plans x the real device time
+            dom = max((p for _, p in traced), key=lambda p: p.bytes_in)
+            timer.submit("dispatch", dom.route or ROUTE_PLAIN,
+                         _kernel_family(dom.key), results, t0,
+                         bytes_in=sum(p.bytes_in for _, p in traced),
+                         bytes_staged=sum(p.bytes_staged
+                                          for _, p in traced))
         for (name, p), res in zip(traced, results):
             out[name] = p.build(res)
         return out
     for name, p in traced:
         jfn = _single_for(p.key, p.fn)
-        out[name] = p.build(jfn(buf_dev, *(_memo_dev(x) for x in p.dyn)))
+        t0 = time.perf_counter() if timing else 0.0
+        res = jfn(buf_dev, *(_memo_dev(x) for x in p.dyn))
+        if timing:
+            timer.submit("dispatch", p.route or ROUTE_PLAIN,
+                         _kernel_family(p.key), res, t0,
+                         bytes_in=p.bytes_in, bytes_staged=p.bytes_staged)
+        out[name] = p.build(res)
     return out
 
 
@@ -1311,22 +1343,30 @@ class _ChunkAssembler:
         self._ship_pref: "list | None" = None
         self._ship: dict = {}
         self._ship_costs: dict = {}  # route -> planner's modeled seconds
+        self._ship_dev_costs: dict = {}  # route -> modeled DEVICE seconds
         self._dict_costs: dict = {}  # same, for the dictionary value table
+        self._dict_dev_costs: dict = {}
         self._dict_ship: "tuple | None" = None  # (route, payload, out_len)
         self._bytes_walk: "tuple | None" = None  # (lens_l, span_l)
         self._narrow_compress = False
         self.ship_records: list = []
 
     def _record_ship(self, route: str, logical: int, shipped: int,
-                     predicted: "float | None" = None) -> None:
+                     predicted: "float | None" = None,
+                     predicted_device: "float | None" = None) -> None:
         # the planner's modeled seconds for the route that actually ran —
         # obs.StatsRegistry.ship_feedback puts it next to the measured link
         # lane (TPQ_LINK_MBPS calibration); value-stream records default to
-        # the preship plan's cost table, dict-table records pass their own
+        # the preship plan's cost table, dict-table records pass their own.
+        # The device-lane prediction rides the same record so the measured
+        # per-route completion timing has a model to calibrate against.
         if predicted is None:
             predicted = self._ship_costs.get(route, 0.0)
+        if predicted_device is None:
+            predicted_device = self._ship_dev_costs.get(route, 0.0)
         self.ship_records.append(
-            (route, int(logical), int(shipped), float(predicted)))
+            (route, int(logical), int(shipped), float(predicted),
+             float(predicted_device)))
 
     def _route_enabled(self, route: str) -> bool:
         """Whether the planner ranked ``route`` ahead of the plain tail
@@ -1494,11 +1534,14 @@ class _ChunkAssembler:
             k = _span_bytes(*self.stats_span)
             if k <= _narrow_max_k(width):
                 narrow_k = k
-        self._ship_pref, self._ship_costs = planner.plan(ChunkFacts(
+        facts = ChunkFacts(
             logical=logical, width=width, narrow_k=narrow_k,
             narrow_possible=is_int and native.available(),
             comp_bytes=comp_bytes, native=native.available(),
-        ))
+        )
+        self._ship_pref, self._ship_costs = planner.plan(facts)
+        self._ship_dev_costs = planner.device_costs(
+            facts, routes=self._ship_costs)
         # failed host work is memoized as a None sentinel so the finish
         # builders (and a later pref entry naming the same family) never
         # repeat a full-chunk scan that already failed — preship exists to
@@ -1557,9 +1600,12 @@ class _ChunkAssembler:
         logical = sum(span_l)
         comp_bytes = sum(len(p.comp[0]) for p in self.pages
                          if p.comp is not None)
-        self._ship_pref, self._ship_costs = planner.plan(ChunkFacts(
+        facts = ChunkFacts(
             logical=logical, width=0, comp_bytes=comp_bytes, native=True,
-        ))
+        )
+        self._ship_pref, self._ship_costs = planner.plan(facts)
+        self._ship_dev_costs = planner.device_costs(
+            facts, routes=self._ship_costs)
         for route in self._ship_pref:
             if route == ROUTE_DEVICE_SNAPPY:
                 if comp_bytes:
@@ -1611,6 +1657,8 @@ class _ChunkAssembler:
             host_bytes_ready=True,  # dict pages always decompress on host
         )
         dict_routes, self._dict_costs = planner.plan(facts)
+        self._dict_dev_costs = planner.device_costs(
+            facts, routes=self._dict_costs)
         for route in dict_routes:
             if route == ROUTE_DEVICE_SNAPPY and comp0 is not None:
                 self._dict_ship = (route, comp0[0], comp0[1])
@@ -2412,7 +2460,9 @@ class _ChunkAssembler:
                     # finalize before a clamped gather can escape.
                     self._record_ship(
                         ship[0], dict_u8.nbytes, info.shipped,
-                        predicted=self._dict_costs.get(ship[0], 0.0))
+                        predicted=self._dict_costs.get(ship[0], 0.0),
+                        predicted_device=self._dict_dev_costs.get(
+                            ship[0], 0.0))
                     dyn.append(np.int64(info.tbase))
                     dkey = ("du8s", dict_kp, dict_itemsize, info.n_ops,
                             info.out_pad, info.iters)
@@ -2456,7 +2506,9 @@ class _ChunkAssembler:
                     # same garbage contract as the plain route's padding
                     self._record_ship(
                         ship[0], rheap.nbytes, info.shipped,
-                        predicted=self._dict_costs.get(ship[0], 0.0))
+                        predicted=self._dict_costs.get(ship[0], 0.0),
+                        predicted_device=self._dict_dev_costs.get(
+                            ship[0], 0.0))
                     dyn.extend((np.int64(roff_base), np.int64(info.tbase)))
                     dkey = ("drags", roff_n, rheap_room, info.n_ops,
                             info.out_pad, info.iters)
@@ -2915,7 +2967,14 @@ class ReaderStats:
     compressed_bytes: int = 0      # chunk bytes read from the file
     staged_bytes: int = 0          # HBM bytes shipped (row-group buffers)
     host_seconds: float = 0.0      # decompress + structure parse + assembly
-    device_seconds: float = 0.0    # stage + dispatch (not queue drain)
+    # the round-13 `device_seconds` scalar double-counted wall time: the
+    # staging worker and the dispatching thread both added their (possibly
+    # CONCURRENT) intervals to it, so the sum could exceed the device lane's
+    # wall.  Split lanes — on a serial (prefetch=0) run host + stage +
+    # dispatch sums back to ~wall (regression-tested); on a pipelined run
+    # the lanes overlap and each is honest on its own.
+    stage_seconds: float = 0.0     # host->device staging (worker or inline)
+    dispatch_seconds: float = 0.0  # issuing fused XLA calls (not queue drain)
     wall_seconds: float = 0.0
     # ship-planner accounting (ship.py): per-route stream counts and byte
     # totals.  `logical` is what plain shipping would have moved; `shipped`
@@ -2928,13 +2987,18 @@ class ReaderStats:
     # route — obs.StatsRegistry.ship_feedback compares them to the measured
     # link lane (staged bytes / stage seconds) for TPQ_LINK_MBPS calibration
     route_pred_seconds: dict = field(default_factory=dict)
+    # the model's DEVICE-lane seconds per route (ship.ShipPlanner
+    # .device_costs) — ship_feedback compares them to the measured per-route
+    # completion timing (DeviceStats) for TPQ_DEVICE_MBPS calibration
+    route_pred_device_seconds: dict = field(default_factory=dict)
     # the link rate the planner ASSUMED (TPQ_LINK_MBPS or the default
     # planning point) — pq_tool doctor prints it next to the measured rate
     # so a recalibration names both sides
     planner_link_mbps: float = 0.0
 
     def count_route(self, route: str, logical: int, shipped: int,
-                    predicted: float = 0.0) -> None:
+                    predicted: float = 0.0,
+                    predicted_device: float = 0.0) -> None:
         self.route_streams[route] = self.route_streams.get(route, 0) + 1
         self.route_bytes_logical[route] = (
             self.route_bytes_logical.get(route, 0) + logical)
@@ -2942,6 +3006,8 @@ class ReaderStats:
             self.route_bytes_shipped.get(route, 0) + shipped)
         self.route_pred_seconds[route] = (
             self.route_pred_seconds.get(route, 0.0) + predicted)
+        self.route_pred_device_seconds[route] = (
+            self.route_pred_device_seconds.get(route, 0.0) + predicted_device)
 
     @property
     def link_bytes_logical(self) -> int:
@@ -2983,17 +3049,383 @@ class ReaderStats:
                     # not round to a 0.0 that ship_feedback would read as
                     # "no prediction" (nulling the error ratio)
                     "predicted_s": round(
-                        self.route_pred_seconds.get(r, 0.0), 9)}
+                        self.route_pred_seconds.get(r, 0.0), 9),
+                    "predicted_device_s": round(
+                        self.route_pred_device_seconds.get(r, 0.0), 9)}
                 for r in sorted(self.route_streams)
             },
             "planner_link_mbps": round(self.planner_link_mbps, 1),
             "host_seconds": round(self.host_seconds, 6),
-            "device_seconds": round(self.device_seconds, 6),
+            "stage_seconds": round(self.stage_seconds, 6),
+            "dispatch_seconds": round(self.dispatch_seconds, 6),
             "wall_seconds": round(self.wall_seconds, 6),
             "rows_per_sec": round(self.rows_per_sec, 1),
             "bytes_per_sec": round(self.bytes_per_sec, 1),
             "pages_per_chunk": round(self.pages_per_chunk, 3),
         }
+
+
+# ---------------------------------------------------------------------------
+# per-route device timing (the completion-side lane, TPQ_DEVICE_TIMING)
+# ---------------------------------------------------------------------------
+
+# plan-key leading token -> kernel family, the granularity the device lane
+# is attributed at (doctor names "the gather family of the dict route", not
+# an opaque executable hash).  Families follow the decode pipeline's device
+# passes: snappy_resolve (op-table source-map resolves), unpack (bitpack /
+# delta reconstruction), gather (dictionary index gathers), narrow
+# (widen/re-bias of truncated ints), levels (RLE-hybrid level expansion),
+# plain (reshape/bitcast-only decodes and host pass-throughs).
+_KERNEL_FAMILIES = {
+    "snappy": "snappy_resolve", "bytess": "snappy_resolve",
+    "narrows": "narrow", "narrow": "narrow",
+    "lvlx": "levels", "lvlp": "levels",
+    "dict": "gather", "mixed": "gather",
+    "hyb": "unpack", "hybvw": "unpack", "delta": "unpack",
+    "plain": "plain", "rows": "plain", "bytes": "plain", "bytesh": "plain",
+    "bool": "plain",
+}
+
+
+def _kernel_family(key) -> str:
+    """Kernel family of a plan key (a ``("col", value_key, ...)`` composite
+    classifies by its VALUE plan — levels ride every column)."""
+    if isinstance(key, tuple) and key:
+        if key[0] == "col":
+            return _kernel_family(key[1])
+        return _KERNEL_FAMILIES.get(key[0], "plain")
+    return "plain"
+
+
+def _device_timing_enabled() -> bool:
+    """Whether the completion-timing lane may run: ``TPQ_DEVICE_TIMING``
+    (default on) AND a live jax backend to time against.  A host with no
+    usable device (mis-set JAX_PLATFORMS, driverless box) drops the lane
+    with ONE warning instead of failing every reader construction — the
+    CPU backend counts as a device (block_until_ready is its clock)."""
+    from .obs import env_int, warn_env_once
+
+    if env_int("TPQ_DEVICE_TIMING", 1, lo=0) == 0:
+        return False
+    try:
+        ok = bool(jax.devices())
+    except Exception:  # noqa: BLE001 — no backend is a disable, not a raise
+        ok = False
+    if not ok:
+        warn_env_once("TPQ_DEVICE_TIMING", "<no jax device>",
+                      "disabled (no device clock)")
+        return False
+    return True
+
+
+class DeviceStats:
+    """Per-route / per-kernel-family device completion timing counters.
+
+    The device half of :class:`~tpu_parquet.pipeline.PipelineStats`: where
+    the pipeline's ``dispatch_seconds`` is the HOST wall of issuing async
+    XLA calls (microseconds), these are the seconds until the dispatched
+    work actually COMPLETED on device (``block_until_ready``), keyed by
+    ship route and kernel family — the attribution the plain_int64 gap and
+    the fused-megakernel work need (ROADMAP direction 2).
+
+    Per route: ``dispatches`` (fused column dispatches timed),
+    ``device_seconds`` (dispatch→completion), ``bytes_in`` (logical output
+    bytes the kernels produce — the planner's per-OUTPUT-byte device charge,
+    so ``bytes_in / device_seconds`` IS the measured ``TPQ_DEVICE_MBPS``),
+    and ``bytes_staged`` (link bytes staged for the route's columns).
+    ``h2d`` times the staged row-group buffer transfers the same way.
+    Thread-safe: the timing worker accumulates while readers snapshot.
+
+    Caveat — completion semantics: the worker serializes each interval
+    against the previous completion (see ``_devtimer_worker``), so the
+    per-route seconds partition ONE device timeline — route shares of
+    the serialized device lane, never a sum that can exceed it.  Per-op
+    exclusive kernel time is ``TPQ_XPROF``'s job, not this lane's.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._routes: dict = {}   # route -> [dispatches, s, b_in, b_staged]
+        self._kernels: dict = {}  # family -> [dispatches, s]
+        self._h2d = [0, 0.0, 0]   # transfers, seconds, bytes
+
+    def note_dispatch(self, route: str, family: str, seconds: float,
+                      bytes_in: int = 0, bytes_staged: int = 0) -> None:
+        with self._lock:
+            r = self._routes.setdefault(route, [0, 0.0, 0, 0])
+            r[0] += 1
+            r[1] += seconds
+            r[2] += int(bytes_in)
+            r[3] += int(bytes_staged)
+            k = self._kernels.setdefault(family, [0, 0.0])
+            k[0] += 1
+            k[1] += seconds
+
+    def note_h2d(self, seconds: float, nbytes: int = 0) -> None:
+        with self._lock:
+            self._h2d[0] += 1
+            self._h2d[1] += seconds
+            self._h2d[2] += int(nbytes)
+
+    def progress(self) -> dict:
+        """Cumulative counters for the sampler's ``device`` track and the
+        watchdog heartbeat (their slope is live device throughput)."""
+        with self._lock:
+            return {
+                "dispatches": sum(r[0] for r in self._routes.values()),
+                "device_seconds": round(
+                    sum(r[1] for r in self._routes.values()), 6),
+                "h2d_transfers": self._h2d[0],
+                "h2d_seconds": round(self._h2d[1], 6),
+            }
+
+    def as_dict(self) -> dict:
+        # 9 decimals on seconds: a tiny run's sub-µs kernel must not round
+        # to a 0.0 that ship_feedback would read as "unmeasured" (same
+        # contract as ReaderStats.predicted_s)
+        with self._lock:
+            return {
+                "dispatches": sum(r[0] for r in self._routes.values()),
+                "device_seconds": round(
+                    sum(r[1] for r in self._routes.values()), 9),
+                "routes": {
+                    route: {"dispatches": r[0],
+                            "device_seconds": round(r[1], 9),
+                            "bytes_in": r[2], "bytes_staged": r[3]}
+                    for route, r in sorted(self._routes.items())
+                },
+                "kernels": {
+                    fam: {"dispatches": k[0],
+                          "device_seconds": round(k[1], 9)}
+                    for fam, k in sorted(self._kernels.items())
+                },
+                "h2d": {"transfers": self._h2d[0],
+                        "device_seconds": round(self._h2d[1], 9),
+                        "bytes": self._h2d[2]},
+            }
+
+
+class _DeviceTimer:
+    """Completion-side timing worker for the device lane.
+
+    Dispatches (and staged transfers) are ASYNC — blocking the dispatching
+    thread on ``block_until_ready`` would serialize the very pipeline the
+    timing is meant to attribute.  Instead each dispatch hands its output
+    arrays (plus route/family/bytes and its dispatch timestamp) to one
+    daemon worker (``tpq-devtimer``, covered by bench.py's zero-leaked-
+    daemon-threads gate) that blocks until the work completes and folds
+    ``t_complete - t_dispatch`` into :class:`DeviceStats` — and, when a
+    tracer is listening, emits a ``device.<route>`` span so ``pq_tool
+    trace`` prints device lanes in the same p50/p95 table as the host
+    stages.
+
+    Disabled (``TPQ_DEVICE_TIMING=0`` or no backend): ``submit`` is one
+    attribute check, guarded <3% by the tier-1 overhead test.  The worker
+    starts lazily on first submit and ``stop()`` joins it (idempotent;
+    submits after stop are dropped, so a closed reader can never respawn
+    the thread).
+    """
+
+    def __init__(self, stats: DeviceStats, tracer=None,
+                 enabled: "bool | None" = None):
+        self.stats = stats
+        self.tracer = tracer
+        self.enabled = (_device_timing_enabled() if enabled is None
+                        else bool(enabled))
+        self._lock = threading.Lock()
+        self._q = None
+        self._thread = None
+        self._closed = False
+
+    def submit(self, kind: str, route: str, family: str, arrays, t0: float,
+               bytes_in: int = 0, bytes_staged: int = 0) -> None:
+        if not self.enabled:
+            return
+        q = self._q
+        if q is None:
+            q = self._start()
+            if q is None:
+                return  # closed
+        q.put((kind, route, family, arrays, t0, bytes_in, bytes_staged))
+
+    def _start(self):
+        import queue
+        import weakref
+
+        with self._lock:
+            if self._closed:
+                return None
+            if self._q is None:
+                self._q = queue.Queue()
+                # the worker references only (queue, stats, tracer) — never
+                # this timer — so an abandoned reader (no close()) lets the
+                # timer become unreachable and the finalizer below delivers
+                # the shutdown sentinel: no thread outlives its reader's
+                # collection, even without the explicit stop()
+                self._thread = threading.Thread(
+                    target=_devtimer_worker,
+                    args=(self._q, self.stats, self.tracer),
+                    name="tpq-devtimer", daemon=True)
+                self._thread.start()
+                weakref.finalize(self, self._q.put, None)
+            return self._q
+
+    def drain(self, timeout: float = 2.0) -> None:
+        """Wait (bounded) until every submitted dispatch has been timed —
+        a mid-session stats read must not observe 1 of a group's 3
+        dispatches just because the worker is still blocking on the other
+        two.  Bounded: a wedged device must not also wedge a flight dump
+        whose registry provider calls this."""
+        import time as _time
+
+        q = self._q
+        if q is None or not self.enabled:
+            return
+        deadline = _time.monotonic() + timeout
+        while q.unfinished_tasks and _time.monotonic() < deadline:
+            _time.sleep(0.002)
+
+    def stop(self) -> None:
+        """Drain and join the worker (idempotent, thread-leak-safe: every
+        already-submitted dispatch is still timed before the join)."""
+        with self._lock:
+            self._closed = True
+            q, t = self._q, self._thread
+            self._q = self._thread = None
+        if t is None:
+            return
+        q.put(None)
+        t.join(timeout=10.0)
+
+
+def _devtimer_worker(q, stats: DeviceStats, tracer) -> None:
+    """The completion worker's loop (module-level on purpose: it must not
+    reference the :class:`_DeviceTimer`, or the timer could never be
+    collected and its shutdown finalizer could never fire).
+
+    Intervals are SERIALIZED against the previous completion: dispatches
+    ride one async device queue, so an interval anchored at its own
+    dispatch time would also contain every earlier dispatch's device time
+    and the per-route sums would overcount the device wall several-fold
+    (K columns back-to-back → ~K/2x).  Anchoring each entry at
+    ``max(own dispatch, previous completion)`` partitions the busy lane:
+    the sums are route shares of one serialized device timeline, directly
+    comparable to the wall-clock host lanes the doctor weighs them
+    against."""
+    import time as _time
+
+    prev_done = 0.0
+    while True:
+        item = q.get()
+        if item is None:
+            return
+        try:
+            kind, route, family, arrays, t0, b_in, b_staged = item
+            try:
+                jax.block_until_ready(arrays)
+            except Exception:  # noqa: BLE001 — a failed dispatch
+                continue       # reports through the consumer
+            t1 = _time.perf_counter()
+            start = max(t0, prev_done)
+            prev_done = t1
+            dt = max(t1 - start, 0.0)
+            if kind == "h2d":
+                stats.note_h2d(dt, b_staged)
+                name = "device.h2d"
+            else:
+                stats.note_dispatch(route, family, dt, b_in, b_staged)
+                name = f"device.{route}"
+            if tracer is not None and tracer.active:
+                tracer.complete(name, start, t1, kernel=family,
+                                bytes=int(b_staged or b_in))
+        finally:
+            q.task_done()
+
+
+# ---------------------------------------------------------------------------
+# aligned device profiles (TPQ_XPROF): one bounded-window jax.profiler
+# capture per process whose TraceAnnotations carry the SAME names as the
+# span tracer's stages, so the host Perfetto artifact and the XLA device
+# timeline line up one-to-one
+# ---------------------------------------------------------------------------
+
+_XPROF_LOCK = threading.Lock()
+_XPROF_DONE = False      # one capture per process: xprof dirs are heavy
+_XPROF_ACTIVE = False    # cheap hot-path gate for TraceAnnotations
+
+
+def _xprof_active() -> bool:
+    return _XPROF_ACTIVE
+
+
+def _xprof_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` matching a span-tracer stage name
+    while an xprof window is capturing; a no-op context otherwise (the
+    annotation objects are only built inside a live capture)."""
+    if not _XPROF_ACTIVE:
+        return _noop_ctx()
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 — profiling never takes the run down
+        return _noop_ctx()
+
+
+class _XprofWindow:
+    """Bounded-window device profile capture (``TPQ_XPROF=<dir>``).
+
+    Starts a ``jax.profiler`` trace at scan start and stops it after
+    ``TPQ_XPROF_S`` seconds (default 10; checked at row-group granularity)
+    or at scan end, whichever comes first — an unbounded xprof over a 1B-row
+    scan is gigabytes, a window is what the alignment needs.  One capture
+    per process; every later scan is a no-op.  All profiler calls are
+    guarded: a backend without profiler support degrades silently.
+    """
+
+    def __init__(self):
+        from .obs import env_float
+
+        self.dir = os.environ.get("TPQ_XPROF", "")
+        self.window_s = env_float("TPQ_XPROF_S", 10.0, lo=0.1)
+        self._t0 = None
+        self._started = False
+
+    def start(self) -> None:
+        global _XPROF_DONE, _XPROF_ACTIVE
+        if not self.dir:
+            return
+        with _XPROF_LOCK:
+            if _XPROF_DONE:
+                return
+            _XPROF_DONE = True
+            try:
+                import time as _time
+
+                jax.profiler.start_trace(self.dir)
+                self._t0 = _time.perf_counter()
+                self._started = True
+                _XPROF_ACTIVE = True
+            except Exception:  # noqa: BLE001
+                self._started = False
+
+    def tick(self) -> None:
+        """Row-group boundary check: close the window once it has run
+        ``window_s`` (the profiler flushes its own buffers on stop)."""
+        import time as _time
+
+        if self._started and _time.perf_counter() - self._t0 >= self.window_s:
+            self.stop()
+
+    def stop(self) -> None:
+        global _XPROF_ACTIVE
+        if not self._started:
+            return
+        self._started = False
+        with _XPROF_LOCK:
+            _XPROF_ACTIVE = False
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
 
 
 class DeviceFileReader:
@@ -3078,6 +3510,21 @@ class DeviceFileReader:
         self._stats = ReaderStats()
         self._stats_lock = __import__("threading").Lock()
         self._t0: float | None = None
+        # per-route device completion timing (TPQ_DEVICE_TIMING, default
+        # on): one lazy daemon worker times each staged dispatch to
+        # block_until_ready, keyed by ship route and kernel family
+        self._device_stats = DeviceStats()
+        self._device_timer = _DeviceTimer(self._device_stats, self._tracer)
+        # HBM residency ledger: staged buffers register at staging
+        # (`_device_staged_pending`), move to `_device_outstanding` at
+        # dispatch, and release at finalize — the one point that proves
+        # every kernel reading the DISPATCHED buffers has completed (the
+        # pipelined path stages group N before group N-1 finalizes, so a
+        # single counter would release N's live buffer early)
+        self._device_staged_pending = 0
+        self._device_outstanding = 0
+        # bounded-window aligned device profile (TPQ_XPROF)
+        self._xprof = _XprofWindow()
         # link-byte ship planner (ship.py): per-reader so env overrides
         # (TPQ_FORCE_ROUTE, TPQ_LINK_MBPS) bind at open time
         self._ship_planner = ShipPlanner()
@@ -3110,6 +3557,12 @@ class DeviceFileReader:
             # quarantined-unit accounting as a live curve: a corruption
             # burst is visible next to the lane it degraded
             self._sampler.add_source("data_errors", self.quarantine.progress)
+            if self._device_timer.enabled:
+                # the device lane as a curve (slope = live device
+                # throughput); on hosts where the timing lane dropped
+                # (no backend) the track simply never registers
+                self._sampler.add_source("device",
+                                         self._device_stats.progress)
             self._sampler.start()
         # hang watchdog (obs.Watchdog, TPQ_HANG_S / hang_s=): fires a
         # flight dump (and, policy "raise", aborts the chunk feed's budget
@@ -3149,7 +3602,9 @@ class DeviceFileReader:
 
     def _sample_alloc(self) -> dict:
         in_use, peak = self.alloc.snapshot()
-        return {"in_use": in_use, "peak": peak}
+        dev_in_use, dev_peak = self.alloc.device_snapshot()
+        return {"in_use": in_use, "peak": peak,
+                "device_in_use": dev_in_use, "device_peak": dev_peak}
 
     def _sample_budget(self) -> dict:
         b = self._live_budget
@@ -3157,6 +3612,12 @@ class DeviceFileReader:
 
     def close(self):
         self._watchdog.stop()  # before the sampler: no dump mid-teardown
+        # before the sampler's final tick and the trace write: every
+        # in-flight dispatch must land in the device section first
+        self._device_timer.stop()
+        self._xprof.stop()
+        # deferred-finalize scans (scan_files) release residency here
+        self._release_device_outstanding(all_bytes=True)
         self._sampler.stop()  # before the write: the final tick must land
         self._host.close()
         if self._owns_tracer:
@@ -3173,6 +3634,14 @@ class DeviceFileReader:
         reg.add_reader(self._stats)
         reg.add_pipeline(self._pipe_stats)
         reg.note_alloc_peak(self.alloc)
+        if self._device_timer.enabled:
+            # the versioned `device` section (golden-keyed like io/
+            # data_errors); absent entirely when the timing lane dropped,
+            # so consumers see "n/a", never zeros masquerading as
+            # measures.  Drain first: a mid-session read must not miss
+            # dispatches still queued behind the completion worker.
+            self._device_timer.drain()
+            reg.add_device(self._device_stats)
         if self._store.stats is not None:
             reg.add_io(self._store.stats)
         if len(self.quarantine.log) or self.quarantine.units_skipped:
@@ -3454,17 +3923,32 @@ class DeviceFileReader:
                     num_leaf_slots=0,
                 )
                 continue
-            plans.append((name, asm.finish(stager)))
+            plan = asm.finish(stager)
+            plans.append((name, plan))
             self._stats.pages_device_expanded += asm.pages_kept_compressed
             tr = self._pipe_stats.tracer
-            for route, logical, shipped, predicted in asm.ship_records:
-                self._stats.count_route(route, logical, shipped, predicted)
+            logical_sum = shipped_sum = 0
+            best_route, best_bytes = None, -1
+            for (route, logical, shipped, predicted,
+                 predicted_dev) in asm.ship_records:
+                self._stats.count_route(route, logical, shipped, predicted,
+                                        predicted_dev)
+                logical_sum += logical
+                shipped_sum += shipped
+                if shipped > best_bytes:
+                    best_route, best_bytes = route, shipped
                 if tr is not None and tr.active:
                     # one instant per shipped stream: pq_tool trace folds
                     # these into the per-route predicted-vs-measured table
                     tr.instant("ship", route=route, column=name,
                                logical=logical, shipped=shipped,
-                               predicted_s=round(predicted, 9))
+                               predicted_s=round(predicted, 9),
+                               predicted_device_s=round(predicted_dev, 9))
+            # device-timing attribution: the column's dispatch is timed
+            # under its dominant (most-shipped-bytes) ship route
+            plan.route = best_route or ROUTE_PLAIN
+            plan.bytes_in = logical_sum
+            plan.bytes_staged = shipped_sum
         # every selected leaf must have a chunk in the row group (host
         # FileReader parity — reader.py read_row_group's missing check)
         seen = set(out) | {name for name, _ in plans}
@@ -3484,21 +3968,69 @@ class DeviceFileReader:
             tr.complete("prepare", t0, now, rg=index, bytes=stager.total)
         return out, plans, stager
 
+    def _note_staged(self, stager, buf_dev, t0: float) -> None:
+        """One staged row-group buffer just shipped: account its HBM
+        residency and hand it to the completion timer as an ``h2d``
+        transfer.  ``t0`` must be the POST-stage timestamp — ``stage()``
+        is host-blocking, so an interval anchored before it would contain
+        the whole host staging wall and the ``h2d`` lane would
+        structurally dominate the link lane it is meant to sit next to.
+        The bytes land in ``_device_staged_pending`` (not yet dispatched)
+        and move to ``_device_outstanding`` at dispatch — finalize proves
+        completion only for DISPATCHED groups, and the pipelined path
+        stages group N before group N-1 finalizes."""
+        n = int(stager.total)
+        if n:
+            self.alloc.register_device(n)
+            with self._stats_lock:
+                self._device_staged_pending += n
+        self._device_timer.submit("h2d", "h2d", "h2d", buf_dev, t0,
+                                  bytes_staged=n)
+
+    def _note_dispatched(self, stager) -> None:
+        """The group's staged bytes are now consumed by in-flight kernels:
+        eligible for release at the next finalize."""
+        n = int(stager.total)
+        if n:
+            with self._stats_lock:
+                self._device_staged_pending -= n
+                self._device_outstanding += n
+
+    def _release_device_outstanding(self, all_bytes: bool = False) -> None:
+        """Release the HBM ledger for groups whose kernels finalize just
+        proved complete; ``all_bytes`` (close) also drops still-pending
+        staged buffers — the scan is over either way."""
+        with self._stats_lock:
+            n, self._device_outstanding = self._device_outstanding, 0
+            if all_bytes:
+                n += self._device_staged_pending
+                self._device_staged_pending = 0
+        if n:
+            self.alloc.release_device(n)
+
     @scoped_x64
     def _dispatch_row_group(self, prepared, buf_dev=None):
         import time as _time
 
-        t0 = _time.perf_counter()
         out, plans, stager = prepared
         if plans:
             if buf_dev is None:
-                with self._pipe_stats.timed("stage", bytes=stager.total):
+                t0 = _time.perf_counter()
+                with self._pipe_stats.timed("stage", bytes=stager.total), \
+                        _xprof_annotation("stage"):
                     buf_dev = stager.stage()
-            with self._pipe_stats.timed("dispatch"):
-                out.update(_run_plans(plans, buf_dev))
+                t_staged = _time.perf_counter()
+                with self._stats_lock:
+                    self._stats.stage_seconds += t_staged - t0
+                self._note_staged(stager, buf_dev, t_staged)
+            t1 = _time.perf_counter()
+            with self._pipe_stats.timed("dispatch"), \
+                    _xprof_annotation("dispatch"):
+                out.update(_run_plans(plans, buf_dev, self._device_timer))
+            with self._stats_lock:
+                self._stats.dispatch_seconds += _time.perf_counter() - t1
+            self._note_dispatched(stager)
         now = _time.perf_counter()
-        with self._stats_lock:
-            self._stats.device_seconds += now - t0
         if self._t0 is not None:
             self._stats.wall_seconds = now - self._t0
         self._pipe_stats.count_row_group()
@@ -3535,9 +4067,13 @@ class DeviceFileReader:
 
     @scoped_x64
     def finalize(self) -> None:
-        """Run deferred validity checks (one device sync for all chunks)."""
-        with self._pipe_stats.timed("finalize"):
+        """Run deferred validity checks (one device sync for all chunks).
+        The sync also proves every kernel reading the staged buffers has
+        completed, so the HBM residency ledger releases them here."""
+        with self._pipe_stats.timed("finalize"), \
+                _xprof_annotation("finalize"):
             _finalize_many([self])
+        self._release_device_outstanding()
         self._pipe_stats.touch_wall()
 
     def iter_batches(self, batch_size: int, columns=None):
@@ -3684,16 +4220,29 @@ class DeviceFileReader:
             return
         trace = (jax.profiler.trace(self.profile_dir) if self.profile_dir
                  else contextlib.nullcontext())
-        with trace, ThreadPoolExecutor(1) as ex:
-            for _, out in _scan_pipeline(
-                ((self, None, i) for i in indices), ex,
-                finalize_each=finalize_each,
-                prefetch=self._prefetch,
-                budget_bytes=self.alloc.max_size,
-                watchdog=self._watchdog,
-                quarantine=self.quarantine,
-            ):
-                yield out
+        # aligned device profile (TPQ_XPROF): a bounded window of the XLA
+        # timeline whose TraceAnnotations match the span tracer's stage
+        # names; profile_dir (the explicit kwarg) takes precedence — the
+        # two capture APIs must not nest
+        xprof = None if self.profile_dir else self._xprof
+        if xprof is not None:
+            xprof.start()
+        try:
+            with trace, ThreadPoolExecutor(1) as ex:
+                for _, out in _scan_pipeline(
+                    ((self, None, i) for i in indices), ex,
+                    finalize_each=finalize_each,
+                    prefetch=self._prefetch,
+                    budget_bytes=self.alloc.max_size,
+                    watchdog=self._watchdog,
+                    quarantine=self.quarantine,
+                ):
+                    yield out
+                    if xprof is not None:
+                        xprof.tick()
+        finally:
+            if xprof is not None:
+                xprof.stop()
 
 
 def _finalize_many(readers) -> None:
@@ -3720,16 +4269,22 @@ def _finalize_many(readers) -> None:
 
 def _timed_stage(reader: DeviceFileReader, stager: _RowGroupStager):
     """Stage on the worker, attributing wall time to the owning reader's
-    stats (the worker and dispatching threads both touch device_seconds;
-    += is not atomic across bytecodes, hence the lock)."""
+    ``stage_seconds`` lane (the worker and dispatching threads write
+    concurrently; += is not atomic across bytecodes, hence the lock.
+    Distinct lanes — not the old shared ``device_seconds`` scalar — so the
+    two threads' concurrent intervals can never double-count wall time)."""
     import time as _time
 
     t0 = _time.perf_counter()
-    with reader._pipe_stats.timed("stage", bytes=stager.total):
+    with reader._pipe_stats.timed("stage", bytes=stager.total), \
+            _xprof_annotation("stage"):
         buf_dev = stager.stage()
-    dt = _time.perf_counter() - t0
+    t_staged = _time.perf_counter()
     with reader._stats_lock:
-        reader._stats.device_seconds += dt
+        reader._stats.stage_seconds += t_staged - t0
+    # post-stage timestamp: the h2d lane times the ASYNC transfer tail,
+    # never the host staging wall the `stage` lane already measured
+    reader._note_staged(stager, buf_dev, t_staged)
     return buf_dev
 
 
@@ -4183,6 +4738,12 @@ def scan_files(paths, columns=None, validate_crc=None,
                 if r._host.row_group_selected(i):
                     yield r, path, i
 
+    # aligned device profile (TPQ_XPROF): the multi-file scan owns ONE
+    # bounded window spanning file boundaries — per-reader windows would
+    # never start (scan_files drives _scan_pipeline directly, not
+    # iter_row_groups)
+    xprof = _XprofWindow()
+    xprof.start()
     try:
         with ThreadPoolExecutor(1) as ex:
             for pp, out in _scan_pipeline(work(), ex, close_finished=True,
@@ -4191,8 +4752,10 @@ def scan_files(paths, columns=None, validate_crc=None,
                                           budget_bytes=int(max_memory),
                                           watchdog=watchdog, quarantine=q):
                 yield (pp, out) if with_path else out
+                xprof.tick()
         _finalize_many(readers)
     finally:
+        xprof.stop()
         watchdog.stop()
         try:
             # idempotent re-check: covers consumers that abandon the scan

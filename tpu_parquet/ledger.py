@@ -55,7 +55,8 @@ LEDGER_VERSION = 1
 _ENV_KEYS = (
     "TPQ_LINK_MBPS", "TPQ_FORCE_ROUTE", "TPQ_TRACE", "TPQ_SAMPLE_MS",
     "TPQ_DEVICE_SNAPPY", "TPQ_COMPILE_CACHE", "TPQ_FUSE_RG", "TPQ_PALLAS",
-    "TPQ_DEFER_DICT_CHECK", "BENCH_SCALE", "BENCH_DEVICE_REPS",
+    "TPQ_DEFER_DICT_CHECK", "TPQ_DEVICE_MBPS", "TPQ_DEVICE_TIMING",
+    "TPQ_XPROF", "BENCH_SCALE", "BENCH_DEVICE_REPS",
     "BENCH_BASELINE_REPS", "BENCH_RESAMPLE", "BENCH_CONFIGS",
     "JAX_PLATFORMS",
 )
@@ -290,20 +291,34 @@ def rel_noise(reps: "list[float]") -> float:
 def attribute_stages(cfg_a: dict, cfg_b: dict) -> "dict | None":
     """Name the registry stage whose seconds grew the most from a to b.
 
-    Reads each config's embedded registry tree (``obs.pipeline``); the
-    stage with the largest absolute second growth is the attribution a
-    flagged regression carries.  None when neither side embedded one, or
-    when no stage grew at all (a shrinking stage can't explain a
-    regression — attributing the least-shrinking one would mislead).
+    Reads each config's embedded registry tree (``obs.pipeline`` plus the
+    per-route device completion seconds of the ``obs.device`` section, as
+    ``device:<route>`` pseudo-stages — so a regression can be pinned to a
+    SPECIFIC device route, not just "dispatch grew"); the stage with the
+    largest absolute second growth is the attribution a flagged regression
+    carries.  Records predating the device section simply contribute no
+    device pseudo-stages (graceful n/a, never a KeyError).  None when
+    neither side embedded a registry, or when no stage grew at all (a
+    shrinking stage can't explain a regression — attributing the
+    least-shrinking one would mislead).
     """
-    pa = ((cfg_a.get("obs") or {}).get("pipeline")) or {}
-    pb = ((cfg_b.get("obs") or {}).get("pipeline")) or {}
+    oa = cfg_a.get("obs") or {}
+    ob = cfg_b.get("obs") or {}
+    pa = oa.get("pipeline") or {}
+    pb = ob.get("pipeline") or {}
     moves = {}
     for k in _STAGE_KEYS:
         sa = float(pa.get(k) or 0.0)
         sb = float(pb.get(k) or 0.0)
         if sa or sb:
             moves[k] = (sa, sb)
+    da = (oa.get("device") or {}).get("routes") or {}
+    db = (ob.get("device") or {}).get("routes") or {}
+    for r in set(da) | set(db):
+        sa = float((da.get(r) or {}).get("device_seconds") or 0.0)
+        sb = float((db.get(r) or {}).get("device_seconds") or 0.0)
+        if sa or sb:
+            moves[f"device:{r}_seconds"] = (sa, sb)
     if not moves:
         return None
     stage = max(moves, key=lambda k: moves[k][1] - moves[k][0])

@@ -1208,7 +1208,9 @@ class StatsRegistry:
         self._loader: "dict | None" = None
         self._io: "dict | None" = None
         self._data_errors: "dict | None" = None
+        self._device: "dict | None" = None
         self._alloc_peak = 0
+        self._alloc_device_peak = 0
         self._hists: dict[str, LatencyHistogram] = {}
 
     # -- composition ----------------------------------------------------------
@@ -1273,12 +1275,27 @@ class StatsRegistry:
                 self._data_errors = {}
             _merge_num_tree(self._data_errors, d)
 
+    def add_device(self, devstats) -> None:
+        """Fold a :class:`~tpu_parquet.device_reader.DeviceStats` in (the
+        ``device`` section: per-route and per-kernel-family completion-side
+        dispatch timing plus the h2d transfer lane — all flows, so
+        multi-file scans compose by addition).  Raw dicts accepted for
+        tests and cross-process merges."""
+        d = devstats if isinstance(devstats, dict) else devstats.as_dict()
+        with self._lock:
+            if self._device is None:
+                self._device = {}
+            _merge_num_tree(self._device, d)
+
     def note_alloc_peak(self, tracker) -> None:
         """Record an :class:`~tpu_parquet.alloc.AllocTracker`'s high-water
-        mark (its ``peak`` attribute; raw ints accepted for tests)."""
+        marks (host ``peak`` + device-bytes ``device_peak``; raw ints
+        accepted for tests as the host peak alone)."""
         peak = int(getattr(tracker, "peak", tracker or 0))
+        dev_peak = int(getattr(tracker, "device_peak", 0) or 0)
         with self._lock:
             self._alloc_peak = max(self._alloc_peak, peak)
+            self._alloc_device_peak = max(self._alloc_device_peak, dev_peak)
 
     def merge_from(self, other: "StatsRegistry") -> None:
         with other._lock:
@@ -1288,12 +1305,15 @@ class StatsRegistry:
             io = dict(other._io) if other._io else None
             data_errors = (dict(other._data_errors)
                            if other._data_errors else None)
+            device = dict(other._device) if other._device else None
             peak = other._alloc_peak
+            dev_peak = other._alloc_device_peak
             hists = dict(other._hists)
         with self._lock:
             for name, src in (("_pipeline", pipeline), ("_reader", reader),
                               ("_loader", loader), ("_io", io),
-                              ("_data_errors", data_errors)):
+                              ("_data_errors", data_errors),
+                              ("_device", device)):
                 if src is None:
                     continue
                 dst = getattr(self, name)
@@ -1301,6 +1321,7 @@ class StatsRegistry:
                     setattr(self, name, dst := {})
                 _merge_num_tree(dst, src)
             self._alloc_peak = max(self._alloc_peak, peak)
+            self._alloc_device_peak = max(self._alloc_device_peak, dev_peak)
         for name, h in hists.items():
             self.histogram(name).merge_from(h)
 
@@ -1311,7 +1332,8 @@ class StatsRegistry:
                 f"obs_version {tree.get('obs_version')!r} != {OBS_VERSION}")
         for key, attr in (("pipeline", "_pipeline"), ("reader", "_reader"),
                           ("loader", "_loader"), ("io", "_io"),
-                          ("data_errors", "_data_errors")):
+                          ("data_errors", "_data_errors"),
+                          ("device", "_device")):
             src = tree.get(key)
             if src is None:
                 continue
@@ -1323,9 +1345,12 @@ class StatsRegistry:
                     setattr(self, attr, dst := {})
                 _merge_num_tree(dst, src)
         with self._lock:
+            alloc = tree.get("alloc", {})
             self._alloc_peak = max(self._alloc_peak,
-                                   int(tree.get("alloc", {})
-                                       .get("peak_bytes", 0)))
+                                   int(alloc.get("peak_bytes", 0)))
+            self._alloc_device_peak = max(
+                self._alloc_device_peak,
+                int(alloc.get("device_peak_bytes", 0) or 0))
         for name, hd in tree.get("histograms", {}).items():
             self.histogram(name).merge_dict(hd)
 
@@ -1348,14 +1373,23 @@ class StatsRegistry:
         tracing off, a run whose staging span recorded no seconds) reports
         ``null`` — explicitly unmeasured, never a divide-by-zero or a bogus
         0.0 ratio a diff would read as "infinitely fast".
+
+        The DEVICE lane rides each route the same way: predicted device
+        seconds from the planner's device cost term
+        (``predicted_device_s`` on ReaderStats), measured from the
+        completion-side device timing (the ``device`` section's per-route
+        ``device_seconds``, ``TPQ_DEVICE_TIMING``) — null when the timing
+        lane never ran, same contract as the link lane.
         """
         with self._lock:
             reader = dict(self._reader or {})
             pipeline = dict(self._pipeline or {})
+            device = dict(self._device or {})
         routes = reader.get("ship_routes") or {}
         staged = reader.get("staged_bytes") or 0
         stage_s = pipeline.get("stage_seconds") or 0.0
         link_bps = staged / stage_s if staged and stage_s else 0.0
+        dev_routes = device.get("routes") or {}
         out = {}
         for route, c in sorted(routes.items()):
             # null-check and ratio on the RAW values, display rounding last:
@@ -1364,6 +1398,10 @@ class StatsRegistry:
             # exists to rule out
             pred = float(c.get("predicted_s", 0.0))
             meas = c.get("shipped", 0) / link_bps if link_bps else None
+            dev_pred = float(c.get("predicted_device_s", 0.0) or 0.0)
+            dr = dev_routes.get(route) or {}
+            dev_meas = (float(dr["device_seconds"])
+                        if dr.get("dispatches") else None)
             out[route] = {
                 "streams": c.get("streams", 0),
                 "shipped_bytes": c.get("shipped", 0),
@@ -1372,6 +1410,13 @@ class StatsRegistry:
                                      else None),
                 "error_ratio": (round(meas / pred, 3)
                                 if meas is not None and pred else None),
+                "device_predicted_seconds": round(dev_pred, 9),
+                "device_measured_seconds": (round(dev_meas, 9)
+                                            if dev_meas is not None
+                                            else None),
+                "device_error_ratio": (round(dev_meas / dev_pred, 3)
+                                       if dev_meas is not None and dev_pred
+                                       else None),
             }
         return {"link_bytes_per_sec": round(link_bps, 1), "routes": out}
 
@@ -1385,7 +1430,9 @@ class StatsRegistry:
                 "io": dict(self._io) if self._io else None,
                 "data_errors": (dict(self._data_errors)
                                 if self._data_errors else None),
-                "alloc": {"peak_bytes": self._alloc_peak},
+                "device": dict(self._device) if self._device else None,
+                "alloc": {"peak_bytes": self._alloc_peak,
+                          "device_peak_bytes": self._alloc_device_peak},
                 "histograms": {n: h.as_dict()
                                for n, h in sorted(self._hists.items())},
             }
@@ -1489,13 +1536,15 @@ def trace_summary(doc) -> dict:
     for s in ships:
         r = routes.setdefault(str(s.get("route", "?")), {
             "streams": 0, "logical_bytes": 0, "shipped_bytes": 0,
-            "predicted_seconds": 0.0,
+            "predicted_seconds": 0.0, "device_predicted_seconds": 0.0,
         })
         r["streams"] += 1
         r["logical_bytes"] += int(s.get("logical", 0))
         r["shipped_bytes"] += int(s.get("shipped", 0))
         r["predicted_seconds"] += float(s.get("predicted_s", 0.0))
-    for r in routes.values():
+        r["device_predicted_seconds"] += float(
+            s.get("predicted_device_s", 0.0) or 0.0)
+    for name, r in routes.items():
         # keys always present; null = unmeasured (same contract as
         # StatsRegistry.ship_feedback — never a fake 0.0 ratio, so the
         # ratio and the null check use the RAW values, rounding last)
@@ -1505,6 +1554,19 @@ def trace_summary(doc) -> dict:
         r["measured_seconds"] = round(meas, 9) if meas is not None else None
         r["error_ratio"] = (round(meas / pred, 3)
                             if meas is not None and pred else None)
+        # the device lane: completion-side `device.<route>` spans (the
+        # TPQ_DEVICE_TIMING worker emits one per dispatch).  Same null
+        # contract — a run with the timing lane off reports null, and an
+        # artifact predating it can never KeyError.
+        dev_pred = r["device_predicted_seconds"]
+        dev = spans.get(f"device.{name}")
+        dev_meas = sum(dev) if dev else None
+        r["device_predicted_seconds"] = round(dev_pred, 9)
+        r["device_measured_seconds"] = (round(dev_meas, 9)
+                                        if dev_meas is not None else None)
+        r["device_error_ratio"] = (round(dev_meas / dev_pred, 3)
+                                   if dev_meas is not None and dev_pred
+                                   else None)
     return {
         "obs_version": other.get("obs_version"),
         "events": len(events),
@@ -1525,12 +1587,13 @@ def trace_summary(doc) -> dict:
 # doctor: rule-based bottleneck attribution (the pq_tool doctor backend)
 # ---------------------------------------------------------------------------
 
-# the four verdicts `pq_tool doctor` can return, keyed by lane
+# the verdicts `pq_tool doctor` can return, keyed by lane
 DOCTOR_VERDICTS = {
     "link": "link-bound",
     "host_decompress": "host-decompress-bound",
     "stall": "stall-bound",
     "device_resolve": "device-resolve-bound",
+    "h2d": "h2d-bound",
 }
 # routes whose overall error_ratio leaves this band disagree with the cost
 # model enough that re-running with the recalibrated TPQ_LINK_MBPS is the
@@ -1550,8 +1613,13 @@ def doctor_registry(tree: dict) -> "dict | None":
     - ``host_decompress``  ``io + decompress + recompress`` seconds (the
       host's half of the work; falls back to the reader's ``host_seconds``
       for prefetch=0 runs that never routed through the chunk pool)
-    - ``device_resolve``  ``dispatch + finalize`` seconds (op-table
-      resolves and deferred validity syncs)
+    - ``device_resolve``  the measured per-route device completion seconds
+      (the ``device`` registry section, ``TPQ_DEVICE_TIMING``); falls back
+      to ``dispatch + finalize`` host-side seconds for artifacts predating
+      the device section (never a KeyError — old records stay readable)
+    - ``h2d``             measured h2d transfer completion seconds (the
+      ``device`` section's ``h2d`` lane; 0 for old artifacts, so the new
+      verdict can never fire on a record that carries no evidence for it)
     - ``stall``           budget backpressure (the submitter blocked on
       ``max_memory`` — more memory or less lookahead, not more bandwidth)
 
@@ -1559,7 +1627,11 @@ def doctor_registry(tree: dict) -> "dict | None":
     seconds disagree with the planner's predictions beyond
     ``DOCTOR_ERROR_BAND``, the report carries ``recalibrate_link_mbps`` —
     the measured staging rate as the ``TPQ_LINK_MBPS`` value to re-run
-    with (exactly the 1B re-measure procedure in ROADMAP item 1).
+    with (exactly the 1B re-measure procedure in ROADMAP item 1).  With a
+    ``device`` section, the report additionally carries a ``device`` block
+    naming the dominant device route and kernel family with its
+    predicted-vs-measured error ratio, and ``recalibrate_device_mbps``
+    when that ratio leaves the band — the device twin of the link loop.
 
     Returns ``None`` when the tree has no lane seconds to attribute.
     """
@@ -1569,6 +1641,8 @@ def doctor_registry(tree: dict) -> "dict | None":
     reader = tree.get("reader") or {}
     if not isinstance(pipe, dict) or not isinstance(reader, dict):
         return None
+    dev = tree.get("device")
+    dev = dev if isinstance(dev, dict) else {}
 
     def g(d, k):
         v = d.get(k)
@@ -1578,11 +1652,18 @@ def doctor_registry(tree: dict) -> "dict | None":
             + g(pipe, "recompress_seconds"))
     if host == 0.0:
         host = g(reader, "host_seconds")
+    dev_routes = {r: c for r, c in (dev.get("routes") or {}).items()
+                  if isinstance(c, dict)}
+    dev_resolve = sum(g(c, "device_seconds") for c in dev_routes.values())
     lanes = {
         "link": g(pipe, "stage_seconds"),
         "host_decompress": host,
-        "device_resolve": (g(pipe, "dispatch_seconds")
-                           + g(pipe, "finalize_seconds")),
+        # measured completion seconds when the timing lane ran; the
+        # host-side dispatch+finalize wall otherwise (old artifacts,
+        # TPQ_DEVICE_TIMING=0 runs)
+        "device_resolve": dev_resolve or (g(pipe, "dispatch_seconds")
+                                          + g(pipe, "finalize_seconds")),
+        "h2d": g(dev.get("h2d") or {}, "device_seconds"),
         "stall": g(pipe, "stall_seconds"),
     }
     total = sum(lanes.values())
@@ -1595,6 +1676,42 @@ def doctor_registry(tree: dict) -> "dict | None":
         "verdict": DOCTOR_VERDICTS[dominant],
         "dominant_share": round(lanes[dominant] / total, 4),
     }
+    if dev_routes:
+        # name the dominant device route (and kernel family) with its
+        # predicted-vs-measured error — the fused-kernel work (ROADMAP
+        # direction 2) starts from exactly this attribution
+        routes_pred = reader.get("ship_routes") or {}
+        dom_route = max(dev_routes,
+                        key=lambda r: (g(dev_routes[r], "device_seconds"), r))
+        dm = g(dev_routes[dom_route], "device_seconds")
+        dp = float((routes_pred.get(dom_route) or {})
+                   .get("predicted_device_s") or 0.0)
+        kernels = {k: c for k, c in (dev.get("kernels") or {}).items()
+                   if isinstance(c, dict)}
+        dom_kernel = (max(kernels,
+                          key=lambda k: (g(kernels[k], "device_seconds"), k))
+                      if kernels else None)
+        # the recalibration rate comes from the DOMINANT route alone — a
+        # blend across routes (plain's near-zero-compute bytes included)
+        # would hand back a TPQ_DEVICE_MBPS far off the resolve
+        # throughput whose error ratio tripped the band in the first place
+        dom_bytes = g(dev_routes[dom_route], "bytes_in")
+        dev_bps = dom_bytes / dm if dom_bytes and dm else 0.0
+        dev_err = round(dm / dp, 3) if dm and dp else None
+        out["device"] = {
+            "dominant_route": dom_route,
+            "dominant_kernel": dom_kernel,
+            "measured_seconds": round(dm, 9),
+            "predicted_seconds": round(dp, 9),
+            "error_ratio": dev_err,
+            "measured_device_mbps": (round(dev_bps / 1e6, 1)
+                                     if dev_bps else None),
+        }
+        lo, hi = DOCTOR_ERROR_BAND
+        if dev_err is not None and dev_bps and not (lo <= dev_err <= hi):
+            from .ship import recalibrate_device_mbps
+
+            out["recalibrate_device_mbps"] = recalibrate_device_mbps(dev_bps)
     fb = reader.get("ship_feedback")
     routes = (fb or {}).get("routes") or {}
     if routes:

@@ -163,7 +163,9 @@ def unpack_bp_groups(buf_dev, bp_base: int, width: int, groups_pad: int,
         bp_base = np.int32(bp_base)  # traced callers pass their own i32
     from .jax_kernels import enable_x64
 
-    with enable_x64(False):
+    # tpq.unpack name scope: the TPQ_XPROF device timeline attributes the
+    # Pallas unpack to the same kernel family as the XLA fallback path
+    with enable_x64(False), jax.named_scope("tpq.unpack"):
         return _bp_groups_jit(buf_dev, bp_base, width=width,
                               groups_pad=groups_pad,
                               interpret=bool(interpret))
@@ -184,5 +186,6 @@ def unpack_bits_pallas(buf, width: int, count: int, interpret: bool | None = Non
     if interpret is None:
         interpret = not pallas_available()
     planes = build_planes(buf, width, count)
-    return _unpack_pallas_jit(planes, width=width, count=count,
-                              interpret=bool(interpret))
+    with jax.named_scope("tpq.unpack"):
+        return _unpack_pallas_jit(planes, width=width, count=count,
+                                  interpret=bool(interpret))

@@ -61,7 +61,10 @@ HOST_TRANSCODE_MBPS = 2500.0   # min/max + truncating copy (native)
 HOST_COMPRESS_MBPS = 1500.0    # native snappy_compress
 HOST_DECOMPRESS_MBPS = 1400.0  # native snappy_decompress (lazy pages only)
 # device-side op-table resolve (searchsorted + pointer-doubling gathers over
-# the output space); HBM-bandwidth bound, charged per OUTPUT byte
+# the output space); HBM-bandwidth bound, charged per OUTPUT byte.
+# TPQ_DEVICE_MBPS overrides it at planner construction — the device twin of
+# TPQ_LINK_MBPS, fed back by `pq_tool doctor` when the measured per-route
+# device lane (obs device timing) disagrees beyond DOCTOR_ERROR_BAND.
 DEVICE_RESOLVE_MBPS = 3000.0
 # a compressed route must beat plain shipping by at least this ratio or the
 # builder falls through (the op tables + resolve cost eat thin wins)
@@ -111,12 +114,16 @@ class ShipPlanner:
     """
 
     def __init__(self, link_mbps: "float | None" = None,
-                 force: "str | None" = None):
-        if link_mbps is None:
-            from .obs import env_float
+                 force: "str | None" = None,
+                 device_mbps: "float | None" = None):
+        from .obs import env_float
 
+        if link_mbps is None:
             link_mbps = env_float("TPQ_LINK_MBPS", DEFAULT_LINK_MBPS)
         self.link_mbps = max(float(link_mbps), 1.0)
+        if device_mbps is None:
+            device_mbps = env_float("TPQ_DEVICE_MBPS", DEVICE_RESOLVE_MBPS)
+        self.device_mbps = max(float(device_mbps), 1.0)
         if force is None:
             force = os.environ.get("TPQ_FORCE_ROUTE", "").strip() or None
         if force is not None and force not in ROUTES:
@@ -156,7 +163,7 @@ class ShipPlanner:
         # built-in win)
         mat = (self._t(L, HOST_DECOMPRESS_MBPS)
                if f.comp_bytes and not f.host_bytes_ready else 0.0)
-        resolve = self._t(L, DEVICE_RESOLVE_MBPS)
+        resolve = self._t(L, self.device_mbps)
         out = {ROUTE_PLAIN: max(mat, self._link(L))}
         if L <= 0:
             return out
@@ -165,16 +172,23 @@ class ShipPlanner:
             k = max(f.width // 2, 1)  # optimistic probe guess
         if k and f.width in (4, 8) and k < f.width:
             narrowed = L * k / f.width
+            # the device lane: the widen/re-bias pass writes L output
+            # bytes; narrow_snappy additionally resolves the compressed
+            # stream over its narrowed output space first — strictly MORE
+            # device work than bare narrow (device_costs mirrors these
+            # terms exactly, so the calibration predictions and the
+            # ranking model can never disagree about the same route)
             out[ROUTE_NARROW] = max(
                 mat + self._t(L, HOST_TRANSCODE_MBPS),
                 self._link(narrowed),
+                self._t(L, self.device_mbps),
             )
             if f.native and narrowed >= MIN_COMPRESS_BYTES:
                 out[ROUTE_NARROW_SNAPPY] = max(
                     mat + self._t(L, HOST_TRANSCODE_MBPS)
                     + self._t(narrowed, HOST_COMPRESS_MBPS),
                     self._link(narrowed * EST_NARROW_SNAPPY_RATIO),
-                    self._t(narrowed, DEVICE_RESOLVE_MBPS),
+                    self._t(L + narrowed, self.device_mbps),
                 )
         if f.comp_bytes and f.native:
             out[ROUTE_DEVICE_SNAPPY] = max(
@@ -185,6 +199,41 @@ class ShipPlanner:
                 self._link(L * EST_RECOMPRESS_RATIO),
                 resolve,
             )
+        return out
+
+    def device_costs(self, f: ChunkFacts, routes=None) -> dict:
+        """Modeled DEVICE-lane seconds per feasible route (keys match
+        :meth:`costs`; pass ``routes`` — e.g. the cost table a
+        :meth:`plan` call just returned — to skip re-running the
+        feasibility walk).
+
+        The device lane is what the per-route completion timing
+        (``TPQ_DEVICE_TIMING``, device_reader) measures: kernel time from
+        dispatch to ``block_until_ready``.  ``plain`` models ~0 (reshape +
+        bitcast, no compute); the compressed routes charge the op-table
+        resolve per OUTPUT byte at ``device_mbps``; ``narrow`` charges the
+        widen/re-bias pass the same way.  These ride ReaderStats per route
+        (``predicted_device_s``) so ``ship_feedback()`` can put them next
+        to the measured device lane — the ``TPQ_DEVICE_MBPS`` calibration
+        signal, exactly as the link lane calibrates ``TPQ_LINK_MBPS``.
+        """
+        c = routes if routes is not None else self.costs(f)
+        L = float(f.logical)
+        k = f.narrow_k
+        if not k and f.narrow_possible and not f.comp_bytes:
+            k = max(f.width // 2, 1)
+        narrowed = L * k / f.width if (k and f.width) else L
+        out = {}
+        for r in c:
+            if r == ROUTE_PLAIN:
+                out[r] = 0.0
+            elif r == ROUTE_NARROW_SNAPPY:
+                # resolve over the narrowed stream + the widen to L: the
+                # SAME term costs() uses — strictly more device work than
+                # bare narrow, never less
+                out[r] = self._t(L + narrowed, self.device_mbps)
+            else:  # narrow widen / snappy resolve: charged per output byte
+                out[r] = self._t(L, self.device_mbps)
         return out
 
     def routes(self, f: ChunkFacts) -> list:
@@ -227,6 +276,18 @@ def recalibrate_link_mbps(link_bytes_per_sec: float) -> "float | None":
     return max(round(link_bytes_per_sec / 1e6, 1), 1.0)
 
 
+def recalibrate_device_mbps(device_bytes_per_sec: float) -> "float | None":
+    """The ``TPQ_DEVICE_MBPS`` value a measured device-resolve rate says to
+    re-run with (the device twin of :func:`recalibrate_link_mbps`): logical
+    output bytes through the measured per-route device seconds, in MB/s,
+    floored at the planner's 1 MB/s clamp.  ``None`` when the device lane
+    was never timed — an unmeasured device must never overwrite a banked
+    calibration with a guess."""
+    if not device_bytes_per_sec or device_bytes_per_sec <= 0:
+        return None
+    return max(round(device_bytes_per_sec / 1e6, 1), 1.0)
+
+
 _default: "ShipPlanner | None" = None
 _default_lock = threading.Lock()
 
@@ -237,7 +298,8 @@ def default_planner() -> ShipPlanner:
     so monkeypatched tests see their override."""
     global _default
     key = (os.environ.get("TPQ_LINK_MBPS", ""),
-           os.environ.get("TPQ_FORCE_ROUTE", ""))
+           os.environ.get("TPQ_FORCE_ROUTE", ""),
+           os.environ.get("TPQ_DEVICE_MBPS", ""))
     with _default_lock:
         if _default is None or getattr(_default, "_env_key", None) != key:
             _default = ShipPlanner()
