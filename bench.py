@@ -883,6 +883,97 @@ def bench_data_faults(path, rows, reps=3):
     return out
 
 
+def bench_serve(path, rows, clients_sweep=(1, 4, 16)):
+    """High-QPS scan service bench (ISSUE 10): a concurrency sweep over ONE
+    shared ScanService vs the same queries run sequentially one-shot.
+
+    Each of N client threads runs Q queries (rotating column projections,
+    host decode) through a shared service whose PlanCache holds footers,
+    ScanPlan IR, and decoded dictionaries; the one-shot baseline opens a
+    fresh FileReader per query — paying the footer parse, the plan build,
+    and the dictionary decode every time.  Reports per-clients wall +
+    p50/p95 request latency + cache hit rate, and ``plan_cache_speedup``:
+    one-shot per-query wall / served-at-1-client per-query wall (same
+    concurrency, so the delta IS the shared-state win).  Skip with
+    BENCH_SERVE=0; ``--smoke`` exercises it end to end.
+    """
+    import threading
+
+    from tpu_parquet.reader import FileReader
+    from tpu_parquet.serve import ScanRequest, ScanService
+
+    q_per_client = int(os.environ.get("BENCH_SERVE_QUERIES", "6"))
+    with FileReader(path) as r0:
+        cols = [".".join(l.path) for l in r0.schema.selected_leaves()]
+    projections = [None, cols[: max(len(cols) // 2, 1)], cols[:1]]
+    out = {"rows": rows, "queries_per_client": q_per_client}
+
+    # one-shot baseline: fresh reader per query, nothing shared
+    t0 = time.perf_counter()
+    for i in range(q_per_client):
+        with FileReader(path, columns=projections[i % len(projections)]) as r:
+            r.read_all()
+    oneshot_s = time.perf_counter() - t0
+    out["oneshot_wall_s"] = round(oneshot_s, 4)
+    out["oneshot_per_query_s"] = round(oneshot_s / q_per_client, 5)
+    log(f"  serve one-shot: {q_per_client} queries in {oneshot_s:.3f}s")
+
+    for clients in clients_sweep:
+        svc = ScanService(concurrency=min(clients, 8),
+                          queue_depth=max(2 * clients, 4))
+        errors = []
+
+        def run_client(ci):
+            try:
+                for i in range(q_per_client):
+                    svc.scan(ScanRequest(
+                        path, columns=projections[(ci + i)
+                                                  % len(projections)]))
+            except Exception as e:  # noqa: BLE001 — reported, not fatal
+                errors.append(repr(e))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=run_client, args=(ci,))
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        tree = svc.obs_registry().as_dict()
+        svc.close()
+        sv = tree["serve"]
+        cache = sv["cache"]
+        hits = sum(cache[f"{k}_hits"] for k in ("footer", "plan", "dict"))
+        total = hits + sum(cache[f"{k}_misses"]
+                           for k in ("footer", "plan", "dict"))
+        hist = (tree.get("histograms") or {}).get("serve.request") or {}
+        nq = clients * q_per_client
+        entry = {
+            "wall_s": round(wall, 4),
+            "per_query_s": round(wall / nq, 5),
+            "queries": nq,
+            "p50_ms": round(float(hist.get("p50_seconds", 0.0)) * 1e3, 3),
+            "p95_ms": round(float(hist.get("p95_seconds", 0.0)) * 1e3, 3),
+            "cache_hit_rate": round(hits / total, 4) if total else 0.0,
+            "queue_wait_s": sv["queue_wait_seconds"],
+        }
+        if errors:
+            entry["errors"] = errors[:3]
+        out[f"clients{clients}"] = entry
+        log(f"  serve {clients} client(s): {nq} queries in {wall:.3f}s "
+            f"(p95 {entry['p95_ms']:.1f}ms, "
+            f"hit rate {entry['cache_hit_rate']:.0%})")
+    c1 = out.get("clients1")
+    if c1 and c1["per_query_s"]:
+        out["plan_cache_speedup"] = round(
+            out["oneshot_per_query_s"] / c1["per_query_s"], 3)
+        log(f"serve: plan_cache_speedup "
+            f"{out['plan_cache_speedup']:.2f}x (shared plan/footer/dict "
+            f"cache vs one-shot opens)")
+    return out
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache (one implementation: the library's —
     device_reader._enable_compile_cache defers to an app-configured dir /
@@ -1415,6 +1506,17 @@ def main(argv=None):
             results["data_faults"] = bench_data_faults(ppath, prows)
         except Exception as e:  # noqa: BLE001
             log(f"data_faults bench FAILED: {e!r}")
+
+    # High-QPS scan service: concurrency sweep over a shared ScanService
+    # vs sequential one-shot opens (plan/footer/dict cache win + p50/p95
+    # SLOs).  Skip with BENCH_SERVE=0; smoke DOES run it (cheap, and the
+    # service's thread lifecycle rides the leak gate below).
+    if os.environ.get("BENCH_SERVE", "1") != "0" and not over_budget():
+        try:
+            ppath, prows = _config_file("4")
+            results["serve"] = bench_serve(ppath, prows)
+        except Exception as e:  # noqa: BLE001
+            log(f"serve bench FAILED: {e!r}")
 
     # Writer throughput (host encode; ~10s).  Skip with BENCH_WRITES=0.
     if os.environ.get("BENCH_WRITES", "1") != "0" and not over_budget():

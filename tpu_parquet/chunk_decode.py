@@ -167,6 +167,7 @@ class ChunkDecoder:
         validate_crc: bool = False,
         alloc: Optional[AllocTracker] = None,
         context: Optional[dict] = None,
+        dict_cache=None,
     ):
         self.leaf = leaf
         self.validate_crc = validate_crc
@@ -175,6 +176,11 @@ class ChunkDecoder:
         if "column" not in self.context and leaf.path:
             self.context["column"] = ".".join(leaf.path)
         self.dictionary = None  # decoded dict values (np array or ByteArrayData)
+        # read-through decoded-dictionary cache (serve.BoundDictCache duck
+        # type): get(rg, column, kind) / put(rg, column, kind, value,
+        # nbytes).  Keyed by this decoder's context coordinates — callers
+        # without a row_group/column context never hit it.
+        self.dict_cache = dict_cache
 
     # -- value decoding dispatch (getValuesDecoder, chunk_reader.go:106-159) --
 
@@ -236,7 +242,27 @@ class ChunkDecoder:
 
     # -- pages ----------------------------------------------------------------
 
+    def _dict_cache_key(self):
+        rg = self.context.get("row_group")
+        col = self.context.get("column")
+        if self.dict_cache is None or rg is None or col is None:
+            return None
+        # the CRC tier is part of the key: a validate_crc=True request
+        # must never be served a dictionary a no-validation request
+        # decoded without the integrity check it asked for
+        return rg, col, f"host:v{1 if self.validate_crc else 0}"
+
     def _decode_dict_page(self, ps: PageSlice, buf: bytes, codec: int):
+        # read-through seam: a dictionary this cache already decoded for
+        # this (row group, column, CRC tier) of this file generation skips
+        # the decompress + PLAIN decode entirely.  Decoded dictionaries are
+        # shared read-only — every consumer below copies on take/index.
+        ck = self._dict_cache_key()
+        if ck is not None:
+            hit = self.dict_cache.get(ck[0], ck[1], ck[2])
+            if hit is not None:
+                self.dictionary = hit
+                return
         header = ps.header
         payload = buf[ps.payload_start : ps.payload_end]
         _check_crc(header, payload, self.validate_crc)
@@ -252,6 +278,11 @@ class ChunkDecoder:
         self.dictionary = plain.decode(
             raw, self.leaf.physical_type, count, self.leaf.type_length
         )
+        if ck is not None:
+            d = self.dictionary
+            nbytes = (int(d.offsets.nbytes + d.heap.nbytes)
+                      if isinstance(d, ByteArrayData) else int(d.nbytes))
+            self.dict_cache.put(ck[0], ck[1], ck[2], d, nbytes)
 
     def _decode_data_page_v1(self, ps: PageSlice, buf: bytes, codec: int):
         header = ps.header
@@ -466,12 +497,18 @@ def read_chunk(
     validate_crc: bool = False,
     alloc: Optional[AllocTracker] = None,
     context: Optional[dict] = None,
+    dict_cache=None,
+    meta: "Optional[tuple]" = None,
 ) -> ColumnData:
-    """Read + decode one column chunk from an open file (readChunk parity)."""
+    """Read + decode one column chunk from an open file (readChunk parity).
+
+    ``meta``: a pre-validated ``(md, offset)`` pair from
+    :func:`validate_chunk_meta` (the scanplan chunk walk yields them) —
+    callers that already walked the footer skip the second validation."""
     from .iostore import require_full
     from .quarantine import error_context
 
-    md, offset = validate_chunk_meta(chunk, leaf)
+    md, offset = meta if meta is not None else validate_chunk_meta(chunk, leaf)
     size = md.total_compressed_size
     if alloc is not None:
         alloc.register(size)
@@ -485,5 +522,5 @@ def read_chunk(
         require_full(buf, offset, size,
                      context=f"column {'.'.join(leaf.path)}")
     dec = ChunkDecoder(leaf, validate_crc=validate_crc, alloc=alloc,
-                       context=ctx)
+                       context=ctx, dict_cache=dict_cache)
     return dec.decode(buf, md.codec, md.num_values)

@@ -439,6 +439,13 @@ def cmd_autopsy(args, out=sys.stdout) -> int:
     if io:
         out.write(f"io: range at offset {io['offset']} ({io['size']} bytes) "
                   f"in flight for {io['age_s']:g}s\n")
+    sv = rep.get("serve")
+    if sv:
+        stuck = sv.get("stuck_request")
+        tail = (f"; stuck request #{stuck['id']} over {stuck['path']!r} "
+                f"({stuck['age_s']:g}s in flight)" if stuck else "")
+        out.write(f"serve: {sv.get('in_flight', 0)} in flight, queue depth "
+                  f"{sv.get('queue_depth', 0)}{tail}\n")
     de = rep.get("data_errors")
     if de:
         first = de.get("first") or {}
@@ -452,6 +459,65 @@ def cmd_autopsy(args, out=sys.stdout) -> int:
         out.write(f"error: {err.get('type')}: {err.get('message')}\n")
     out.write(f"verdict: {rep['verdict']}\n")
     out.write(f"probable cause: {rep['probable_cause']}\n")
+    return 0
+
+
+def cmd_serve_stats(args, out=sys.stdout) -> int:
+    """Summarize a scan service run's ``serve`` registry section: request/
+    rejection counters, queue depth, plan-cache hit rates, and the
+    per-request latency SLO table (p50/p95 from the ``serve.*``
+    histograms).  Accepts the same inputs as ``doctor`` (registry tree,
+    trace artifact, bench artifact, ledger ref) plus flight dumps."""
+    from ..obs import LatencyHistogram
+
+    tree, why = _load_registry_tree(args.file, getattr(args, "config", None))
+    if tree is None:
+        doc = _load_doc(args.file)
+        if isinstance(doc, dict) and isinstance(doc.get("registry"), dict):
+            tree, why = doc["registry"], None  # a flight dump's snapshot
+    if tree is None:
+        out.write(f"pq-tool serve-stats: {args.file}: {why}\n")
+        return 1
+    sv = tree.get("serve")
+    if not isinstance(sv, dict):
+        out.write(f"pq-tool serve-stats: {args.file}: registry has no "
+                  f"`serve` section (run was not served through a "
+                  f"ScanService)\n")
+        return 1
+    out.write(f"serve-stats: {args.file}\n")
+    out.write(f"requests: {sv.get('submitted', 0)} submitted, "
+              f"{sv.get('completed', 0)} completed, "
+              f"{sv.get('rejected', 0)} rejected (overload), "
+              f"{sv.get('failed', 0)} failed\n")
+    out.write(f"queue: depth peak {sv.get('queue_depth_peak', 0)}, "
+              f"total wait {float(sv.get('queue_wait_seconds', 0)):.4f}s, "
+              f"total exec {float(sv.get('exec_seconds', 0)):.4f}s\n")
+    cache = sv.get("cache") or {}
+    if cache:
+        def rate(kind):
+            h = int(cache.get(f"{kind}_hits", 0))
+            m = int(cache.get(f"{kind}_misses", 0))
+            return f"{kind} {h}/{h + m}" + (
+                f" ({100 * h / (h + m):.0f}%)" if h + m else "")
+
+        out.write("cache hits: " + "  ".join(
+            rate(k) for k in ("footer", "plan", "dict"))
+            + f"  [{cache.get('held_bytes', 0)} B held, "
+              f"{cache.get('evictions', 0)} evicted, "
+              f"{cache.get('invalidations', 0)} invalidated]\n")
+    hists = tree.get("histograms") or {}
+    slo = [(name.split(".", 1)[1], LatencyHistogram.from_dict(hd))
+           for name, hd in sorted(hists.items())
+           if name.startswith("serve.")]
+    if slo:
+        out.write("latency (per request):\n")
+        out.write(f"  {'lane':<12}{'count':>7}{'p50':>12}{'p95':>12}"
+                  f"{'max':>12}\n")
+        for lane, h in slo:
+            out.write(f"  {lane:<12}{h.count:>7}"
+                      f"{h.quantile(0.5) * 1e3:>10.2f}ms"
+                      f"{h.quantile(0.95) * 1e3:>10.2f}ms"
+                      f"{h.max_seconds * 1e3:>10.2f}ms\n")
     return 0
 
 
@@ -656,6 +722,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="summarize a quarantine ledger (TPQ_QUARANTINE_LOG JSONL)")
     qa.add_argument("file", help="quarantine JSONL path")
     qa.set_defaults(func=cmd_quarantine)
+
+    ss = sub.add_parser(
+        "serve-stats",
+        help="summarize a ScanService run's `serve` registry section: "
+             "queue depth, cache hit rates, per-request p50/p95 SLO table")
+    ss.add_argument("file", help="registry/trace/bench artifact, flight "
+                                 "dump, or ledger ref")
+    ss.add_argument("--config", default=None,
+                    help="bench-artifact input: which config's registry to "
+                         "summarize")
+    ss.set_defaults(func=cmd_serve_stats)
 
     be = sub.add_parser(
         "bench", help="run-ledger tools: compare and list recorded runs")

@@ -39,13 +39,14 @@ import jax.numpy as jnp
 
 from . import jax_kernels as K
 from .jax_kernels import scoped_x64
-from .chunk_decode import _check_crc, validate_chunk_meta, walk_pages
+from .chunk_decode import _check_crc, walk_pages
 from .column import ByteArrayData
 from .kernels import bitpack
 from .compress import decompress_block
 from .footer import ParquetError
 from .format import Encoding, PageType, Type, parse_encoding
 from .iostore import require_full
+from .scanplan import int_stats_span as _int_stats_span, row_group_chunks
 from .jax_decode import (
     DeviceColumnData, ParsedDataPage, _bucket, _bucket_bytes, _bucket_count,
     _SLACK, _concat_jit, _concat_ragged_jit, _dict_gather_bytes_jit,
@@ -1350,6 +1351,10 @@ class _ChunkAssembler:
         self._bytes_walk: "tuple | None" = None  # (lens_l, span_l)
         self._narrow_compress = False
         self.ship_records: list = []
+        # memoized route from a replayed ScanPlan (scanplan.py): preship
+        # puts it first in the preference order, so a plain memo skips the
+        # failed narrow/recompress probes a first pass already paid
+        self._route_hint: "str | None" = None
 
     def _record_ship(self, route: str, logical: int, shipped: int,
                      predicted: "float | None" = None,
@@ -1367,6 +1372,18 @@ class _ChunkAssembler:
         self.ship_records.append(
             (route, int(logical), int(shipped), float(predicted),
              float(predicted_device)))
+
+    def _apply_route_hint(self) -> None:
+        """Reorder the planner's preference behind a replayed route memo.
+
+        Only a route the model priced FEASIBLE for this chunk moves up (a
+        hint recorded for different data never forces an impossible
+        build); everything else of the ranked order stays as fallback.
+        A forced route (``TPQ_FORCE_ROUTE``) wins over any memo."""
+        h = self._route_hint
+        if (h and self._ship_pref and h in (self._ship_costs or {})
+                and self._ship_pref[0] != h):
+            self._ship_pref = [h] + [r for r in self._ship_pref if r != h]
 
     def _route_enabled(self, route: str) -> bool:
         """Whether the planner ranked ``route`` ahead of the plain tail
@@ -1390,6 +1407,38 @@ class _ChunkAssembler:
             self.dict_len = len(decoded)
         else:
             self.dict_u8, self.dict_dtype, self.dict_len = decoded
+
+    def dict_cache_entry(self) -> "dict | None":
+        """This chunk's decoded dictionary as a read-through cache entry
+        (serve.PlanCache): the decoded table, its compressed ship payload
+        when the file's own snappy page covers the rows, and a byte size
+        for cache accounting.  None when the chunk has no dictionary."""
+        if self.dict_len == 0:
+            return None
+        if self.dict_u8 is not None:
+            nbytes = int(self.dict_u8.nbytes)
+        elif self.dict_ragged is not None:
+            nbytes = int(self.dict_ragged.offsets.nbytes
+                         + self.dict_ragged.heap.nbytes)
+        else:
+            return None
+        if self.dict_comp is not None:
+            nbytes += len(self.dict_comp[0])
+        return {
+            "u8": self.dict_u8, "dtype": self.dict_dtype,
+            "ragged": self.dict_ragged, "len": self.dict_len,
+            "comp": self.dict_comp, "nbytes": nbytes,
+        }
+
+    def adopt_dictionary(self, entry: dict) -> None:
+        """Adopt a cached decoded dictionary (inverse of
+        :meth:`dict_cache_entry`) — shared READ-ONLY across assemblers;
+        every consumer gathers/copies, never mutates the tables."""
+        self.dict_u8 = entry.get("u8")
+        self.dict_dtype = entry.get("dtype")
+        self.dict_ragged = entry.get("ragged")
+        self.dict_len = int(entry.get("len") or 0)
+        self.dict_comp = entry.get("comp")
 
     # -- ship planning (host half; see tpu_parquet.ship) ----------------------
 
@@ -1493,7 +1542,7 @@ class _ChunkAssembler:
         return k, mn, out
 
     def preship(self, planner: "ShipPlanner | None" = None,
-                pipe_stats=None) -> None:
+                pipe_stats=None, route_hint: "str | None" = None) -> None:
         """Route choice + link-byte host work for this chunk (ship.py).
 
         Runs on the prefetch pool's worker threads when prefetch > 0 — the
@@ -1503,9 +1552,16 @@ class _ChunkAssembler:
         preference plus any host-built artifacts; ``finish`` executes the
         routes in order, falling through on infeasibility.  Compression
         seconds land in PipelineStats' ``recompress`` stage.
+
+        ``route_hint`` (a replayed ScanPlan's memoized route) moves that
+        route to the head of the preference order when the model still
+        prices it feasible — the builders' fall-through keeps correctness
+        if the replay turns out infeasible on this chunk.
         """
         if planner is None:
             planner = default_planner()
+        # a forced route (TPQ_FORCE_ROUTE) wins over any replayed memo
+        self._route_hint = route_hint if planner.force is None else None
         self._preship_dict(planner, pipe_stats)
         if not self.pages:
             return
@@ -1542,6 +1598,7 @@ class _ChunkAssembler:
         self._ship_pref, self._ship_costs = planner.plan(facts)
         self._ship_dev_costs = planner.device_costs(
             facts, routes=self._ship_costs)
+        self._apply_route_hint()
         # failed host work is memoized as a None sentinel so the finish
         # builders (and a later pref entry naming the same family) never
         # repeat a full-chunk scan that already failed — preship exists to
@@ -1606,6 +1663,7 @@ class _ChunkAssembler:
         self._ship_pref, self._ship_costs = planner.plan(facts)
         self._ship_dev_costs = planner.device_costs(
             facts, routes=self._ship_costs)
+        self._apply_route_hint()
         for route in self._ship_pref:
             if route == ROUTE_DEVICE_SNAPPY:
                 if comp_bytes:
@@ -2827,7 +2885,7 @@ class _ChunkAssembler:
 def _collect_chunk(
     buf: bytes, codec: int, total_values: int, leaf: SchemaNode,
     deferred_checks: list, validate_crc: bool = False, alloc=None,
-    statistics=None, skip_pages=None, context=None,
+    statistics=None, skip_pages=None, context=None, dict_cache=None,
 ) -> Optional[_ChunkAssembler]:
     """Walk a chunk's pages into an assembler (host phase); None if no data.
 
@@ -2835,7 +2893,11 @@ def _collect_chunk(
     pushdown — their payloads are never decompressed, parsed, or staged.
     ``context``: decode-site coordinates ({file, column, row_group,
     chunk_offset}) stamped onto every raise (quarantine.error_context),
-    plus the failing page's ordinal and byte offset."""
+    plus the failing page's ordinal and byte offset.
+    ``dict_cache`` (serve.BoundDictCache duck type): read-through cache of
+    DECODED dictionaries keyed by this context's (row_group, column) — a
+    hit adopts the decoded value table (and its compressed ship payload)
+    without decompressing or parsing the dictionary page again."""
     from .format import CompressionCodec
     from .quarantine import error_context
 
@@ -2865,6 +2927,17 @@ def _collect_chunk(
         header = ps.header
         pt = header.type
         if pt == PageType.DICTIONARY_PAGE:
+            dk = (ctx.get("row_group"), ctx.get("column"),
+                  # CRC tier in the key (chunk_decode._dict_cache_key
+                  # contract): a validating request never adopts an
+                  # unvalidated decode
+                  f"dev:v{1 if validate_crc else 0}")
+            if (dict_cache is not None and dk[0] is not None
+                    and dk[1] is not None):
+                hit = dict_cache.get(dk[0], dk[1], dk[2])
+                if hit is not None:
+                    asm.adopt_dictionary(hit)
+                    continue
             with error_context(offset=chunk_offset + ps.payload_start, **ctx):
                 payload = buf[ps.payload_start : ps.payload_end]
                 _check_crc(header, payload, validate_crc)
@@ -2880,6 +2953,12 @@ def _collect_chunk(
                 # it on device (_preship_dict / _finish_dict)
                 asm.dict_comp = (payload,
                                  max(header.uncompressed_page_size or 0, 0))
+            if (dict_cache is not None and dk[0] is not None
+                    and dk[1] is not None):
+                entry = asm.dict_cache_entry()
+                if entry is not None:
+                    dict_cache.put(dk[0], dk[1], dk[2], entry,
+                                   entry["nbytes"])
             continue
         if pt in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
             if skip_pages and data_ordinal in skip_pages:
@@ -2900,30 +2979,6 @@ def _collect_chunk(
     # returned even with zero pages: a fully-pruned chunk still carries its
     # pages_pruned count (callers emit a placeholder column for it)
     return asm
-
-
-def _int_stats_span(statistics, leaf: SchemaNode) -> "tuple[int, int] | None":
-    """Decode chunk Statistics min/max into an int span hint, if plausible.
-
-    Returns (min, max) for INT32/INT64 leaves whose stats carry well-formed
-    PLAIN-encoded bounds, else None.  Used only to ROUTE between transfer
-    strategies (never for correctness), so malformed or lying stats are
-    simply ignored.
-    """
-    if statistics is None or leaf.physical_type not in (Type.INT32, Type.INT64):
-        return None
-    width = 8 if leaf.physical_type == Type.INT64 else 4
-    dt = "<i8" if width == 8 else "<i4"
-    lo = statistics.min_value if statistics.min_value is not None else statistics.min
-    hi = statistics.max_value if statistics.max_value is not None else statistics.max
-    if (not isinstance(lo, (bytes, bytearray)) or len(lo) != width
-            or not isinstance(hi, (bytes, bytearray)) or len(hi) != width):
-        return None
-    lo_v = int(np.frombuffer(lo, dt)[0])
-    hi_v = int(np.frombuffer(hi, dt)[0])
-    if lo_v > hi_v:
-        return None
-    return lo_v, hi_v
 
 
 @scoped_x64
@@ -3463,7 +3518,8 @@ class DeviceFileReader:
                  profile_dir: "str | None" = None, max_memory: int = 0,
                  row_filter=None, prefetch: int = 0, trace=None,
                  sample_ms=None, hang_s=None, hang_policy=None,
-                 store=None, on_data_error=None, quarantine=None):
+                 store=None, on_data_error=None, quarantine=None,
+                 metadata=None, plan=None, dict_cache=None):
         from .obs import (Sampler, Watchdog, register_flight_registry,
                           resolve_hang_s, resolve_sample_ms, resolve_tracer)
         from .pipeline import PipelineStats
@@ -3483,7 +3539,20 @@ class DeviceFileReader:
                                 row_filter=row_filter,
                                 trace=self._tracer, store=store,
                                 on_data_error=on_data_error,
-                                quarantine=quarantine)
+                                quarantine=quarantine,
+                                metadata=metadata, plan=plan,
+                                dict_cache=dict_cache)
+        # the plan IR (scanplan.py): the footer slice + pruning verdicts +
+        # ship-route memo this scan consumes.  A caller-supplied plan (the
+        # serve.ScanService cache) is REPLAYED — group pruning is adopted
+        # from it (via the host reader), page-pruning header walks are
+        # skipped where memoized, and preship starts from the memoized
+        # route.  Without one, the reader builds its own, so plan
+        # construction always lives in scanplan.py.
+        self._plan = self._host._plan
+        # decoded-dictionary read-through cache (serve.BoundDictCache duck
+        # type: get(rg, column, kind) / put(rg, column, kind, value, nbytes))
+        self._dict_cache = dict_cache
         # data-error containment engine, SHARED with the host half so the
         # budget and quarantine ledger span both decode paths
         self.quarantine = self._host.quarantine
@@ -3660,165 +3729,41 @@ class DeviceFileReader:
 
     @staticmethod
     def _walk_headers_file(f, offset: int, size: int, num_values: int):
-        """Page headers of a chunk read via seeks — header bytes only, never
-        the payloads (the pruning planner needs page BOUNDARIES of every
-        selected column; loading whole chunks for that doubled peak host
-        memory under row_filter).  Returns the data-page headers in order."""
-        from .chunk_decode import _read_page_header
-        from .thrift import ThriftError
+        """Page headers of a chunk read via seeks (moved to
+        scanplan.walk_header_pages — kept as a delegate for callers/tests
+        addressing the reader)."""
+        from .scanplan import walk_header_pages
 
-        headers = []
-        pos = 0
-        seen = 0
-        seen_dict = False
-        while seen < num_values:
-            if pos >= size:
-                raise ParquetError(
-                    f"chunk exhausted at {seen}/{num_values} values")
-            win = 1024
-            while True:
-                f.seek(offset + pos)
-                head = f.read(min(win, size - pos))
-                try:
-                    header, hlen = _read_page_header(head, 0)
-                    break
-                except ThriftError as e:
-                    # could be a truncated window, not corruption: widen
-                    # until the whole remaining chunk has been tried
-                    if win >= size - pos:
-                        raise ParquetError(
-                            f"corrupt page header: {e}") from e
-                    win *= 8
-            csize = header.compressed_page_size
-            if csize is None or csize < 0:
-                raise ParquetError(f"invalid compressed page size {csize}")
-            usize = header.uncompressed_page_size
-            if usize is None or usize < 0:
-                raise ParquetError(f"invalid uncompressed page size {usize}")
-            if hlen + csize > size - pos:
-                raise ParquetError("page payload extends past chunk end")
-            # CONTRACT: the data-page ordinals this walk yields must match
-            # walk_pages' exactly — skip_pages indices computed here are
-            # applied against walk_pages' sequence in _collect_chunk, so
-            # the reject set below mirrors walk_pages (missing per-type
-            # headers raise; anything else would silently shift ordinals
-            # and prune the wrong pages)
-            if header.type == PageType.DATA_PAGE:
-                if header.data_page_header is None:
-                    raise ParquetError("data page v1 missing its header")
-                seen += header.data_page_header.num_values or 0
-                headers.append(header)
-            elif header.type == PageType.DATA_PAGE_V2:
-                if header.data_page_header_v2 is None:
-                    raise ParquetError("data page v2 missing its header")
-                seen += header.data_page_header_v2.num_values or 0
-                headers.append(header)
-            elif header.type == PageType.DICTIONARY_PAGE:
-                if seen_dict or headers:
-                    raise ParquetError("unexpected extra dictionary page")
-                if header.dictionary_page_header is None:
-                    raise ParquetError("dictionary page missing its header")
-                seen_dict = True
-            pos += hlen + csize
-        return headers
+        return walk_header_pages(f, offset, size, num_values)
 
-    def _plan_page_pruning(self, rg, leaves, f=None):
-        """Page-level predicate pushdown (beyond the reference, which writes
-        page Statistics but never reads them): within a surviving row group,
-        maximal row runs the predicate provably cannot match — aligned to
-        whole-page boundaries of EVERY selected column — are dropped by
-        skipping those pages outright (no decompression, no staging, no
-        decode).  Returns ({column_path: set(data-page ordinals to skip)},
-        rows_dropped), or (None, 0) when ineligible (no filter, repeated
-        columns, a filter column absent/repeated).
-
-        Output contract (same lattice as group pruning): yielded rows are a
-        SUPERSET of matching rows — callers re-filter exactly; whole-page
-        alignment keeps every column's yielded rows identical.
+    def _plan_page_pruning(self, rg, leaves, f=None, index=None):
+        """Page-level predicate pushdown planning, via the plan IR
+        (scanplan.plan_page_pruning) with a per-row-group memo: a replayed
+        ScanPlan (serve's PlanCache, or a second scan over one reader)
+        skips the header walks entirely and adopts the recorded skip sets.
+        The memoized replay returns no filter-chunk buffers — the decode
+        loop then reads those chunks itself, exactly as without a filter.
         """
         pred = self._host.row_filter
         if pred is None:
             return None, 0, {}
-        from .predicate import prune_pages
+        from . import scanplan as _sp
 
-        all_leaves = {".".join(l.path): l for l in self.schema.leaves}
-        if any(l.max_rep > 0 for l in leaves.values()):
-            return None, 0, {}
-        fcols = set(pred.columns())
-        for name in fcols:
-            leaf = all_leaves.get(name)
-            if leaf is None or leaf.max_rep > 0:
-                return None, 0, {}
-        by_path = {}
-        for chunk in rg.columns or []:
-            md = chunk.meta_data
-            if md is not None and md.path_in_schema:
-                by_path[".".join(md.path_in_schema)] = chunk
-        if not fcols <= set(by_path):
-            return None, 0, {}
+        plan = self._plan
+        memo_ok = (plan is not None and index is not None
+                   and plan.filter_fp is not None
+                   and plan.filter_fp == _sp.predicate_fingerprint(pred))
+        if memo_ok:
+            hint = plan.pruning_hint(index)
+            if hint is not None:
+                skip, rows_dropped = hint
+                return skip, rows_dropped, {}
         if f is None:  # the chunk feed passes a thread-safe pread view
             f = self._host._sr.as_file()  # store-backed, like every read
-        filter_pages = {}
-        boundaries = {}
-        # FILTER chunks' bytes, handed to the decode loop when also selected
-        # — the planner already paid their IO.  Non-filter selected columns
-        # are walked header-only via seeks (loading their whole chunks here
-        # roughly doubled peak host memory under row_filter); the decode
-        # loop reads them exactly once, as without a filter.
-        bufs: dict = {}
-        walk = set(fcols) | {".".join(p) for p in leaves}
-        for name in walk:
-            chunk = by_path.get(name)
-            if chunk is None:
-                return None, 0, bufs  # selected column missing: decode raises
-            leaf = all_leaves[name]
-            md, offset = validate_chunk_meta(chunk, leaf)
-            if name in fcols:
-                f.seek(offset)
-                buf = f.read(md.total_compressed_size)
-                if tuple(name.split(".")) in leaves:
-                    bufs[tuple(name.split("."))] = buf
-                hdrs = [ps.header for ps in walk_pages(buf, md.num_values)]
-            else:
-                hdrs = self._walk_headers_file(
-                    f, offset, md.total_compressed_size, md.num_values)
-            ends, stats = [], []
-            total = 0
-            for h in hdrs:
-                if h.type == PageType.DATA_PAGE and h.data_page_header:
-                    total += h.data_page_header.num_values or 0
-                    st = h.data_page_header.statistics
-                elif (h.type == PageType.DATA_PAGE_V2
-                      and h.data_page_header_v2):
-                    total += h.data_page_header_v2.num_values or 0
-                    st = h.data_page_header_v2.statistics
-                else:
-                    continue
-                ends.append(total)
-                stats.append(st)
-            boundaries[name] = ends
-            if name in fcols:
-                filter_pages[name] = (ends, stats, md.type)
-        num_rows = rg.num_rows or 0
-        sel_bounds = {n: boundaries[n]
-                      for n in {".".join(p) for p in leaves}}
-        runs = prune_pages(filter_pages, sel_bounds, num_rows, pred,
-                           all_leaves)
-        if not runs:
-            return None, 0, bufs
-        skip = {}
-        for path in leaves:
-            name = ".".join(path)
-            ends = boundaries[name]
-            drop = set()
-            start = 0
-            for i, e in enumerate(ends):
-                if any(a <= start and e <= b for a, b in runs):
-                    drop.add(i)
-                start = e
-            if drop:
-                skip[path] = drop
-        rows_dropped = sum(b - a for a, b in runs)
+        skip, rows_dropped, bufs = _sp.plan_page_pruning(
+            rg, leaves, self.schema, pred, f)
+        if memo_ok:
+            plan.note_pruning(index, skip, rows_dropped)
         return skip, rows_dropped, bufs
 
     @scoped_x64
@@ -3854,20 +3799,13 @@ class DeviceFileReader:
         self.alloc.reset()
         if collected is None:
             skip_pages, rows_dropped, planned_bufs = self._plan_page_pruning(
-                rg, leaves)
+                rg, leaves, index=index)
         else:
             skip_pages, planned_bufs = None, {}
             rows_dropped = collected["rows_dropped"]
         stager = _RowGroupStager(executor)
         plans: list[tuple[str, object]] = []
-        for chunk in rg.columns or []:
-            md = chunk.meta_data
-            if md is None or md.path_in_schema is None:
-                raise ParquetError("column chunk missing metadata/path")
-            path = tuple(md.path_in_schema)
-            leaf = leaves.get(path)
-            if leaf is None:
-                continue
+        for path, leaf, chunk, md, offset in row_group_chunks(rg, leaves):
             if collected is not None:
                 entry = collected["chunks"].get(path)
                 if entry is None:
@@ -3888,7 +3826,6 @@ class DeviceFileReader:
                 self._stats.compressed_bytes += md.total_compressed_size
                 self.alloc.register(md.total_compressed_size)
             else:
-                md, offset = validate_chunk_meta(chunk, leaf)
                 ctx = {"file": self._host._source_name, "row_group": index,
                        "column": ".".join(path), "chunk_offset": offset}
                 buf = planned_bufs.get(path)
@@ -3905,10 +3842,18 @@ class DeviceFileReader:
                     validate_crc=self.validate_crc, alloc=self.alloc,
                     statistics=md.statistics,
                     skip_pages=(skip_pages or {}).get(path),
-                    context=ctx,
+                    context=ctx, dict_cache=self._dict_cache,
                 )
                 if asm is not None:
-                    asm.preship(self._ship_planner, self._pipe_stats)
+                    # replay the plan IR's memoized route (scanplan.py):
+                    # preship starts from the recorded choice instead of
+                    # re-ranking — and, on a plain memo, skips the failed
+                    # narrow/recompress probes a first pass already paid
+                    asm.preship(self._ship_planner, self._pipe_stats,
+                                route_hint=(
+                                    self._plan.route_hint(index,
+                                                          ".".join(path))
+                                    if self._plan is not None else None))
             if asm is not None:
                 self._stats.pages += len(asm.pages)
                 self._stats.pages_pruned += asm.pages_pruned
@@ -3949,6 +3894,12 @@ class DeviceFileReader:
             plan.route = best_route or ROUTE_PLAIN
             plan.bytes_in = logical_sum
             plan.bytes_staged = shipped_sum
+            if self._plan is not None:
+                # memoize the decision into the plan IR: a replay (this
+                # reader's next scan, or the serve cache's next request
+                # over the same plan) starts preship from it
+                self._plan.note_route(index, name, plan.route,
+                                      _kernel_family(plan.key))
         # every selected leaf must have a chunk in the row group (host
         # FileReader parity — reader.py read_row_group's missing check)
         seen = set(out) | {name for name, _ in plans}
@@ -4392,18 +4343,10 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
             rg = r.metadata.row_groups[i]
             leaves = {l.path: l for l in r.schema.selected_leaves()}
             skip_pages, rows_dropped, planned_bufs = r._plan_page_pruning(
-                rg, leaves, f=sr.as_file())
+                rg, leaves, f=sr.as_file(), index=i)
             items = []
             ranges = []
-            for chunk in rg.columns or []:
-                md = chunk.meta_data
-                if md is None or md.path_in_schema is None:
-                    raise ParquetError("column chunk missing metadata/path")
-                p = tuple(md.path_in_schema)
-                leaf = leaves.get(p)
-                if leaf is None:
-                    continue  # unselected: never read its bytes
-                md, offset = validate_chunk_meta(chunk, leaf)
+            for p, leaf, _chunk, md, offset in row_group_chunks(rg, leaves):
                 items.append([r, sr, i, p, leaf, md, offset,
                               (skip_pages or {}).get(p),
                               planned_bufs.get(p), None])
@@ -4462,7 +4405,7 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
                     buf, md.codec, md.num_values, leaf, r._deferred,
                     validate_crc=r.validate_crc, alloc=tracker,
                     statistics=md.statistics, skip_pages=skip,
-                    context=ctx,
+                    context=ctx, dict_cache=r._dict_cache,
                 )
         except ParquetError as e:
             # containment seam (quarantine.py): wrap instead of raise so
@@ -4480,7 +4423,9 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
         # timer: its compression seconds land in the `recompress` stage) —
         # the link-recompression work overlaps the consumer's stage/dispatch
         if asm is not None:
-            asm.preship(r._ship_planner, stats)
+            asm.preship(r._ship_planner, stats,
+                        route_hint=(r._plan.route_hint(i, ".".join(p))
+                                    if r._plan is not None else None))
         stats.count_chunk()
         return (id(r), i), p, (md, asm)
 
@@ -4628,7 +4573,7 @@ def scan_files(paths, columns=None, validate_crc=None,
                max_memory: int = 0, row_filter=None, with_path: bool = False,
                prefetch: int = 0, trace=None, sample_ms=None, hang_s=None,
                hang_policy=None, store=None, on_data_error=None,
-               quarantine=None):
+               quarantine=None, plan_cache=None):
     """Scan several files' row groups through ONE continuous transfer pipeline.
 
     ``prefetch=K`` additionally runs chunk IO + decompression K-deep on a
@@ -4642,6 +4587,11 @@ def scan_files(paths, columns=None, validate_crc=None,
     ``store=`` selects the IO backend per file (iostore.py): pass a
     FACTORY callable (``lambda f: MyRangeStore(...)``) so each file gets
     its own store — a single shared instance would mix files' bytes.
+
+    ``plan_cache=`` (a :class:`tpu_parquet.serve.PlanCache`) makes every
+    file's footer, ScanPlan IR, and decoded dictionaries read through
+    shared cached state — a re-scanned file re-parses nothing, and route/
+    pruning memos accumulate across scans.
 
     The multi-file dataset form of ``DeviceFileReader.iter_row_groups``
     (BASELINE config 5 is a multi-file row-group scan): per-file iteration
@@ -4724,10 +4674,18 @@ def scan_files(paths, columns=None, validate_crc=None,
 
     def work():
         for path in paths:
+            # with a serve.PlanCache, the footer, the ScanPlan IR, and the
+            # decoded-dictionary cache all read through shared state — a
+            # re-scanned file re-parses nothing (ROADMAP item 4's owed
+            # footer cache, generalized)
+            kw = (plan_cache.reader_kwargs(path, columns=columns,
+                                           row_filter=row_filter)
+                  if plan_cache is not None else {})
             r = DeviceFileReader(
                 path, columns=columns, validate_crc=validate_crc,
                 max_memory=max_memory, row_filter=row_filter, trace=tracer,
                 sample_ms=sample_ms, hang_s=0, store=store, quarantine=q,
+                **kw,
             )
             readers.append(r)
             if watchdog.enabled:

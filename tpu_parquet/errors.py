@@ -65,6 +65,26 @@ class RetryExhaustedError(IOError):
         self.size = size
 
 
+class OverloadError(RuntimeError):
+    """A scan service rejected a request because its admission queue is full.
+
+    Raised by :class:`tpu_parquet.serve.ScanService` *at submission time* —
+    a fast-reject, never a blocked caller: under overload the service sheds
+    load in microseconds so callers can back off or route elsewhere, instead
+    of queueing unboundedly until every client times out.  Deliberately NOT
+    a ParquetError (nothing is malformed) and not an IOError (nothing was
+    read): it is a load-shedding signal.  ``queue_depth`` and ``in_flight``
+    carry the admission state at rejection so the error itself says how
+    overloaded the service was.
+    """
+
+    def __init__(self, message: str, queue_depth: "int | None" = None,
+                 in_flight: "int | None" = None):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.in_flight = in_flight
+
+
 class DataIntegrityError(ParquetError):
     """A scan's data-error budget is exhausted: corruption is no longer
     containable.

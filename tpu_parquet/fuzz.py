@@ -801,6 +801,72 @@ def crafted_page_corrupt_blobs() -> "list[bytes]":
     ]
 
 
+def fuzz_scan_plan(data: bytes) -> None:
+    """Fuzz target #16: ScanPlan IR blob adoption (scanplan.py).
+
+    The serve layer caches serialized plans and replays them across
+    requests, so a plan blob is an INPUT like a footer is: deserialize must
+    either raise ParquetError or yield a plan whose serialize→deserialize
+    round-trip is byte-stable, whose cache key survives the trip (the
+    PlanCache's correctness invariant — a round-tripped plan must land on
+    the same cache slot), and whose memo/costing surfaces never crash on
+    arbitrary coordinates."""
+    from .scanplan import ScanPlan
+
+    try:
+        p = ScanPlan.deserialize(data)
+    except ParquetError:
+        return
+    blob = p.serialize()
+    q = ScanPlan.deserialize(blob)  # our own output must always readopt
+    assert q.cache_key() == p.cache_key(), "cache key broke round-trip"
+    assert q.serialize() == blob, "serialize not stable across round-trip"
+    # the replay surfaces a reader would hit — never a crash, any input
+    assert p.estimated_bytes() >= 0
+    p.selected_ordinals()
+    for rgp in p.row_groups[:8]:
+        p.pruning_hint(rgp.ordinal)
+        for c in rgp.chunks[:8]:
+            p.route_hint(rgp.ordinal, c.column)
+
+
+def crafted_scan_plan_blobs() -> "list[bytes]":
+    """Hand-crafted ``scan_plan`` inputs (and corpus blobs): truncated and
+    lying plans around a small valid one."""
+    from .scanplan import ChunkPlan, RowGroupPlan, ScanPlan
+
+    plan = ScanPlan(
+        file_key=("file", "/tmp/x.parquet", 4096, 1234567890),
+        columns=("a", "s"), filter_fp=None, rg_keep=[True, False],
+        row_groups=[
+            RowGroupPlan(0, 100, [ChunkPlan("a", 4, 800, 1600, 1, 100),
+                                  ChunkPlan("s", 804, 900, 2000, 1, 100)]),
+            RowGroupPlan(1, 50, [ChunkPlan("a", 1704, 400, 800, 1, 50)]),
+        ])
+    plan.note_route(0, "a", "device_snappy", "snappy_resolve")
+    plan.note_pruning(1, {("a",): {0, 2}}, 30)
+    good = plan.serialize()
+    lying_route = good.replace(b"device_snappy", b"warp_teleportx")
+    neg_offset = good.replace(b'"offset":4,', b'"offset":-4,')
+    dup_ordinal = good.replace(b'"ordinal":1}', b'"ordinal":0}')
+    # non-string family: must be the typed rejection, never a TypeError
+    # out of the frozenset membership test
+    bad_family = good.replace(b'"snappy_resolve"]', b"[1714]]")
+    assert (lying_route != good and neg_offset != good
+            and dup_ordinal != good and bad_family != good)
+    return [
+        good,
+        good[:17],                      # truncated mid-body
+        b"TPQX" + good[4:],             # bad magic
+        b"TPQP\xff" + good[5:],         # unknown version
+        lying_route,
+        neg_offset,
+        dup_ordinal,
+        bad_family,
+        b"TPQP\x01" + b'{"row_groups":"no"}',
+    ]
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -817,6 +883,7 @@ TARGETS = {
     "loader_state": fuzz_loader_state,
     "io_ranges": fuzz_io_ranges,
     "page_corrupt": fuzz_page_corrupt,
+    "scan_plan": fuzz_scan_plan,
 }
 
 
@@ -1014,6 +1081,8 @@ def _seed_inputs(target: str) -> list[bytes]:
         return crafted_io_range_blobs()
     if target == "page_corrupt":
         return crafted_page_corrupt_blobs()
+    if target == "scan_plan":
+        return crafted_scan_plan_blobs()
     if target == "loader_state":
         from .data import checkpoint as ck
 

@@ -244,6 +244,12 @@ class ByteStore:
     prefers_coalescing = False
     coalesce_gap = 0
     stats: "IOStats | None" = None
+    # object-identity token for read-through caches (serve.PlanCache):
+    # a stable name + generation marker for the REMOTE object this store
+    # reads (a URL + etag, a blob id + generation).  None = not cacheable
+    # across re-opened stores; together with ``size()`` it forms the cache
+    # key, so a changed object (new etag or new size) invalidates cleanly.
+    identity_token: "str | None" = None
 
     def read_range(self, offset: int, size: int,
                    deadline: "float | None" = None) -> bytes:
@@ -366,8 +372,13 @@ class GenericRangeStore(ByteStore):
 
     prefers_coalescing = True
 
-    def __init__(self, config: "IOConfig | None" = None, seed: int = 0):
+    def __init__(self, config: "IOConfig | None" = None, seed: int = 0,
+                 identity_token: "str | None" = None):
         self.config = config if config is not None else IOConfig.from_env()
+        # see ByteStore.identity_token: adapters pass the remote object's
+        # stable name + generation (URL + etag) so re-opened stores hit the
+        # serve-layer footer/plan caches instead of re-fetching
+        self.identity_token = identity_token
         self.coalesce_gap = self.config.coalesce_gap
         self.stats = IOStats()
         self._rng = random.Random(seed)
@@ -597,8 +608,12 @@ class FaultInjectingStore(GenericRangeStore):
     """
 
     def __init__(self, inner: ByteStore, spec: "FaultSpec | None" = None,
-                 config: "IOConfig | None" = None, seed: int = 0):
-        super().__init__(config=config, seed=seed)
+                 config: "IOConfig | None" = None, seed: int = 0,
+                 identity_token: "str | None" = None):
+        super().__init__(config=config, seed=seed,
+                         identity_token=(identity_token
+                                         if identity_token is not None
+                                         else inner.identity_token))
         self.inner = inner
         self.spec = spec if spec is not None else FaultSpec()
         self._attempts: dict[int, int] = {}  # offset -> attempts so far

@@ -56,7 +56,8 @@ _ENV_KEYS = (
     "TPQ_LINK_MBPS", "TPQ_FORCE_ROUTE", "TPQ_TRACE", "TPQ_SAMPLE_MS",
     "TPQ_DEVICE_SNAPPY", "TPQ_COMPILE_CACHE", "TPQ_FUSE_RG", "TPQ_PALLAS",
     "TPQ_DEFER_DICT_CHECK", "TPQ_DEVICE_MBPS", "TPQ_DEVICE_TIMING",
-    "TPQ_XPROF", "BENCH_SCALE", "BENCH_DEVICE_REPS",
+    "TPQ_XPROF", "TPQ_SERVE_CONCURRENCY", "TPQ_SERVE_QUEUE",
+    "TPQ_PLAN_CACHE_MB", "BENCH_SCALE", "BENCH_DEVICE_REPS",
     "BENCH_BASELINE_REPS", "BENCH_RESAMPLE", "BENCH_CONFIGS",
     "JAX_PLATFORMS",
 )

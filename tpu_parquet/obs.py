@@ -1138,6 +1138,11 @@ class Watchdog:
 _MERGE_MAXED = frozenset((
     "peak_in_flight_bytes", "window_peak_rows", "prefetch", "budget_bytes",
     "planner_link_mbps",
+    # serve section gauges: the cache footprint and the admission peak are
+    # point-in-time state of ONE shared object, not flows to sum (the
+    # names are serve-specific — a generic "bytes" here would max the
+    # device section's h2d byte FLOW)
+    "queue_depth_peak", "held_bytes", "capacity_bytes", "entries",
 ))
 # ratios/rates derived from the flows: summing them is meaningless (four
 # files' overlap_efficiency is not their sum) — the merge drops them and
@@ -1209,6 +1214,7 @@ class StatsRegistry:
         self._io: "dict | None" = None
         self._data_errors: "dict | None" = None
         self._device: "dict | None" = None
+        self._serve: "dict | None" = None
         self._alloc_peak = 0
         self._alloc_device_peak = 0
         self._hists: dict[str, LatencyHistogram] = {}
@@ -1287,6 +1293,20 @@ class StatsRegistry:
                 self._device = {}
             _merge_num_tree(self._device, d)
 
+    def add_serve(self, serve_stats) -> None:
+        """Fold a :class:`~tpu_parquet.serve.ServeStats` tree in (the
+        ``serve`` section: request/rejection counters, queue-wait and exec
+        second sums, and the plan-cache hit/miss/eviction counters — all
+        flows except the ``queue_depth_peak``/cache-gauge keys, which the
+        generic merge already treats per its rules).  Raw dicts accepted
+        for tests and cross-process merges."""
+        d = (serve_stats if isinstance(serve_stats, dict)
+             else serve_stats.as_dict())
+        with self._lock:
+            if self._serve is None:
+                self._serve = {}
+            _merge_num_tree(self._serve, d)
+
     def note_alloc_peak(self, tracker) -> None:
         """Record an :class:`~tpu_parquet.alloc.AllocTracker`'s high-water
         marks (host ``peak`` + device-bytes ``device_peak``; raw ints
@@ -1306,6 +1326,7 @@ class StatsRegistry:
             data_errors = (dict(other._data_errors)
                            if other._data_errors else None)
             device = dict(other._device) if other._device else None
+            serve = dict(other._serve) if other._serve else None
             peak = other._alloc_peak
             dev_peak = other._alloc_device_peak
             hists = dict(other._hists)
@@ -1313,7 +1334,7 @@ class StatsRegistry:
             for name, src in (("_pipeline", pipeline), ("_reader", reader),
                               ("_loader", loader), ("_io", io),
                               ("_data_errors", data_errors),
-                              ("_device", device)):
+                              ("_device", device), ("_serve", serve)):
                 if src is None:
                     continue
                 dst = getattr(self, name)
@@ -1333,7 +1354,7 @@ class StatsRegistry:
         for key, attr in (("pipeline", "_pipeline"), ("reader", "_reader"),
                           ("loader", "_loader"), ("io", "_io"),
                           ("data_errors", "_data_errors"),
-                          ("device", "_device")):
+                          ("device", "_device"), ("serve", "_serve")):
             src = tree.get(key)
             if src is None:
                 continue
@@ -1431,6 +1452,7 @@ class StatsRegistry:
                 "data_errors": (dict(self._data_errors)
                                 if self._data_errors else None),
                 "device": dict(self._device) if self._device else None,
+                "serve": dict(self._serve) if self._serve else None,
                 "alloc": {"peak_bytes": self._alloc_peak,
                           "device_peak_bytes": self._alloc_device_peak},
                 "histograms": {n: h.as_dict()
@@ -1594,6 +1616,7 @@ DOCTOR_VERDICTS = {
     "stall": "stall-bound",
     "device_resolve": "device-resolve-bound",
     "h2d": "h2d-bound",
+    "admission": "admission-bound",
 }
 # routes whose overall error_ratio leaves this band disagree with the cost
 # model enough that re-running with the recalibrated TPQ_LINK_MBPS is the
@@ -1643,6 +1666,8 @@ def doctor_registry(tree: dict) -> "dict | None":
         return None
     dev = tree.get("device")
     dev = dev if isinstance(dev, dict) else {}
+    serve = tree.get("serve")
+    serve = serve if isinstance(serve, dict) else {}
 
     def g(d, k):
         v = d.get(k)
@@ -1665,6 +1690,12 @@ def doctor_registry(tree: dict) -> "dict | None":
                                           + g(pipe, "finalize_seconds")),
         "h2d": g(dev.get("h2d") or {}, "device_seconds"),
         "stall": g(pipe, "stall_seconds"),
+        # the serve section's queue-wait sum: requests waiting for a worker
+        # slot.  Dominant queue-wait means the service is admission-bound —
+        # raise TPQ_SERVE_CONCURRENCY (or shed load earlier), the decode
+        # lanes are not the problem (records without a serve section carry
+        # a 0 here, so the verdict can never fire on old artifacts)
+        "admission": g(serve, "queue_wait_seconds"),
     }
     total = sum(lanes.values())
     if total <= 0:
@@ -1875,6 +1906,27 @@ def autopsy_dump(doc: dict) -> dict:
                 io_inflight = {"offset": s.get("inflight_offset"),
                                "size": s.get("inflight_size"),
                                "age_s": s.get("inflight_age_s")}
+    # the scan service's admission state at dump time (serve.ScanService
+    # registers itself as a flight source): the report names the OLDEST
+    # in-flight request — for a one-request wedge, that IS the stuck one
+    serve_state = None
+    sv = (doc.get("samples") or {}).get("serve")
+    if isinstance(sv, dict):
+        oldest = None
+        for rid, r in sorted((sv.get("requests") or {}).items()):
+            if isinstance(r, dict) and (
+                    oldest is None
+                    or float(r.get("age_s") or 0.0)
+                    > float(oldest[1].get("age_s") or 0.0)):
+                oldest = (rid, r)
+        serve_state = {
+            "queue_depth": sv.get("queue_depth"),
+            "in_flight": sv.get("in_flight"),
+            "stuck_request": ({"id": oldest[0],
+                               "path": oldest[1].get("path"),
+                               "age_s": oldest[1].get("age_s")}
+                              if oldest is not None else None),
+        }
     # the rule table, most specific first.  Data corruption never hangs —
     # an explicit data-integrity error (or quarantined failures on a crash
     # dump) outranks every stall inference.
@@ -1940,6 +1992,7 @@ def autopsy_dump(doc: dict) -> dict:
         "budget": {"waiters": waiters,
                    "longest_wait_s": round(longest, 3)} if budgets else None,
         "io": io_inflight,
+        "serve": serve_state,
         "data_errors": ({"errors": q_errors, "first": q_first}
                         if q_errors or data_error else None),
         "error": doc.get("error"),

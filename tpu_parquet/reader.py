@@ -27,12 +27,6 @@ from .pipeline import PipelineStats, SharedReader, prefetch_map
 from .schema.core import Schema, SchemaNode
 
 
-def _as_path_tuple(col: Union[str, Sequence[str]]) -> tuple[str, ...]:
-    if isinstance(col, str):
-        return tuple(col.split("."))
-    return tuple(col)
-
-
 class _ChunkFailed:
     """In-band marker for a quarantined chunk riding the ordered prefetch
     stream (the stream must keep flowing — a raise would kill the pool).
@@ -78,6 +72,8 @@ class FileReader:
         store=None,
         on_data_error=None,
         quarantine=None,
+        plan=None,
+        dict_cache=None,
     ):
         from .obs import resolve_tracer
         from .quarantine import Quarantine, resolve_validate
@@ -131,13 +127,37 @@ class FileReader:
             # whose footer stats prove the predicate can never match are
             # skipped by the iteration APIs — their bytes are never read
             self.row_filter = row_filter
-            if row_filter is not None:
-                from .predicate import prune_row_groups
+            # decoded-dictionary read-through cache (serve.BoundDictCache
+            # duck type); threaded into every ChunkDecoder below
+            self._dict_cache = dict_cache
+            from .scanplan import build_scan_plan, predicate_fingerprint
 
-                self._rg_keep = prune_row_groups(self.metadata, self.schema,
-                                                 row_filter)
+            fp = predicate_fingerprint(row_filter)
+            cols_sig = tuple(sorted(
+                ".".join(l.path) for l in self.schema.selected_leaves()))
+            fp_match = ((row_filter is None and plan is not None
+                         and plan.filter_fp is None)
+                        or (fp is not None and plan is not None
+                            and plan.filter_fp == fp))
+            if plan is not None and fp_match and plan.columns == cols_sig:
+                # replay a cached ScanPlan (scanplan.py): the group-pruning
+                # verdict is adopted, never recomputed; a plan whose
+                # projection or filter doesn't match falls through to a
+                # fresh build rather than a wrong replay
+                self._plan = plan
+                self._rg_keep = (list(plan.rg_keep)
+                                 if plan.rg_keep is not None else None)
             else:
-                self._rg_keep = None
+                if row_filter is not None:
+                    from .predicate import prune_row_groups
+
+                    self._rg_keep = prune_row_groups(
+                        self.metadata, self.schema, row_filter)
+                else:
+                    self._rg_keep = None
+                self._plan = build_scan_plan(
+                    self.metadata, self.schema, row_filter=row_filter,
+                    filter_fp=fp, rg_keep=self._rg_keep)
         except BaseException:
             # a constructor failure (bad footer, bad projection, bad filter)
             # must not leak the fd this reader opened
@@ -152,18 +172,20 @@ class FileReader:
         Validates BEFORE applying: a failed call leaves the selection as it
         was (an applied-then-raised empty selection would make later reads
         silently return {})."""
-        if columns is None:
-            self.schema.set_selected(None)
-        else:
-            paths = [_as_path_tuple(c) for c in columns]
-            if not self.schema.selection_matches(paths):
-                known = [".".join(l.path) for l in self.schema.leaves]
-                raise ParquetError(
-                    f"selected columns {['.'.join(p) for p in paths]} "
-                    f"match no schema columns; available: {known}"
-                )
-            self.schema.set_selected(paths)
+        from .scanplan import apply_selection
+
+        apply_selection(self.schema, columns)
         self._preloaded = None
+        # the plan IR is projection-scoped: re-projecting rebuilds it (a
+        # cheap footer walk) so its chunk slices and byte estimates always
+        # describe the CURRENT selection.  During __init__ the first plan
+        # has not been built yet — the constructor builds it right after.
+        if hasattr(self, "_plan"):
+            from .scanplan import build_scan_plan
+
+            self._plan = build_scan_plan(self.metadata, self.schema,
+                                         row_filter=self.row_filter,
+                                         rg_keep=self._rg_keep)
 
     def row_group_selected(self, index: int) -> bool:
         """False when ``row_filter`` proves row group ``index`` cannot match."""
@@ -347,7 +369,8 @@ class FileReader:
                 with stats.timed("decompress"):
                     dec = ChunkDecoder(leaf, validate_crc=self.validate_crc,
                                        alloc=alloc,
-                                       context={**ctx, "chunk_offset": offset})
+                                       context={**ctx, "chunk_offset": offset},
+                                       dict_cache=self._dict_cache)
                     cd = dec.decode(buf, md.codec, md.num_values)
             except ParquetError as e:
                 # containment seam (quarantine.py): under a skip policy the
@@ -435,18 +458,16 @@ class FileReader:
         # watchdog abort from a previous scan never poisons this one.
         self._store.begin_scan()
         f = self._sr.as_file()
-        for chunk in rg.columns or []:
-            md = chunk.meta_data
-            if md is None or md.path_in_schema is None:
-                raise ParquetError("column chunk missing metadata/path")
-            path = tuple(md.path_in_schema)
-            leaf = by_path.get(path)
-            if leaf is None:
-                continue  # unselected: never read its bytes (skipChunk parity)
+        # the one shared footer walk (scanplan.py): unselected chunks'
+        # bytes are never read (skipChunk parity)
+        from .scanplan import row_group_chunks
+
+        for path, leaf, chunk, md, offset in row_group_chunks(rg, by_path):
             out[".".join(path)] = read_chunk(
                 f, chunk, leaf,
                 validate_crc=self.validate_crc, alloc=self.alloc,
                 context={"file": self._source_name, "row_group": index},
+                dict_cache=self._dict_cache, meta=(md, offset),
             )
         missing = set(".".join(p) for p in by_path) - set(out)
         if missing:

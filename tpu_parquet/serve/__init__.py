@@ -1,0 +1,23 @@
+"""tpu_parquet.serve — the high-QPS concurrent scan service.
+
+Many concurrent callers submit scan requests (file set + projection +
+predicate) to one :class:`ScanService`; requests execute over SHARED state —
+a bounded read-through :class:`PlanCache` of parsed footers, ScanPlan IR
+objects (:mod:`tpu_parquet.scanplan`), and decoded dictionary pages — behind
+admission control (bounded queue + :class:`~tpu_parquet.alloc
+.InFlightBudget`; a full queue fast-rejects with
+:class:`~tpu_parquet.errors.OverloadError`), with per-request p50/p95
+latency SLOs in the registry ``serve`` section.
+
+See README "Serving concurrent scans"; ``pq_tool serve-stats`` prints a
+run's SLO table, and ``pq_tool doctor`` reads ``admission-bound`` when
+queue-wait dominates.
+"""
+
+from .cache import BoundDictCache, CacheStats, PlanCache
+from .service import ScanRequest, ScanService, ScanTicket, ServeStats
+
+__all__ = [
+    "BoundDictCache", "CacheStats", "PlanCache",
+    "ScanRequest", "ScanService", "ScanTicket", "ServeStats",
+]

@@ -1,0 +1,381 @@
+"""PlanCache: the shared read-through state a high-QPS scan service runs on.
+
+Every one-shot open pays three metadata costs before the first data byte:
+the footer thrift parse, the ScanPlan construction (group pruning + the
+footer walk into chunk byte ranges), and — for dictionary-encoded columns —
+the dictionary-page decompress + decode, per chunk, per scan.  Under
+concurrent traffic over a bounded working set those costs repeat millions of
+times for identical inputs.  This module holds all three behind ONE bounded
+LRU keyed by file *generation*:
+
+- **footers** (parsed ``FileMetaData`` + a ``Schema``) keyed by
+  ``(path, size, mtime_ns)`` for local files, or ``(identity_token, size)``
+  for :class:`~tpu_parquet.iostore.ByteStore`-backed objects — the
+  read-through footer cache ROADMAP item 4 owed for re-opened
+  ``GenericRangeStore`` objects.  A changed file changes its key, so stale
+  entries can never be served (and the previous generation is dropped
+  eagerly — ``invalidations`` counts them);
+- **ScanPlans** (:mod:`tpu_parquet.scanplan`) keyed by
+  ``(file key, projection, filter fingerprint)`` — replayed, not rebuilt,
+  so the route memo and pruning memo accumulate across requests;
+- **decoded dictionaries** keyed by ``(file key, row group, column,
+  decode kind)`` — shared read-only with every decoder
+  (:class:`BoundDictCache` is the per-file adapter the readers duck-call).
+
+Bounded: total cached bytes are capped (``TPQ_PLAN_CACHE_MB``, default 256)
+with LRU eviction; ``hits``/``misses``/``evictions`` counters per kind ride
+the registry ``serve`` section and the flight dumps.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+from ..footer import read_file_metadata
+from ..iostore import ByteStore
+from ..obs import env_int
+
+__all__ = ["PlanCache", "BoundDictCache", "CacheStats"]
+
+_KINDS = ("footer", "plan", "dict")
+
+
+class CacheStats:
+    """Per-kind hit/miss/eviction counters (thread-safe via the owning
+    cache's lock; this object only aggregates)."""
+
+    __slots__ = ("hits", "misses", "evictions", "invalidations")
+
+    def __init__(self):
+        self.hits = {k: 0 for k in _KINDS}
+        self.misses = {k: 0 for k in _KINDS}
+        self.evictions = 0
+        self.invalidations = 0
+
+    def as_dict(self) -> dict:
+        return {
+            **{f"{k}_hits": self.hits[k] for k in _KINDS},
+            **{f"{k}_misses": self.misses[k] for k in _KINDS},
+            "evictions": self.evictions,
+            "invalidations": self.invalidations,
+        }
+
+
+class PlanCache:
+    """Bounded read-through cache over footers, ScanPlans, and decoded
+    dictionaries.  Thread-safe; one instance is shared by every worker of a
+    :class:`~tpu_parquet.serve.ScanService` (or passed to ``scan_files``
+    via ``plan_cache=``)."""
+
+    def __init__(self, max_bytes: "int | None" = None):
+        if max_bytes is None:
+            max_bytes = env_int("TPQ_PLAN_CACHE_MB", 256, lo=1) << 20
+        self.max_bytes = int(max_bytes)
+        self.stats = CacheStats()
+        self._lock = threading.Lock()
+        # full key -> (value, nbytes); insertion order = recency (LRU)
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._bytes = 0
+        # file identity -> current generation key: a re-opened file whose
+        # generation moved drops the stale entries eagerly instead of
+        # letting them age out of the LRU
+        self._gen: dict = {}
+        # single-flight build locks: N concurrent first-touches of one key
+        # build ONCE (the "footer parsed exactly once per file" acceptance
+        # is a guarantee, not a race outcome); late arrivals count as hits
+        self._building: dict = {}
+
+    # -- identity --------------------------------------------------------------
+
+    @staticmethod
+    def file_key(source, store: "ByteStore | None" = None):
+        """The file-generation cache key, or None when the source has no
+        stable identity (an anonymous stream: never cached, never stale).
+
+        Local paths key by ``(abspath, size, mtime_ns)``; stores by their
+        ``identity_token`` + ``size()`` (the satellite contract: a changed
+        object — new token or new size — invalidates cleanly)."""
+        if store is not None and isinstance(store, ByteStore):
+            tok = store.identity_token
+            if tok is None:
+                return None
+            return ("store", tok, int(store.size()))
+        if isinstance(source, (str, os.PathLike)):
+            path = os.path.abspath(os.fspath(source))
+            try:
+                st = os.stat(path)
+            except OSError:
+                return None
+            return ("file", path, int(st.st_size), int(st.st_mtime_ns))
+        return None
+
+    # -- the one LRU -----------------------------------------------------------
+
+    def _get(self, kind: str, key: tuple):
+        with self._lock:
+            full = (kind, *key)
+            hit = self._entries.get(full)
+            if hit is not None:
+                self._entries.move_to_end(full)
+                self.stats.hits[kind] += 1
+                return hit[0]
+            self.stats.misses[kind] += 1
+            return None
+
+    def _read_through(self, kind: str, key: tuple, build):
+        """Get-or-build with single-flight semantics: exactly one builder
+        per key runs (one miss counted); concurrent callers wait on the
+        build lock and count as hits.  ``build()`` returns
+        ``(value, nbytes)``."""
+        full = (kind, *key)
+        with self._lock:
+            hit = self._entries.get(full)
+            if hit is not None:
+                self._entries.move_to_end(full)
+                self.stats.hits[kind] += 1
+                return hit[0]
+            lock = self._building.get(full)
+            if lock is None:
+                lock = self._building[full] = threading.Lock()
+        with lock:
+            with self._lock:
+                hit = self._entries.get(full)
+                if hit is not None:
+                    self._entries.move_to_end(full)
+                    self.stats.hits[kind] += 1
+                    return hit[0]
+                self.stats.misses[kind] += 1
+            try:
+                value, nbytes = build()
+                # publish BEFORE dropping the build lock's registration: a
+                # thread arriving after the pop must find the entry (pop
+                # first and it would rebuild — a second counted miss and a
+                # second plan object whose memos no longer accumulate)
+                self._put(kind, key, value, nbytes)
+            finally:
+                with self._lock:
+                    self._building.pop(full, None)
+            return value
+
+    def _put(self, kind: str, key: tuple, value, nbytes: int) -> None:
+        with self._lock:
+            full = (kind, *key)
+            old = self._entries.pop(full, None)
+            if old is not None:
+                self._bytes -= old[1]
+            nbytes = max(int(nbytes), 1)
+            self._entries[full] = (value, nbytes)
+            self._bytes += nbytes
+            # generation bookkeeping: a new generation of the same file
+            # drops the PREVIOUS generation's entries in full (footer/plan/
+            # dict alike) — they can never be served again, so aging them
+            # out of the LRU is pure waste.  A file key is ("file", path,
+            # size, mtime_ns) or ("store", token, size); identity = kind +
+            # name, generation = the full tuple.
+            fk = key[0]
+            if isinstance(fk, tuple) and len(fk) >= 2:
+                ident = fk[:2]
+                prev = self._gen.get(ident)
+                if prev is not None and prev != fk:
+                    stale = [f for f in self._entries
+                             if isinstance(f[1], tuple)
+                             and f[1][:2] == ident and f[1] != fk]
+                    for f in stale:
+                        _v, n = self._entries.pop(f)
+                        self._bytes -= n
+                        self.stats.invalidations += 1
+                self._gen[ident] = fk
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _f, (_v, n) = self._entries.popitem(last=False)
+                self._bytes -= n
+                self.stats.evictions += 1
+
+    # -- footers ---------------------------------------------------------------
+
+    def footer(self, source, store: "ByteStore | None" = None):
+        """Read-through footer: ``(FileMetaData, Schema)`` for a path or a
+        ByteStore-backed object.  Un-keyable sources load fresh every time
+        (counted as misses) — correct, just uncached."""
+        from ..schema.core import Schema
+
+        def build():
+            if store is not None and isinstance(store, ByteStore):
+                meta = read_file_metadata(_StoreFile(store),
+                                          validate_head_magic=False)
+                nbytes = _footer_len(store=store)
+            else:
+                meta = read_file_metadata(source)
+                nbytes = _footer_len(path=source)
+            return (meta, Schema.from_file_metadata(meta)), nbytes + 4096
+
+        key = self.file_key(source, store)
+        if key is None:
+            with self._lock:
+                self.stats.misses["footer"] += 1
+            return build()[0]
+        return self._read_through("footer", (key,), build)
+
+    # -- plans -----------------------------------------------------------------
+
+    def plan(self, key, columns, row_filter, meta=None, schema=None,
+             source=None, store=None):
+        """Read-through ScanPlan for ``(file key, projection, filter)``.
+
+        ``meta``/``schema`` may be passed when the caller already holds the
+        footer; otherwise they read through :meth:`footer`.  Returns the
+        SHARED plan object — its route/pruning memos accumulate across every
+        consumer, which is the point."""
+        from ..scanplan import build_scan_plan, predicate_fingerprint
+
+        fp = predicate_fingerprint(row_filter)
+        cols_sig = _columns_sig(columns)
+
+        def build():
+            m, s = ((meta, schema) if meta is not None and schema is not None
+                    else self.footer(source, store))
+            sel = _selected_schema(s, columns)
+            plan = build_scan_plan(m, sel, file_key=key,
+                                   row_filter=row_filter, filter_fp=fp)
+            return plan, plan.nbytes()
+
+        cacheable = key is not None and (row_filter is None or fp is not None)
+        if not cacheable:
+            with self._lock:
+                self.stats.misses["plan"] += 1
+            return build()[0]
+        return self._read_through("plan", (key, cols_sig, fp), build)
+
+    # -- decoded dictionaries --------------------------------------------------
+
+    def dict_get(self, key, rg, column, kind):
+        if key is None:
+            return None
+        return self._get("dict", (key, int(rg), column, kind))
+
+    def dict_put(self, key, rg, column, kind, value, nbytes) -> None:
+        if key is None:
+            return
+        self._put("dict", (key, int(rg), column, kind), value, nbytes)
+
+    # -- reader integration ----------------------------------------------------
+
+    def reader_kwargs(self, source, columns=None, row_filter=None,
+                      store: "ByteStore | None" = None) -> dict:
+        """The ``metadata=``/``plan=``/``dict_cache=`` kwargs that make a
+        ``FileReader``/``DeviceFileReader`` (or ``scan_files``) run over
+        this cache's shared state."""
+        key = self.file_key(source, store)
+        meta, schema = self.footer(source, store)
+        plan = self.plan(key, columns, row_filter, meta=meta, schema=schema)
+        return {"metadata": meta, "plan": plan,
+                "dict_cache": BoundDictCache(self, key)}
+
+    # -- reporting -------------------------------------------------------------
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                **self.stats.as_dict(),
+                "held_bytes": self._bytes,
+                "capacity_bytes": self.max_bytes,
+                "entries": len(self._entries),
+            }
+
+    # flight-source sample (obs.register_flight_source duck type)
+    sample = counters
+
+
+class BoundDictCache:
+    """A :class:`PlanCache` bound to one file generation — the adapter the
+    chunk decoders duck-call (``get(rg, column, kind)`` /
+    ``put(rg, column, kind, value, nbytes)``).  ``kind`` separates the two
+    decode shapes ("host": plain-decoded arrays, "dev": the device
+    assembler's value-table entry)."""
+
+    __slots__ = ("cache", "key")
+
+    def __init__(self, cache: PlanCache, key):
+        self.cache = cache
+        self.key = key
+
+    def get(self, rg, column, kind):
+        return self.cache.dict_get(self.key, rg, column, kind)
+
+    def put(self, rg, column, kind, value, nbytes) -> None:
+        self.cache.dict_put(self.key, rg, column, kind, value, nbytes)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+class _StoreFile:
+    """Minimal seek/read file view over a ByteStore (whence-aware, which
+    the SharedReader pread view deliberately is not) — enough for
+    :func:`~tpu_parquet.footer.read_file_metadata`."""
+
+    __slots__ = ("_s", "_pos")
+
+    def __init__(self, store: ByteStore):
+        self._s = store
+        self._pos = 0
+
+    def seek(self, pos: int, whence: int = 0) -> int:
+        if whence == os.SEEK_END:
+            self._pos = self._s.size() + pos
+        elif whence == os.SEEK_CUR:
+            self._pos += pos
+        else:
+            self._pos = pos
+        return self._pos
+
+    def read(self, size: int = -1) -> bytes:
+        if size < 0:
+            size = max(self._s.size() - self._pos, 0)
+        b = self._s.read_range(self._pos, size)
+        self._pos += len(b)
+        return b
+
+
+def _footer_len(path=None, store: "ByteStore | None" = None) -> int:
+    """The footer's thrift length (cache accounting): read from the 8-byte
+    tail; 0 on any failure (accounting only, never correctness)."""
+    import struct
+
+    try:
+        if store is not None:
+            size = store.size()
+            tail = store.read_range(size - 8, 8)
+        else:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(size - 8)
+                tail = f.read(8)
+        return struct.unpack("<I", tail[:4])[0]
+    except Exception:  # noqa: BLE001 — accounting only
+        return 0
+
+
+def _columns_sig(columns) -> "tuple | None":
+    if columns is None:
+        return None
+    out = []
+    for c in columns:
+        out.append(c if isinstance(c, str) else ".".join(c))
+    return tuple(sorted(out))
+
+
+def _selected_schema(schema, columns):
+    """A fresh Schema with ``columns`` applied (the shared cached Schema is
+    never mutated — selection is per-consumer state)."""
+    if columns is None:
+        return schema
+    import copy
+
+    from ..scanplan import apply_selection
+
+    sel = copy.deepcopy(schema)
+    apply_selection(sel, columns)
+    return sel

@@ -1,0 +1,420 @@
+"""ScanService: many concurrent scan requests over one shared plan cache.
+
+The north star is heavy traffic from many users, and the one-shot readers
+are the wrong shape for it: every request re-parses, re-plans, and fights
+every other request for memory with no arbitration.  This service puts a
+bounded admission pipeline in front of the same readers:
+
+    submit() ──bounded queue──► worker pool ──InFlightBudget──► reader
+       │                           │
+       └─ queue full: OverloadError (fast-reject, never a blocked caller)
+                                   └─ per-request p50/p95 latency SLOs
+
+- **Shared state**: one :class:`~tpu_parquet.serve.PlanCache` — footers,
+  ScanPlan IR (route + pruning memos), and decoded dictionaries read
+  through it, so concurrent requests over a working set parse each file's
+  metadata once (cache counters prove it in tests).
+- **Admission control**: a bounded request queue (``TPQ_SERVE_QUEUE``) +
+  ``TPQ_SERVE_CONCURRENCY`` workers; each admitted request charges its
+  plan's :meth:`~tpu_parquet.scanplan.ScanPlan.estimated_bytes` against one
+  shared :class:`~tpu_parquet.alloc.InFlightBudget` (``max_memory``) before
+  reading a byte — backpressure between requests, OverloadError at the
+  door.
+- **SLOs**: per-request queue-wait and execution latencies land in
+  :class:`~tpu_parquet.obs.LatencyHistogram`\\ s under the registry
+  ``serve`` section (``pq_tool serve-stats`` prints the table;
+  ``pq_tool doctor`` says ``admission-bound`` when queue-wait dominates).
+- **Hang containment**: with ``hang_s`` (or ``TPQ_HANG_S``) each executing
+  request is watched by its own :class:`~tpu_parquet.obs.Watchdog`; a
+  stalled store fetch dumps flight state (the dump's ``serve`` sample
+  names the stuck request) and aborts THAT request with
+  :class:`~tpu_parquet.errors.HangError` — the other clients never notice.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+
+from ..alloc import InFlightBudget
+from ..errors import OverloadError
+from ..obs import (LatencyHistogram, env_int, register_flight_source,
+                   resolve_hang_s)
+from .cache import BoundDictCache, PlanCache
+
+__all__ = ["ScanRequest", "ScanService", "ScanTicket", "ServeStats"]
+
+_req_ids = itertools.count(1)
+
+
+def _count_rows(result: dict) -> int:
+    """Best-effort served-row accounting over a response tree ({path:
+    {column: ColumnData | DeviceColumnData | [per-row-group parts]}}).
+    Accounting only — it must never be able to fail a completed request."""
+    rows = 0
+    for cols in result.values():
+        if not cols:
+            continue
+        first = next(iter(cols.values()))
+        parts = first if isinstance(first, list) else [first]
+        rows += sum(int(getattr(p, "num_leaf_slots", 0) or 0)
+                    for p in parts)
+    return rows
+
+
+class ScanRequest:
+    """One scan: a file set + projection + predicate + response shape.
+
+    ``paths``: the files (str/PathLike), scanned in order.  ``columns``:
+    projection (None = all).  ``filter``: a :mod:`~tpu_parquet.predicate`
+    Predicate or its text form (``parse_filter`` grammar); yielded rows are
+    the readers' usual superset contract.  ``prefetch``: per-file chunk
+    pipeline depth.  ``device=True`` decodes to device arrays through
+    ``DeviceFileReader`` (host ``FileReader`` otherwise — the fixed shape
+    of a batched response is the loader's job; this service returns the
+    reader's columnar output per file).
+    """
+
+    __slots__ = ("paths", "columns", "filter", "prefetch", "device",
+                 "validate_crc")
+
+    def __init__(self, paths, columns=None, filter=None,  # noqa: A002
+                 prefetch: int = 0, device: bool = False,
+                 validate_crc=None):
+        import os
+
+        self.paths = ([paths] if isinstance(paths, (str, bytes, os.PathLike))
+                      else list(paths))
+        self.columns = columns
+        self.filter = filter
+        self.prefetch = int(prefetch)
+        self.device = bool(device)
+        self.validate_crc = validate_crc
+
+
+class ScanTicket:
+    """The admission receipt: ``result(timeout)`` blocks for the response
+    (re-raising the request's failure), ``done()`` polls."""
+
+    __slots__ = ("id", "_event", "_result", "_exc", "queue_wait_s",
+                 "exec_s")
+
+    def __init__(self, rid: int):
+        self.id = rid
+        self._event = threading.Event()
+        self._result = None
+        self._exc: "BaseException | None" = None
+        self.queue_wait_s = 0.0
+        self.exec_s = 0.0
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: "float | None" = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"scan request #{self.id} still running")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def _finish(self, result=None, exc: "BaseException | None" = None):
+        self._result = result
+        self._exc = exc
+        self._event.set()
+
+
+class ServeStats:
+    """Service counters (all flows except the gauges; composes by addition
+    in the registry ``serve`` section)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.failed = 0
+        self.queue_wait_seconds = 0.0
+        self.exec_seconds = 0.0
+        self.rows = 0
+        self.queue_depth_peak = 0
+
+    def as_dict(self) -> dict:
+        with self.lock:
+            return {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "failed": self.failed,
+                "queue_wait_seconds": round(self.queue_wait_seconds, 6),
+                "exec_seconds": round(self.exec_seconds, 6),
+                "rows": self.rows,
+                "queue_depth_peak": self.queue_depth_peak,
+            }
+
+
+class ScanService:
+    """The concurrent scan front end.  Construct once, ``submit()`` from
+    any thread, ``close()`` when done (context manager supported)."""
+
+    def __init__(self, concurrency: "int | None" = None,
+                 queue_depth: "int | None" = None, max_memory: int = 0,
+                 cache: "PlanCache | None" = None, store=None,
+                 hang_s=None, validate_crc=None):
+        if concurrency is None:
+            concurrency = env_int("TPQ_SERVE_CONCURRENCY", 4, lo=1)
+        if queue_depth is None:
+            queue_depth = env_int("TPQ_SERVE_QUEUE", 2 * concurrency, lo=1)
+        self.concurrency = int(concurrency)
+        self.cache = cache if cache is not None else PlanCache()
+        self.stats = ServeStats()
+        self._store = store  # per-file ByteStore factory (iostore contract)
+        self._hang_s = hang_s
+        self._validate_crc = validate_crc
+        # admission: bounded queue (fast-reject) + shared memory budget
+        # (backpressure between ADMITTED requests, charged from the plan
+        # IR's byte estimate before any byte is read)
+        self._q: "queue.Queue" = queue.Queue(maxsize=int(queue_depth))
+        self._budget = InFlightBudget(int(max_memory))
+        self._hist_wait = LatencyHistogram()
+        self._hist_exec = LatencyHistogram()
+        self._hist_total = LatencyHistogram()
+        self._inflight: dict = {}  # rid -> (path0, t_start)
+        self._inflight_lock = threading.Lock()
+        self._closed = False
+        # serializes the closed-check+enqueue in submit() against close()'s
+        # drain+sentinels: without it a racing submit can land its item
+        # BEHIND the shutdown sentinels — never processed, never finished,
+        # a caller blocked in result() forever
+        self._submit_lock = threading.Lock()
+        self._workers = [
+            threading.Thread(target=self._worker, name=f"tpq-serve-{i}",
+                             daemon=True)
+            for i in range(self.concurrency)
+        ]
+        for t in self._workers:
+            t.start()
+        # a wedged process's flight dump must name the stuck request —
+        # autopsy prints this sample's oldest in-flight entry
+        register_flight_source("serve", self, "sample")
+
+    # -- submission ------------------------------------------------------------
+
+    def submit(self, request: ScanRequest) -> ScanTicket:
+        """Admit one request; raises :class:`OverloadError` IMMEDIATELY
+        when the queue is full (load shedding, never a blocked caller)."""
+        ticket = ScanTicket(next(_req_ids))
+        try:
+            with self._submit_lock:
+                if self._closed:
+                    raise RuntimeError("ScanService is closed")
+                self._q.put_nowait((ticket, request, time.perf_counter()))
+        except queue.Full:
+            with self.stats.lock:
+                self.stats.rejected += 1
+                inflight = len(self._inflight)
+            raise OverloadError(
+                f"scan service overloaded: queue full "
+                f"({self._q.maxsize} queued, {inflight} in flight)",
+                queue_depth=self._q.maxsize, in_flight=inflight) from None
+        with self.stats.lock:
+            self.stats.submitted += 1
+            self.stats.queue_depth_peak = max(self.stats.queue_depth_peak,
+                                              self._q.qsize())
+        return ticket
+
+    def scan(self, request: ScanRequest, timeout: "float | None" = None):
+        """Submit + wait: the one-call form."""
+        return self.submit(request).result(timeout)
+
+    # -- workers ---------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            ticket, request, t_submit = item
+            t_start = time.perf_counter()
+            wait = t_start - t_submit
+            ticket.queue_wait_s = wait
+            self._hist_wait.record(wait)
+            first = request.paths[0] if request.paths else None
+            with self._inflight_lock:
+                self._inflight[ticket.id] = (str(first), t_start)
+            try:
+                result, exc = self._execute(request), None
+            except BaseException as e:  # noqa: BLE001 — delivered to caller
+                result, exc = None, e
+            # ALL bookkeeping lands before _finish sets the ticket's event:
+            # a caller waking from result() must read final exec_s/stats,
+            # never a zero the worker hadn't written yet
+            t_end = time.perf_counter()
+            ticket.exec_s = t_end - t_start
+            self._hist_exec.record(ticket.exec_s)
+            self._hist_total.record(t_end - t_submit)
+            with self._inflight_lock:
+                self._inflight.pop(ticket.id, None)
+            with self.stats.lock:
+                self.stats.queue_wait_seconds += wait
+                self.stats.exec_seconds += ticket.exec_s
+                if exc is not None:
+                    self.stats.failed += 1
+                else:
+                    self.stats.completed += 1
+                    self.stats.rows += _count_rows(result)
+            if exc is not None:
+                ticket._finish(exc=exc)
+            else:
+                ticket._finish(result=result)
+
+    def _resolve_filter(self, request: ScanRequest):
+        flt = request.filter
+        if isinstance(flt, str):
+            from ..predicate import parse_filter
+
+            return parse_filter(flt)
+        return flt
+
+    def _execute(self, request: ScanRequest) -> dict:
+        """Run one request over the shared cache: per file, read the
+        footer/plan through it, charge the plan's byte estimate against
+        the admission budget, then scan with a plan-replaying reader.
+        Returns ``{path: {column: ColumnData}}`` in request order."""
+        from ..reader import FileReader
+
+        pred = self._resolve_filter(request)
+        out: dict = {}
+        for path in request.paths:
+            key = self.cache.file_key(path)
+            meta, schema = self.cache.footer(path)
+            plan = self.cache.plan(key, request.columns, pred,
+                                   meta=meta, schema=schema)
+            charge = min(plan.estimated_bytes(),
+                         max(self._budget.max_bytes, 0)) \
+                if self._budget.max_bytes > 0 else 0
+            if charge:
+                self._budget.acquire(charge)
+            try:
+                kw = dict(columns=request.columns, metadata=meta,
+                          row_filter=pred, prefetch=request.prefetch,
+                          validate_crc=(request.validate_crc
+                                        if request.validate_crc is not None
+                                        else self._validate_crc),
+                          store=self._store, plan=plan,
+                          dict_cache=BoundDictCache(self.cache, key))
+                if request.device:
+                    from ..device_reader import DeviceFileReader
+
+                    with DeviceFileReader(path, hang_s=self._hang_s,
+                                          **kw) as r:
+                        cols: dict = {}
+                        for group in r.iter_row_groups():
+                            for name, cd in group.items():
+                                cols.setdefault(name, []).append(cd)
+                        out[str(path)] = {
+                            name: parts[0] if len(parts) == 1 else parts
+                            for name, parts in cols.items()}
+                else:
+                    with FileReader(path, **kw) as r:
+                        out[str(path)] = self._read_watched(r)
+            finally:
+                if charge:
+                    self._budget.release(charge)
+        return out
+
+    def _read_watched(self, r) -> dict:
+        """``read_all`` under a per-request watchdog: a stalled store fetch
+        (the transport wedge) dumps flight state and aborts THIS request
+        with HangError while every other worker keeps serving.  Mirrors
+        DeviceFileReader's own watchdog wiring — the host FileReader has
+        none of its own."""
+        from ..obs import Watchdog
+
+        wd = Watchdog(resolve_hang_s(self._hang_s))
+        if not wd.enabled or r._store.stats is None:
+            # a plain local store cannot stall (os.pread either returns or
+            # errors), and its counters don't tick on the sequential path —
+            # arming the dog there would misread a long clean read as a
+            # wedge.  Stall containment is for instrumented range stores.
+            return r.read_all()
+        wd.watch("pipeline", lambda: r._pipe_stats.sample())
+        wd.watch("iostore", r._store.stats.progress)
+        wd.add_abort_hook(r._store.abort)
+        wd.start()
+        try:
+            out = r.read_all()
+            wd.check()  # surface a fired raise-policy HangError
+            return out
+        finally:
+            wd.stop()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def close(self) -> None:
+        """Drain-free shutdown: queued-but-unstarted requests fail with
+        OverloadError; executing requests finish."""
+        with self._submit_lock:
+            if self._closed:
+                return
+            self._closed = True
+        drained = []
+        try:
+            while True:
+                drained.append(self._q.get_nowait())
+        except queue.Empty:
+            pass
+        for item in drained:
+            if item is not None:
+                with self.stats.lock:
+                    # accounted as rejections so the serve section always
+                    # reconciles: submitted == completed + failed + rejected
+                    self.stats.rejected += 1
+                item[0]._finish(exc=OverloadError(
+                    "scan service closed before this request started"))
+        for _ in self._workers:
+            self._q.put(None)
+        for t in self._workers:
+            t.join(timeout=60)
+
+    def __enter__(self) -> "ScanService":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- reporting -------------------------------------------------------------
+
+    def sample(self) -> dict:
+        """Live admission state (flight dumps + obs.Sampler track): queue
+        depth, in-flight requests with ages, and the cache counters."""
+        now = time.perf_counter()
+        with self._inflight_lock:
+            inflight = {str(rid): {"path": p, "age_s": round(now - t0, 6)}
+                        for rid, (p, t0) in self._inflight.items()}
+        oldest = max((v["age_s"] for v in inflight.values()), default=0.0)
+        return {
+            "queue_depth": self._q.qsize(),
+            "in_flight": len(inflight),
+            "oldest_request_s": oldest,
+            "requests": inflight,
+            "cache": self.cache.counters(),
+        }
+
+    def serve_stats(self) -> dict:
+        """The registry ``serve`` section: counters + cache counters."""
+        return {**self.stats.as_dict(), "cache": self.cache.counters()}
+
+    def obs_registry(self):
+        """Unified metrics tree: the ``serve`` section plus the request
+        latency histograms (``serve.queue_wait`` / ``serve.exec`` /
+        ``serve.request`` — the p50/p95 SLO surface)."""
+        from ..obs import StatsRegistry
+
+        reg = StatsRegistry()
+        reg.add_serve(self.serve_stats())
+        reg.histogram("serve.queue_wait").merge_from(self._hist_wait)
+        reg.histogram("serve.exec").merge_from(self._hist_exec)
+        reg.histogram("serve.request").merge_from(self._hist_total)
+        return reg
