@@ -949,12 +949,15 @@ def bench_serve(path, rows, clients_sweep=(1, 4, 16)):
                            for k in ("footer", "plan", "dict"))
         hist = (tree.get("histograms") or {}).get("serve.request") or {}
         nq = clients * q_per_client
+        from tpu_parquet.obs import LatencyHistogram as _LH
+        p99_s = _LH.from_dict(hist).quantile(0.99) if hist else 0.0
         entry = {
             "wall_s": round(wall, 4),
             "per_query_s": round(wall / nq, 5),
             "queries": nq,
             "p50_ms": round(float(hist.get("p50_seconds", 0.0)) * 1e3, 3),
             "p95_ms": round(float(hist.get("p95_seconds", 0.0)) * 1e3, 3),
+            "p99_ms": round(p99_s * 1e3, 3),
             "cache_hit_rate": round(hits / total, 4) if total else 0.0,
             "queue_wait_s": sv["queue_wait_seconds"],
         }
@@ -962,7 +965,7 @@ def bench_serve(path, rows, clients_sweep=(1, 4, 16)):
             entry["errors"] = errors[:3]
         out[f"clients{clients}"] = entry
         log(f"  serve {clients} client(s): {nq} queries in {wall:.3f}s "
-            f"(p95 {entry['p95_ms']:.1f}ms, "
+            f"(p95 {entry['p95_ms']:.1f}ms, p99 {entry['p99_ms']:.1f}ms, "
             f"hit rate {entry['cache_hit_rate']:.0%})")
     c1 = out.get("clients1")
     if c1 and c1["per_query_s"]:
@@ -971,6 +974,162 @@ def bench_serve(path, rows, clients_sweep=(1, 4, 16)):
         log(f"serve: plan_cache_speedup "
             f"{out['plan_cache_speedup']:.2f}x (shared plan/footer/dict "
             f"cache vs one-shot opens)")
+    return out
+
+
+def bench_serve_faults(path, rows, smoke=False):
+    """Fault-injected serve sweep (ISSUE 11): the same shared ScanService
+    under a seeded stall storm, hedging OFF vs ON.
+
+    Every 4th KiB-aligned range's FIRST attempt stalls (the
+    FaultInjectingStore ``stall_first`` shape — retries recover, so
+    results stay bit-identical); without hedging each stalled range costs
+    ~stall_s of tail, with hedging the duplicate fetch (attempt 2 at the
+    same offset: clean) wins the race after ``hedge_ms``.  Banks p50/p95/
+    p99 per mode, the hedge win-rate + wasted bytes that justify it, a
+    brownout micro-phase's shed counts, and the leaked-thread count (the
+    hedge duplicate path rides the exit-3 gate).  Skip with
+    BENCH_SERVE_FAULTS=0; ``--smoke`` runs a tiny phase.
+    """
+    import threading
+
+    from tpu_parquet.errors import OverloadError
+    from tpu_parquet.iostore import (FaultInjectingStore, FaultSpec,
+                                     IOConfig, LocalStore)
+    from tpu_parquet.obs import LatencyHistogram
+    from tpu_parquet.reader import FileReader
+    from tpu_parquet.serve import (PRIORITY_HIGH, PRIORITY_LOW, ScanRequest,
+                                   ScanService)
+
+    clients = 2 if smoke else 4
+    q_per_client = 2 if smoke else int(
+        os.environ.get("BENCH_SERVE_FAULT_QUERIES", "6"))
+    stall_s = 0.08 if smoke else 0.3
+    hedge_ms = 10.0
+    spec = FaultSpec(stall_first=1, stall_s=stall_s,
+                     match=lambda o, s: (o >> 10) % 4 == 0)
+    with FileReader(path) as r0:
+        cols = [".".join(l.path) for l in r0.schema.selected_leaves()]
+        expect = r0.read_all()
+    out = {"rows": rows, "stall_s": stall_s, "hedge_ms": hedge_ms,
+           "queries": clients * q_per_client}
+
+    for mode, h_ms in (("hedge_off", 0.0), ("hedge_on", hedge_ms)):
+        cfg = IOConfig(retries=4, backoff_ms=1.0, hedge_ms=h_ms,
+                       hedge_max=8)
+        svc = ScanService(
+            concurrency=min(clients, 4), queue_depth=max(4 * clients, 8),
+            store=lambda f: FaultInjectingStore(LocalStore(f), spec,
+                                                config=cfg))
+        errors = []
+
+        def run_client(ci):
+            try:
+                for i in range(q_per_client):
+                    svc.scan(ScanRequest(
+                        path, columns=[cols[(ci + i) % len(cols)]]),
+                        timeout=600)
+            except Exception as e:  # noqa: BLE001 — reported, not fatal
+                errors.append(repr(e))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=run_client, args=(ci,))
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        # bit-identity proof: a full-response scan through the faulted
+        # (and possibly hedged) path must match the clean one-shot read
+        # byte for byte — exactly the lie hedge_mismatches exists to catch
+        import numpy as _np
+
+        res = svc.scan(ScanRequest(path), timeout=600)[path]
+        for name, want in expect.items():
+            got = res[name]
+            parts = got if isinstance(got, list) else [got]
+            got_rows = sum(p.num_leaf_slots for p in parts)
+            assert got_rows == want.num_leaf_slots, \
+                f"{mode}: {name} rows {got_rows} != {want.num_leaf_slots}"
+            wv = want.values
+            if hasattr(wv, "heap"):
+                got_heap = _np.concatenate(
+                    [_np.asarray(p.values.heap) for p in parts])
+                assert _np.array_equal(got_heap, _np.asarray(wv.heap)), \
+                    f"{mode}: {name} heap bytes diverged"
+            else:
+                got_vals = _np.concatenate(
+                    [_np.asarray(p.values) for p in parts])
+                assert (got_vals.view(_np.uint8).tobytes()
+                        == _np.asarray(wv).view(_np.uint8).tobytes()), \
+                    f"{mode}: {name} value bytes diverged"
+        tree = svc.obs_registry().as_dict()
+        svc.close()
+        hist = (tree.get("histograms") or {}).get("serve.request") or {}
+        h = LatencyHistogram.from_dict(hist) if hist else LatencyHistogram()
+        io = tree.get("io") or {}
+        issued = int(io.get("hedges_issued", 0))
+        entry = {
+            "wall_s": round(wall, 4),
+            "p50_ms": round(h.quantile(0.5) * 1e3, 3),
+            "p95_ms": round(h.quantile(0.95) * 1e3, 3),
+            "p99_ms": round(h.quantile(0.99) * 1e3, 3),
+            "hedges_issued": issued,
+            "hedges_won": int(io.get("hedges_won", 0)),
+            "hedge_win_rate": (round(io.get("hedges_won", 0) / issued, 3)
+                               if issued else 0.0),
+            "hedges_wasted_bytes": int(io.get("hedges_wasted_bytes", 0)),
+            "retries": int(io.get("retries", 0)),
+        }
+        if errors:
+            entry["errors"] = errors[:3]
+        out[mode] = entry
+        log(f"  serve_faults {mode}: p99 {entry['p99_ms']:.1f}ms "
+            f"(p50 {entry['p50_ms']:.1f}ms), {issued} hedges, "
+            f"win rate {entry['hedge_win_rate']:.0%}")
+    if out["hedge_off"]["p99_ms"]:
+        out["p99_cut_ratio"] = round(
+            out["hedge_on"]["p99_ms"] / out["hedge_off"]["p99_ms"], 3)
+        log(f"serve_faults: hedged p99 is "
+            f"{out['p99_cut_ratio']:.2f}x of unhedged under the stall "
+            f"storm (lower is better)")
+
+    # brownout micro-phase: a burst past capacity sheds LOW with a
+    # retry_after_s hint while HIGH still admits
+    svc = ScanService(concurrency=1, queue_depth=4, brownout=0.25,
+                      store=lambda f: FaultInjectingStore(
+                          LocalStore(f),
+                          FaultSpec(latency_s=0.03),
+                          config=IOConfig(backoff_ms=1.0)))
+    tickets, shed_hint = [], None
+    for i in range(12):
+        try:
+            tickets.append(svc.submit(ScanRequest(
+                path, columns=[cols[0]], priority=PRIORITY_LOW)))
+        except OverloadError as e:
+            shed_hint = e.retry_after_s
+    high_ok = True
+    try:
+        tickets.append(svc.submit(ScanRequest(
+            path, columns=[cols[0]], priority=PRIORITY_HIGH)))
+    except OverloadError:
+        high_ok = False
+    for t in tickets:
+        try:
+            t.result(600)
+        except Exception:  # noqa: BLE001 — shed accounting is the product
+            pass
+    sheds = svc.serve_stats()["sheds"]
+    svc.close()
+    out["brownout"] = {"sheds": sheds, "high_admitted": high_ok,
+                      "retry_after_s": shed_hint}
+    log(f"  serve_faults brownout: shed {sheds} "
+        f"(high admitted: {high_ok}, retry_after {shed_hint})")
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("tpq-hedge")]
+    out["leaked_hedge_threads"] = len(leaked)
+    assert not leaked, f"hedge racers leaked: {leaked}"
     return out
 
 
@@ -1518,6 +1677,18 @@ def main(argv=None):
         except Exception as e:  # noqa: BLE001
             log(f"serve bench FAILED: {e!r}")
 
+    # Request-lifecycle resilience: the serve sweep under a seeded stall
+    # storm, hedging off vs on (p99 cut + win rate), a brownout shed
+    # phase, and the hedge thread-leak assertion.  Skip with
+    # BENCH_SERVE_FAULTS=0; smoke runs a tiny phase.
+    if os.environ.get("BENCH_SERVE_FAULTS", "1") != "0" and not over_budget():
+        try:
+            ppath, prows = _config_file("4")
+            results["serve_faults"] = bench_serve_faults(
+                ppath, prows, smoke=args.smoke)
+        except Exception as e:  # noqa: BLE001
+            log(f"serve_faults bench FAILED: {e!r}")
+
     # Writer throughput (host encode; ~10s).  Skip with BENCH_WRITES=0.
     if os.environ.get("BENCH_WRITES", "1") != "0" and not over_budget():
         try:
@@ -1574,7 +1745,8 @@ def main(argv=None):
 
     leaked = [t.name for t in threading.enumerate()
               if t.name.startswith(("tpq-sampler", "tpq-watchdog",
-                                    "tpq-devtimer"))]
+                                    "tpq-devtimer", "tpq-hedge",
+                                    "tpq-serve"))]
     if leaked:
         log(f"FAIL: obs daemon threads leaked after completion: {leaked}")
         sys.exit(3)

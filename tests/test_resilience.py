@@ -1,0 +1,763 @@
+"""Request-lifecycle resilience for the serve tier (ISSUE 11).
+
+The contracts under test, in rough order of importance:
+
+- **Deadline propagation**: `ScanRequest(deadline_s=)` rides the cancel
+  token into every store read and unit boundary — an expired request
+  raises a typed `DeadlineExceededError` quickly, stops issuing new IO,
+  and releases its admission-budget charge; everyone else is untouched.
+- **Cancellation**: `ticket.cancel()` has the same containment contract
+  (`CancelledError`), and a cancelled request leaves no orphaned in-flight
+  range registered anywhere a flight dump would show.
+- **Per-scan RetryBudget** (the PR 7 scoping fix): two concurrent requests
+  on ONE shared store spend their OWN budgets — one flaky request can
+  neither drain nor refresh another's.
+- **Hedged reads**: a fetch slower than the hedge delay gets a duplicate,
+  first success wins with the loser accounted (wasted bytes, verified
+  identity), results bit-identical, no leaked racer threads.
+- **Circuit breakers**: N classified failures open a file's circuit;
+  requests fast-fail with `CircuitOpenError` NAMING the file; healthy
+  files are unaffected; a half-open probe closes it after cooldown.
+- **Brownout**: past `TPQ_SERVE_BROWNOUT` occupancy, low-priority requests
+  shed with a drain-rate `retry_after_s` while high priority still admits.
+- **Chaos harness**: a seeded `ChaosSchedule` (stall storm + per-file
+  blackout) over a live ScanService proves the whole matrix
+  deterministically; its blob codec round-trips and rejects lies (fuzz
+  target #17's corpus rides tests/fuzz_corpus).
+"""
+
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_parquet.column import ByteArrayData, ColumnData
+from tpu_parquet.errors import (CancelledError, CircuitOpenError,
+                                DeadlineExceededError, OverloadError,
+                                ParquetError, RetryExhaustedError)
+from tpu_parquet.format import (CompressionCodec, FieldRepetitionType as FRT,
+                                Type)
+from tpu_parquet.iostore import (FaultInjectingStore, FaultSpec,
+                                 GenericRangeStore, IOConfig, LocalStore)
+from tpu_parquet.reader import FileReader
+from tpu_parquet.resilience import (BreakerBoard, CancelToken, ChaosPhase,
+                                    ChaosSchedule)
+from tpu_parquet.schema.core import build_schema, data_column
+from tpu_parquet.serve import (PRIORITY_HIGH, PRIORITY_LOW, ScanRequest,
+                               ScanService)
+from tpu_parquet.writer import FileWriter
+
+
+def _strings(vals):
+    return ColumnData(values=ByteArrayData(
+        offsets=np.cumsum([0] + [len(v) for v in vals]),
+        heap=np.frombuffer(b"".join(vals), np.uint8).copy(),
+    ))
+
+
+def _write_file(path, seed=0, groups=3, rows=500):
+    rng = np.random.default_rng(seed)
+    schema = build_schema([
+        data_column("a", Type.INT64, FRT.REQUIRED),
+        data_column("s", Type.BYTE_ARRAY, FRT.REQUIRED),
+    ])
+    pool = [b"alpha", b"beta", b"gamma", b"delta", b""]
+    with open(path, "wb") as fh:
+        with FileWriter(fh, schema, codec=CompressionCodec.SNAPPY) as w:
+            for _g in range(groups):
+                svals = [pool[i] for i in rng.integers(0, len(pool), rows)]
+                w.write_columns({
+                    "a": rng.integers(-(1 << 40), 1 << 40, rows),
+                    "s": _strings(svals),
+                })
+                w.flush_row_group()
+    return path
+
+
+@pytest.fixture(scope="module")
+def files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("resilience")
+    return [_write_file(str(d / f"f{i}.parquet"), seed=i) for i in range(3)]
+
+
+def _latency_factory(latency_s, **cfg):
+    return lambda f: FaultInjectingStore(
+        LocalStore(f), FaultSpec(latency_s=latency_s),
+        config=IOConfig(backoff_ms=0, **cfg))
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation
+# ---------------------------------------------------------------------------
+
+def test_deadline_expired_typed_fast_and_budget_released(files):
+    # 6 chunks x 60ms injected latency each = ~360ms sequential floor; a
+    # 100ms deadline must fail LONG before that — typed, with the budget
+    # free and the transport left idle (no new reads after the verdict)
+    svc = ScanService(concurrency=2, queue_depth=8, max_memory=1 << 24,
+                      store=_latency_factory(0.06))
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceededError):
+        svc.scan(ScanRequest(files[0], deadline_s=0.1), timeout=30)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.0, f"expiry took {elapsed:.2f}s — not a fast fail"
+    assert svc._budget.held == 0
+    stores = list(svc._served_stores)
+    reads_after = [s.stats.progress() for s in stores]
+    time.sleep(0.25)
+    assert [s.stats.progress() for s in stores] == reads_after, \
+        "reads continued after the deadline verdict"
+    for s in stores:
+        assert "inflight_offset" not in s.stats.sample()
+    sv = svc.serve_stats()
+    assert sv["failed"] == 1 and sv["deadline_exceeded"] == 1
+    svc.close()
+
+
+def test_deadline_expired_in_queue_never_reads(files):
+    # one worker wedged on a slow request: a queued request whose deadline
+    # expires BEFORE a worker frees up must fail without reading a byte
+    svc = ScanService(concurrency=1, queue_depth=8,
+                      store=_latency_factory(0.08))
+    slow = svc.submit(ScanRequest(files[0]))
+    quick = svc.submit(ScanRequest(files[1], deadline_s=0.01))
+    with pytest.raises(DeadlineExceededError):
+        quick.result(30)
+    slow.result(60)
+    assert svc.serve_stats()["deadline_exceeded"] == 1
+    svc.close()
+
+
+def test_deadline_reaches_store_reads(files):
+    # the deadline must bind INSIDE read_range too: a single stalled fetch
+    # longer than the whole deadline resolves at ~deadline, not stall_s
+    store = FaultInjectingStore(
+        LocalStore(open(files[0], "rb")),
+        FaultSpec(stall_first=1, stall_s=5.0),
+        config=IOConfig(backoff_ms=0, retries=0))
+    tok = store.begin_scan(cancel=CancelToken.with_timeout(0.15))
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceededError):
+        store.read_range(4, 1000, scan=tok)
+    assert time.perf_counter() - t0 < 2.0
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# cancellation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+def test_cancel_mid_flight_typed_and_no_orphans(files, prefetch):
+    spec = FaultSpec(stall_first=1, stall_s=10.0)
+    stores = []
+
+    def factory(f):
+        st = FaultInjectingStore(LocalStore(f), spec,
+                                 config=IOConfig(backoff_ms=0, retries=2))
+        stores.append(st)
+        return st
+
+    svc = ScanService(concurrency=2, queue_depth=8, max_memory=1 << 24,
+                      store=factory)
+    ticket = svc.submit(ScanRequest(files[0], prefetch=prefetch))
+    time.sleep(0.1)  # let it reach the injected stall
+    ticket.cancel()
+    for st in stores:
+        st.release()  # unblock the stall so the attempt can observe cancel
+    with pytest.raises(CancelledError):
+        ticket.result(30)
+    # no orphaned in-flight range anywhere a flight dump would report it
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all("inflight_offset" not in st.stats.sample() for st in stores):
+            break
+        time.sleep(0.02)
+    for st in stores:
+        assert "inflight_offset" not in st.stats.sample()
+    assert svc._budget.held == 0
+    sv = svc.serve_stats()
+    assert sv["cancelled"] == 1 and sv["failed"] == 1
+    svc.close()
+
+
+def test_cancel_before_start_is_typed(files):
+    svc = ScanService(concurrency=1, queue_depth=8,
+                      store=_latency_factory(0.1))
+    blocker = svc.submit(ScanRequest(files[0]))
+    queued = svc.submit(ScanRequest(files[1]))
+    queued.cancel()
+    with pytest.raises(CancelledError):
+        queued.result(30)
+    blocker.result(60)
+    svc.close()
+
+
+def test_prefetch_map_cancel_releases_budget():
+    from tpu_parquet.alloc import InFlightBudget
+    from tpu_parquet.pipeline import prefetch_map
+
+    budget = InFlightBudget(1 << 20)
+    token = CancelToken()
+    out = []
+    gen = prefetch_map(range(100), lambda x: x * 2, prefetch=2,
+                       budget=budget, cost=lambda x: 1024, cancel=token)
+    out.append(next(gen))
+    token.cancel()
+    with pytest.raises(CancelledError):
+        for v in gen:
+            out.append(v)
+    assert budget.held == 0, "cancelled map left budget bytes charged"
+    assert out[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# per-scan RetryBudget scoping (the PR 7 fix)
+# ---------------------------------------------------------------------------
+
+def test_scan_tokens_isolate_retry_budgets(files):
+    with open(files[0], "rb") as f:
+        st = FaultInjectingStore(LocalStore(f),
+                                 config=IOConfig(retry_budget=3))
+        t1 = st.begin_scan()
+        t2 = st.begin_scan()
+        assert t1.budget is not t2.budget
+        assert t1.budget.spend() and t1.budget.spend()
+        assert t2.budget.spent == 0, "budgets shared across scan tokens"
+        st.close()
+
+
+def test_concurrent_requests_one_store_budget_isolation(files):
+    # ONE FaultInjectingStore instance shared by two concurrent request
+    # streams over the same file: A's projection reads the big 'a' chunks
+    # (every attempt faults; its budget of 2 must exhaust), B's reads the
+    # small 's' chunks (healthy; every scan re-begins and must never see
+    # A's spends or refresh A's budget mid-failure)
+    big = FaultSpec(fail_first=1 << 30, match=lambda o, s: s > 2000)
+    with open(files[0], "rb") as f:
+        store = FaultInjectingStore(
+            LocalStore(f), big,
+            config=IOConfig(retries=20, backoff_ms=0.1, retry_budget=2,
+                            coalesce_gap=0))
+        results = {"a": None, "b_ok": 0}
+
+        def client_a():
+            try:
+                with FileReader(files[0], columns=["a"], store=store,
+                                prefetch=2) as r:
+                    r.read_all()
+                results["a"] = "completed"
+            except RetryExhaustedError as e:
+                results["a"] = str(e)
+            except Exception as e:  # noqa: BLE001
+                results["a"] = f"WRONG: {e!r}"
+
+        def client_b():
+            for _ in range(4):
+                with FileReader(files[0], columns=["s"], store=store,
+                                prefetch=0) as r:
+                    r.read_all()
+                results["b_ok"] += 1
+
+        ta = threading.Thread(target=client_a)
+        tb = threading.Thread(target=client_b)
+        ta.start(); tb.start()
+        ta.join(60); tb.join(60)
+        store.close()
+    # A exhausted ITS OWN budget (2), even while B's begin_scan calls were
+    # minting fresh tokens — the store-wide reset bug would have kept
+    # refreshing A's budget until its 21-attempt retry cap fired instead
+    assert results["a"] is not None and "retry budget" in results["a"], \
+        results["a"]
+    assert results["b_ok"] == 4, "healthy concurrent scans were impacted"
+
+
+# ---------------------------------------------------------------------------
+# hedged reads
+# ---------------------------------------------------------------------------
+
+class _SlowFirstStore(GenericRangeStore):
+    """First attempt at any offset is slow; duplicates are fast.  The
+    deterministic hedge showcase — and `payload_fn` lets the mismatch test
+    make the duplicate return different bytes."""
+
+    def __init__(self, data, config, slow_s=0.4, payload_fn=None):
+        super().__init__(config=config)
+        self.data = data
+        self.slow_s = slow_s
+        self.payload_fn = payload_fn
+        self.calls = {}
+        self._calls_lock = threading.Lock()
+
+    def size(self):
+        return len(self.data)
+
+    def _fetch_once(self, offset, size, timeout):
+        with self._calls_lock:
+            n = self.calls.get(offset, 0)
+            self.calls[offset] = n + 1
+        if n == 0:
+            time.sleep(self.slow_s)
+        buf = self.data[offset: offset + size]
+        if self.payload_fn is not None:
+            buf = self.payload_fn(buf, n)
+        return buf
+
+
+def test_hedged_read_first_wins_and_loser_accounted():
+    data = bytes(range(256)) * 64
+    st = _SlowFirstStore(data, IOConfig(hedge_ms=20, backoff_ms=0))
+    t0 = time.perf_counter()
+    buf = st.read_range(512, 1024)
+    fast = time.perf_counter() - t0
+    assert buf == data[512:1536]  # bit-identical to the object
+    assert fast < st.slow_s, f"hedge did not cut the stall: {fast:.3f}s"
+    d = st.stats.as_dict()
+    assert d["hedges_issued"] == 1 and d["hedges_won"] == 1
+    st.close()  # joins the slow primary racer
+    d = st.stats.as_dict()
+    assert d["hedges_wasted_bytes"] == 1024  # loser paid, accounted
+    assert d["hedge_mismatches"] == 0
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("tpq-hedge")]
+
+
+def test_hedged_read_mismatch_detected():
+    data = b"x" * 4096
+    # the duplicate (attempt 1) returns DIFFERENT bytes of the same length
+    st = _SlowFirstStore(
+        data, IOConfig(hedge_ms=10, backoff_ms=0), slow_s=0.3,
+        payload_fn=lambda buf, n: buf if n == 0 else b"y" * len(buf))
+    st.read_range(0, 100)
+    st.close()
+    assert st.stats.as_dict()["hedge_mismatches"] == 1
+
+
+def test_hedge_auto_learns_p90_delay():
+    data = bytes(range(256)) * 512
+    st = _SlowFirstStore(data, IOConfig(hedge_ms=-1.0, backoff_ms=0),
+                         slow_s=0.5)
+    st.slow_s = 0.0  # warmup: fast everywhere, populate the latency hist
+    for i in range(20):
+        st.read_range(i * 128, 64)
+    # auto mode hedges the slowest DECILE by definition, so a warmup read
+    # may occasionally race itself — but a genuinely slow fetch must lose
+    # to its duplicate decisively
+    st.slow_s = 0.5  # now the first attempt at a NEW offset stalls
+    won_before = st.stats.as_dict()["hedges_won"]
+    t0 = time.perf_counter()
+    buf = st.read_range(100_000, 256)
+    assert buf == data[100_000:100_256]
+    assert time.perf_counter() - t0 < 0.5
+    assert st.stats.as_dict()["hedges_won"] == won_before + 1
+    st.close()
+
+
+def test_hedging_off_by_default():
+    cfg = IOConfig.from_env()
+    assert cfg.hedge_ms == 0.0
+    st = _SlowFirstStore(b"z" * 1024, IOConfig(backoff_ms=0), slow_s=0.01)
+    st.read_range(0, 64)
+    assert st.stats.as_dict()["hedges_issued"] == 0
+    st.close()
+
+
+# ---------------------------------------------------------------------------
+# circuit breakers
+# ---------------------------------------------------------------------------
+
+def _blackout_factory(victim_path, healthy_cfg=None):
+    """Per-file store factory: the victim file always fails, others clean."""
+    cfg = healthy_cfg or IOConfig(retries=1, backoff_ms=0, retry_budget=8)
+
+    def factory(f):
+        name = os.path.abspath(getattr(f, "name", "") or "")
+        spec = (FaultSpec(fail_first=1 << 30)
+                if name == os.path.abspath(victim_path) else FaultSpec())
+        return FaultInjectingStore(LocalStore(f), spec, config=cfg)
+
+    return factory
+
+
+def test_circuit_trips_within_n_failures_and_names_file(files):
+    victim, healthy = files[2], files[0]
+    board = BreakerBoard(fails=2, window_s=60, cooldown_s=60)
+    svc = ScanService(concurrency=2, queue_depth=32, breakers=board,
+                      store=_blackout_factory(victim))
+    failures = 0
+    for _ in range(2):  # exactly N=2 classified failures trip the circuit
+        with pytest.raises(RetryExhaustedError):
+            svc.scan(ScanRequest(victim), timeout=60)
+        failures += 1
+    with pytest.raises(CircuitOpenError) as ei:
+        svc.scan(ScanRequest(victim), timeout=60)
+    assert ei.value.file == str(victim)
+    assert ei.value.retry_after_s is not None
+    # ...while concurrent requests on a healthy file complete clean
+    with FileReader(healthy) as r:
+        want_rows = r.num_rows
+    res = svc.scan(ScanRequest(healthy, columns=["a"]), timeout=60)
+    got = res[healthy]["a"]
+    parts = got if isinstance(got, list) else [got]
+    assert sum(p.num_leaf_slots for p in parts) == want_rows
+    circ = svc.serve_stats()["circuit"]
+    assert circ["open_now"] == 1 and circ["open_files"] == [str(victim)]
+    assert circ["opened"] == 1 and circ["fast_fails"] >= 1
+    # the flight-dump sample names the open circuit too (autopsy's input)
+    assert svc.sample()["circuit_open"][0]["file"] == str(victim)
+    svc.close()
+
+
+def test_circuit_half_open_probe_closes(files):
+    clk = [0.0]
+    board = BreakerBoard(fails=2, window_s=60, cooldown_s=5,
+                         clock=lambda: clk[0])
+    key, name = ("file", "k", 1, 2), "/data/x.parquet"
+    board.note(key, name, ok=False)
+    board.note(key, name, ok=False)
+    with pytest.raises(CircuitOpenError):
+        board.admit(key, name)
+    clk[0] = 6.0  # cooldown passed: ONE half-open probe admits
+    board.admit(key, name)
+    with pytest.raises(CircuitOpenError):
+        board.admit(key, name)  # second caller held while probe is out
+    board.note(key, name, ok=True)  # probe succeeded
+    board.admit(key, name)
+    c = board.counters()
+    assert c["open_now"] == 0 and c["closed"] == 1
+    # ...and a failing probe re-opens with a fresh cooldown
+    board.note(key, name, ok=False)
+    board.note(key, name, ok=False)
+    clk[0] = 12.0
+    board.admit(key, name)          # probe
+    board.note(key, name, ok=False)  # probe failed
+    assert board.counters()["reopened"] == 1
+    with pytest.raises(CircuitOpenError):
+        board.admit(key, name)
+
+
+def test_abandoned_probe_never_wedges_breaker_open():
+    # a half-open probe that dies with an UNCLASSIFIED error (deadline
+    # expiry, caller cancel) never calls note(); after a further cooldown
+    # of silence the probe slot is forfeit and a new probe admits
+    clk = [0.0]
+    board = BreakerBoard(fails=1, window_s=60, cooldown_s=5,
+                         clock=lambda: clk[0])
+    key, name = ("file", "k", 1, 2), "/data/x.parquet"
+    board.note(key, name, ok=False)  # opens
+    clk[0] = 6.0
+    board.admit(key, name)  # the probe... which silently vanishes
+    clk[0] = 8.0
+    with pytest.raises(CircuitOpenError):
+        board.admit(key, name)  # probe still nominally out
+    clk[0] = 12.0  # a full cooldown after the probe went quiet
+    board.admit(key, name)  # slot forfeited: this caller is the new probe
+    board.note(key, name, ok=True)
+    assert board.counters()["open_now"] == 0
+
+
+def test_default_scan_token_never_inherits_request_verdict(files):
+    # a shared store's scan-less readers (footer reads, cache warms) must
+    # not inherit a foreign request's deadline/cancel from begin_scan
+    with open(files[0], "rb") as f:
+        st = FaultInjectingStore(LocalStore(f),
+                                 config=IOConfig(backoff_ms=0))
+        expired = CancelToken.with_timeout(0.0)
+        tok = st.begin_scan(cancel=expired)
+        with pytest.raises(DeadlineExceededError):
+            st.read_range(4, 100, scan=tok)  # the request itself: typed
+        st.read_range(4, 100)  # a scan-less caller: unaffected
+        st.close()
+
+
+def test_deadline_failures_never_trip_circuits(files):
+    board = BreakerBoard(fails=1, window_s=60, cooldown_s=60)
+    svc = ScanService(concurrency=2, queue_depth=8, breakers=board,
+                      store=_latency_factory(0.08))
+    with pytest.raises(DeadlineExceededError):
+        svc.scan(ScanRequest(files[0], deadline_s=0.02), timeout=30)
+    # an impatient caller must not poison the file for everyone else
+    assert board.counters()["open_now"] == 0
+    svc.scan(ScanRequest(files[0], columns=["a"]), timeout=60)
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# brownout load shedding
+# ---------------------------------------------------------------------------
+
+def test_brownout_sheds_low_admits_high(files):
+    svc = ScanService(concurrency=1, queue_depth=4, brownout=0.25,
+                      store=_latency_factory(0.05))
+    tickets, shed = [], None
+    for _ in range(10):
+        try:
+            tickets.append(svc.submit(
+                ScanRequest(files[0], columns=["a"],
+                            priority=PRIORITY_LOW)))
+        except OverloadError as e:
+            shed = e
+    assert shed is not None, "brownout never shed low-priority work"
+    assert shed.retry_after_s is not None and shed.retry_after_s > 0
+    assert shed.shed_priority == PRIORITY_LOW
+    assert shed.queue_depth is not None and shed.in_flight is not None
+    # high-priority still admits under the same pressure
+    tickets.append(svc.submit(
+        ScanRequest(files[0], columns=["a"], priority=PRIORITY_HIGH)))
+    for t in tickets:
+        t.result(60)
+    sv = svc.serve_stats()
+    assert sv["sheds"]["low"] >= 1 and sv["completed"] == len(tickets)
+    svc.close()
+
+
+def test_brownout_disabled_and_default(files):
+    with ScanService(concurrency=1, queue_depth=4, brownout=0.0) as svc:
+        assert svc.brownout == 0.0
+    with ScanService(concurrency=1) as svc:
+        assert svc.brownout == pytest.approx(0.85)  # TPQ_SERVE_BROWNOUT
+
+
+# ---------------------------------------------------------------------------
+# the chaos harness (acceptance matrix)
+# ---------------------------------------------------------------------------
+
+def test_chaos_schedule_roundtrip_and_invariants():
+    s = ChaosSchedule.generate(seed=42, n_phases=6, horizon=400, files=3)
+    assert ChaosSchedule.from_blob(s.to_blob()) == s
+    assert ChaosSchedule.generate(seed=42, n_phases=6, horizon=400,
+                                  files=3) == s
+    prev_end = 0
+    for p in s.phases:
+        assert p.end > p.start >= prev_end
+        prev_end = p.end
+    with pytest.raises(ParquetError):
+        ChaosSchedule([ChaosPhase(0, 10, "stall", stall_s=60.0)])
+    with pytest.raises(ParquetError):
+        ChaosSchedule([ChaosPhase(0, 10, "stall"),
+                       ChaosPhase(5, 15, "transient")])
+    with pytest.raises(ParquetError):
+        ChaosSchedule.from_blob(b"TPQC\x01junk")
+
+
+def test_chaos_matrix_blackout_trips_circuit_healthy_files_clean(
+        files, tmp_path):
+    # seeded schedule: a stall storm over the first reads, then a per-file
+    # blackout pinned to files[2] for the rest of the run
+    schedule = ChaosSchedule([
+        ChaosPhase(0, 8, "stall", intensity=1, stall_s=0.05),
+        ChaosPhase(8, 1 << 20, "blackout", file_index=2),
+    ], seed=11)
+    factory = schedule.store_factory(
+        files, config=IOConfig(retries=1, backoff_ms=1.0, retry_budget=32))
+    board = BreakerBoard(fails=2, window_s=60, cooldown_s=60)
+    # ground truth for bit-identity, read clean
+    expect = {}
+    for p in files[:2]:
+        with FileReader(p, columns=["a"]) as r:
+            expect[p] = r.read_all()["a"].values.copy()
+
+    with ScanService(concurrency=2, queue_depth=32, breakers=board,
+                     store=factory) as svc:
+        # healthy files ride THROUGH the stall storm (first attempts
+        # stall, retries recover) — bit-identical output, and their reads
+        # advance the shared ordinal clock into the blackout phase
+        for p in files[:2]:
+            got = svc.scan(ScanRequest(p, columns=["a"]),
+                           timeout=120)[p]["a"]
+            parts = got if isinstance(got, list) else [got]
+            np.testing.assert_array_equal(
+                np.concatenate([np.asarray(q.values) for q in parts]),
+                expect[p])
+        # the blacked-out file: N classified failures, then the circuit
+        outcome = []
+        for _ in range(4):
+            try:
+                svc.scan(ScanRequest(files[2], columns=["a"]), timeout=120)
+                outcome.append("ok")
+            except RetryExhaustedError:
+                outcome.append("fail")
+            except CircuitOpenError:
+                outcome.append("open")
+        assert outcome[:2] == ["fail", "fail"], outcome  # trips at N=2
+        assert set(outcome[2:]) == {"open"}, outcome     # then fast-fails
+        # ...while the healthy files STILL complete clean mid-blackout
+        for p in files[:2]:
+            got = svc.scan(ScanRequest(p, columns=["a"]),
+                           timeout=120)[p]["a"]
+            parts = got if isinstance(got, list) else [got]
+            np.testing.assert_array_equal(
+                np.concatenate([np.asarray(q.values) for q in parts]),
+                expect[p])
+        circ = svc.serve_stats()["circuit"]
+        assert circ["open_files"] == [str(files[2])]
+        factory.release()
+
+
+# ---------------------------------------------------------------------------
+# observability: serve-stats / doctor / autopsy surfaces
+# ---------------------------------------------------------------------------
+
+def _reg_tree_with_everything():
+    return {
+        "obs_version": 1,
+        "pipeline": {"io_seconds": 0.4, "stage_seconds": 0.1,
+                     "stall_seconds": 0.0},
+        "reader": {},
+        "io": {"reads": 100, "hedges_issued": 10, "hedges_won": 1,
+               "hedges_wasted_bytes": 5000, "hedge_mismatches": 0},
+        "serve": {
+            "submitted": 10, "completed": 5, "rejected": 3, "failed": 2,
+            "queue_wait_seconds": 0.2, "exec_seconds": 1.0, "rows": 100,
+            "queue_depth_peak": 4, "deadline_exceeded": 1, "cancelled": 1,
+            "sheds": {"low": 3, "normal": 0},
+            "circuit": {"opened": 1, "reopened": 0, "closed": 0,
+                        "fast_fails": 2, "open_now": 1,
+                        "open_files": ["/data/bad.parquet"]},
+            "cache": {"footer_hits": 1, "footer_misses": 1, "plan_hits": 1,
+                      "plan_misses": 1, "dict_hits": 0, "dict_misses": 0,
+                      "evictions": 0, "invalidations": 0, "held_bytes": 10,
+                      "capacity_bytes": 100, "entries": 2},
+        },
+        "histograms": {},
+    }
+
+
+def test_doctor_circuit_open_and_hedge_ineffective():
+    from tpu_parquet.obs import doctor_registry
+
+    rep = doctor_registry(_reg_tree_with_everything())
+    assert rep["circuit_open"]["verdict"] == "circuit-open"
+    assert rep["circuit_open"]["files"] == ["/data/bad.parquet"]
+    assert rep["hedge"]["verdict"] == "hedge-ineffective"
+    assert rep["hedge"]["win_rate"] == 0.1
+    # a healthy hedge win-rate raises no advisory
+    tree = _reg_tree_with_everything()
+    tree["io"]["hedges_won"] = 9
+    assert "hedge" not in doctor_registry(tree)
+    # and a closed board raises no circuit block
+    tree["serve"]["circuit"]["open_now"] = 0
+    assert "circuit_open" not in doctor_registry(tree)
+
+
+def test_doctor_cli_prints_circuit_and_hedge(tmp_path):
+    from tpu_parquet.cli import pq_tool
+
+    path = str(tmp_path / "reg.json")
+    with open(path, "w") as f:
+        json.dump(_reg_tree_with_everything(), f)
+    buf = io.StringIO()
+    rc = pq_tool.cmd_doctor(
+        type("A", (), {"file": path, "config": None})(), out=buf)
+    out = buf.getvalue()
+    assert rc == 0
+    assert "circuit-open: /data/bad.parquet" in out
+    assert "hedge-ineffective" in out and "TPQ_IO_HEDGE_MS" in out
+
+
+def test_serve_stats_cli_lifecycle_circuit_hedge_lines(tmp_path):
+    from tpu_parquet.cli import pq_tool
+
+    path = str(tmp_path / "reg.json")
+    with open(path, "w") as f:
+        json.dump(_reg_tree_with_everything(), f)
+    buf = io.StringIO()
+    rc = pq_tool.cmd_serve_stats(
+        type("A", (), {"file": path, "config": None})(), out=buf)
+    out = buf.getvalue()
+    assert rc == 0
+    assert "lifecycle: 1 deadline-exceeded, 1 cancelled, shed 3 low" in out
+    assert "circuit: 1 open now (/data/bad.parquet)" in out
+    assert "hedges: 10 issued, 1 won (10%)" in out
+
+
+def test_autopsy_names_open_circuit(files, tmp_path):
+    from tpu_parquet.cli import pq_tool
+    from tpu_parquet.obs import autopsy_dump
+
+    doc = {
+        "flight_version": 1, "reason": "explicit", "pid": 1234,
+        "threads": {}, "stacks": {}, "budgets": [], "samples": {
+            "serve": {
+                "queue_depth": 0, "in_flight": 0, "requests": {},
+                "circuit_open": [{"file": str(files[2]),
+                                  "retry_after_s": 4.5,
+                                  "state": "open"}],
+            },
+        },
+    }
+    rep = autopsy_dump(doc)
+    assert rep["verdict"] == "circuit-open"
+    assert str(files[2]) in rep["probable_cause"]
+    path = str(tmp_path / "dump.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    buf = io.StringIO()
+    rc = pq_tool.cmd_autopsy(type("A", (), {"file": path})(), out=buf)
+    assert rc == 0
+    assert f"circuit: OPEN for {str(files[2])!r}" in buf.getvalue()
+
+
+def test_registry_serve_merge_with_new_keys(files):
+    # cross-process merge: lifecycle flows add, the open_now gauge maxes
+    from tpu_parquet.obs import StatsRegistry
+
+    tree = _reg_tree_with_everything()
+    reg = StatsRegistry()
+    reg.merge_dict(tree)
+    reg.merge_dict(tree)
+    sv = reg.as_dict()["serve"]
+    assert sv["deadline_exceeded"] == 2 and sv["sheds"]["low"] == 6
+    assert sv["circuit"]["opened"] == 2      # transitions are flows
+    assert sv["circuit"]["open_now"] == 1    # gauge: max, not sum
+    io_sec = reg.as_dict()["io"]
+    assert io_sec["hedges_issued"] == 20
+
+
+def test_io_stats_survive_store_collection(files):
+    # factory stores die with their readers; the service must bank their
+    # counters at close so completed work never reports zero hedges/reads
+    import gc
+
+    svc = ScanService(
+        concurrency=2, queue_depth=8,
+        store=lambda f: FaultInjectingStore(
+            LocalStore(f), FaultSpec(fail_first=1),
+            config=IOConfig(backoff_ms=0)))
+    svc.scan(ScanRequest(files[0]), timeout=60)
+    gc.collect()
+    io_sec = svc.obs_registry().as_dict()["io"]
+    assert io_sec and io_sec["reads"] > 0 and io_sec["retries"] > 0, io_sec
+    svc.close()
+
+
+def test_breaker_board_drops_recovered_entries():
+    board = BreakerBoard(fails=5, window_s=60, cooldown_s=5)
+    key = ("file", "k", 1, 2)
+    board.note(key, "f", ok=False)  # one blip: entry created, still closed
+    assert len(board._breakers) == 1
+    board.note(key, "f", ok=True)   # recovered: the entry must not linger
+    assert len(board._breakers) == 0
+
+
+def test_overload_error_carries_lifecycle_fields():
+    e = OverloadError("shed", queue_depth=3, in_flight=2,
+                      retry_after_s=0.7, shed_priority=0)
+    assert e.retry_after_s == 0.7 and e.shed_priority == 0
+    assert not issubclass(DeadlineExceededError, ParquetError)
+    assert not issubclass(CancelledError, ParquetError)
+    assert not issubclass(CircuitOpenError, ParquetError)
+    assert issubclass(DeadlineExceededError, TimeoutError)
+
+
+def test_no_leaked_threads_after_everything(files):
+    # the hedge duplicate path and the cancel paths must leave nothing
+    # behind (the bench exit-3 gate watches the same prefixes)
+    time.sleep(0.1)
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith(("tpq-hedge", "tpq-serve"))]
+    assert not leaked, leaked

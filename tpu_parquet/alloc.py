@@ -188,14 +188,17 @@ class InFlightBudget:
             self.peak = max(self.peak, self.held)
             return True
 
-    def acquire(self, nbytes: int) -> None:
+    def acquire(self, nbytes: int, cancel=None) -> None:
         """Block until ``nbytes`` fit under the cap, then take them.
 
         While blocked, the waiter is visible in :meth:`snapshot` (waiter
         count + longest wait age).  An :meth:`abort` delivered by the
         watchdog wakes every waiter and raises the abort exception here —
         the graceful-degradation exit from a wedge that would otherwise
-        block forever.
+        block forever.  ``cancel`` (a
+        :class:`~tpu_parquet.resilience.CancelToken`) turns the wait into
+        a sliced one so a cancelled or deadline-expired request raises its
+        typed verdict instead of waiting out someone else's drain.
         """
         if self.max_bytes <= 0:
             return
@@ -207,10 +210,12 @@ class InFlightBudget:
                 while not self._fits(n):
                     if self._abort is not None:
                         raise self._abort
+                    if cancel is not None:
+                        cancel.check()
                     if started is None:
                         started = time.monotonic()
                         self._waiting[tid] = started
-                    self._cv.wait()
+                    self._cv.wait(0.05 if cancel is not None else None)
             finally:
                 if started is not None:
                     self._waiting.pop(tid, None)

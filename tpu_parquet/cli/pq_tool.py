@@ -391,6 +391,19 @@ def cmd_doctor(args, out=sys.stdout) -> int:
         # TPQ_DEVICE_TIMING=0): explicitly n/a, never a KeyError
         out.write("device: n/a (no device section — record predates device "
                   "timing, or TPQ_DEVICE_TIMING=0)\n")
+    co = rep.get("circuit_open")
+    if co:
+        out.write(f"circuit-open: {', '.join(co['files']) or '?'} "
+                  f"({co['opened']} trip(s), {co['fast_fails']} fast-fail(s)"
+                  f") — the named file keeps failing; inspect or replace "
+                  f"it, healthy traffic is unaffected\n")
+    hg = rep.get("hedge")
+    if hg:
+        out.write(f"hedge-ineffective: {hg['won']}/{hg['issued']} hedges "
+                  f"won ({100 * hg['win_rate']:.0f}%) for "
+                  f"{hg['wasted_bytes']} wasted bytes — the hedge delay "
+                  f"sits below the real p90; raise TPQ_IO_HEDGE_MS or let "
+                  f"auto re-learn\n")
     return 0
 
 
@@ -446,6 +459,9 @@ def cmd_autopsy(args, out=sys.stdout) -> int:
                 f"({stuck['age_s']:g}s in flight)" if stuck else "")
         out.write(f"serve: {sv.get('in_flight', 0)} in flight, queue depth "
                   f"{sv.get('queue_depth', 0)}{tail}\n")
+        for c in sv.get("circuit_open") or []:
+            out.write(f"circuit: OPEN for {c['file']!r} (next probe in "
+                      f"{c.get('retry_after_s', '?')}s)\n")
     de = rep.get("data_errors")
     if de:
         first = de.get("first") or {}
@@ -492,6 +508,30 @@ def cmd_serve_stats(args, out=sys.stdout) -> int:
     out.write(f"queue: depth peak {sv.get('queue_depth_peak', 0)}, "
               f"total wait {float(sv.get('queue_wait_seconds', 0)):.4f}s, "
               f"total exec {float(sv.get('exec_seconds', 0)):.4f}s\n")
+    sheds = sv.get("sheds") or {}
+    dl, cn = sv.get("deadline_exceeded", 0), sv.get("cancelled", 0)
+    if any(sheds.values()) or dl or cn:
+        out.write(f"lifecycle: {dl} deadline-exceeded, {cn} cancelled, "
+                  f"shed {sheds.get('low', 0)} low / "
+                  f"{sheds.get('normal', 0)} normal priority (brownout)\n")
+    circ = sv.get("circuit") or {}
+    if any(v for k, v in circ.items() if k != "open_files"):
+        files = circ.get("open_files") or []
+        out.write(f"circuit: {circ.get('open_now', 0)} open now"
+                  + (f" ({', '.join(str(f) for f in files)})" if files
+                     else "")
+                  + f", {circ.get('opened', 0)} opened / "
+                    f"{circ.get('reopened', 0)} reopened / "
+                    f"{circ.get('closed', 0)} closed, "
+                    f"{circ.get('fast_fails', 0)} fast-fails\n")
+    io_sec = tree.get("io") or {}
+    if io_sec.get("hedges_issued"):
+        issued = int(io_sec.get("hedges_issued", 0))
+        won = int(io_sec.get("hedges_won", 0))
+        out.write(f"hedges: {issued} issued, {won} won "
+                  f"({100 * won / issued:.0f}%), "
+                  f"{io_sec.get('hedges_wasted_bytes', 0)} wasted bytes, "
+                  f"{io_sec.get('hedge_mismatches', 0)} mismatches\n")
     cache = sv.get("cache") or {}
     if cache:
         def rate(kind):
@@ -512,11 +552,12 @@ def cmd_serve_stats(args, out=sys.stdout) -> int:
     if slo:
         out.write("latency (per request):\n")
         out.write(f"  {'lane':<12}{'count':>7}{'p50':>12}{'p95':>12}"
-                  f"{'max':>12}\n")
+                  f"{'p99':>12}{'max':>12}\n")
         for lane, h in slo:
             out.write(f"  {lane:<12}{h.count:>7}"
                       f"{h.quantile(0.5) * 1e3:>10.2f}ms"
                       f"{h.quantile(0.95) * 1e3:>10.2f}ms"
+                      f"{h.quantile(0.99) * 1e3:>10.2f}ms"
                       f"{h.max_seconds * 1e3:>10.2f}ms\n")
     return 0
 

@@ -3519,7 +3519,7 @@ class DeviceFileReader:
                  row_filter=None, prefetch: int = 0, trace=None,
                  sample_ms=None, hang_s=None, hang_policy=None,
                  store=None, on_data_error=None, quarantine=None,
-                 metadata=None, plan=None, dict_cache=None):
+                 metadata=None, plan=None, dict_cache=None, cancel=None):
         from .obs import (Sampler, Watchdog, register_flight_registry,
                           resolve_hang_s, resolve_sample_ms, resolve_tracer)
         from .pipeline import PipelineStats
@@ -3541,7 +3541,7 @@ class DeviceFileReader:
                                 on_data_error=on_data_error,
                                 quarantine=quarantine,
                                 metadata=metadata, plan=plan,
-                                dict_cache=dict_cache)
+                                dict_cache=dict_cache, cancel=cancel)
         # the plan IR (scanplan.py): the footer slice + pruning verdicts +
         # ship-route memo this scan consumes.  A caller-supplied plan (the
         # serve.ScanService cache) is REPLAYED — group pruning is adopted
@@ -4005,7 +4005,8 @@ class DeviceFileReader:
         collected = None
         if self._prefetch > 0:
             feed = _chunk_feed(iter([(self, None, index)]), self._prefetch,
-                               self.alloc.max_size)
+                               self.alloc.max_size,
+                               cancel=self._host._cancel)
             try:
                 _r, _p, _i, collected = next(feed)
             finally:
@@ -4161,8 +4162,10 @@ class DeviceFileReader:
                                          tracer=self._tracer)
         # fresh per-scan retry budget / coalescing state / abort poison on
         # BOTH paths (the prefetch feed also calls this — idempotent at
-        # scan start; the prefetch=0 path has no other reset point)
-        self._store.begin_scan()
+        # scan start; the prefetch=0 path has no other reset point), with
+        # the request's deadline/cancel riding the scan token
+        self._host._sr.set_scan(
+            self._store.begin_scan(cancel=self._host._cancel))
         indices = [i for i in range(self.num_row_groups)
                    if self._host.row_group_selected(i)]
         self.quarantine.begin_scan(len(indices))
@@ -4187,6 +4190,7 @@ class DeviceFileReader:
                     budget_bytes=self.alloc.max_size,
                     watchdog=self._watchdog,
                     quarantine=self.quarantine,
+                    cancel=self._host._cancel,
                 ):
                     yield out
                     if xprof is not None:
@@ -4252,7 +4256,8 @@ class _FailedChunk:
         self.exc = exc
 
 
-def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
+def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None,
+                cancel=None):
     """Chunk-granular prefetch over the ``(reader, path, index)`` stream.
 
     The host half of the overlapped pipeline (ISSUE 1 tentpole): IO + CRC +
@@ -4338,8 +4343,10 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
                 # the host reader's own store-backed view — one wrapper
                 # per (file, store) pair, never a divergent copy
                 sr = srs[id(r)] = r._host._sr
-                # fresh per-scan retry budget + coalescing state
-                sr.store.begin_scan()
+                # fresh per-scan retry budget + coalescing state, scoped
+                # to this scan's token (the reader's request deadline/
+                # cancel rides it into every store read)
+                sr.set_scan(sr.store.begin_scan(cancel=r._host._cancel))
             rg = r.metadata.row_groups[i]
             leaves = {l.path: l for l in r.schema.selected_leaves()}
             skip_pages, rows_dropped, planned_bufs = r._plan_page_pruning(
@@ -4359,9 +4366,12 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
             # out on the prefetch pool (the first worker to touch a span
             # fetches it) — only for stores that ask for it
             st = sr.store
-            if (st.prefers_coalescing and not st.coalesce_disabled
+            tok = sr._scan
+            if (st.prefers_coalescing
+                    and not (tok.coalesce_disabled if tok is not None
+                             else st.coalesce_disabled)
                     and len(ranges) > 1):
-                fetcher = CoalescedFetcher(st, ranges)
+                fetcher = CoalescedFetcher(st, ranges, scan=tok)
                 for it in items:
                     if it[8] is None:
                         it[9] = fetcher
@@ -4432,7 +4442,8 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None):
     try:
         for key, p, payload in prefetch_map(gen_items(), collect, prefetch,
                                             budget=budget, cost=cost,
-                                            stats=_StatsFwd()):
+                                            stats=_StatsFwd(),
+                                            cancel=cancel):
             slot = pending[key]
             if p is not None:
                 slot["chunks"][p] = payload
@@ -4462,7 +4473,7 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
                    close_finished: bool = False,
                    defer_finalize: bool = False,
                    prefetch: int = 0, budget_bytes: int = 0,
-                   watchdog=None, quarantine=None):
+                   watchdog=None, quarantine=None, cancel=None):
     """The one-deep prepare/stage/dispatch pipeline shared by
     ``DeviceFileReader.iter_row_groups`` (one reader) and :func:`scan_files`
     (many).  ``work`` yields ``(reader, path, row_group_index)``; this yields
@@ -4485,7 +4496,8 @@ def _scan_pipeline(work, ex, finalize_each: bool = False,
     never touches a closed descriptor).
     """
     if prefetch > 0:
-        stream = _chunk_feed(work, prefetch, budget_bytes, watchdog=watchdog)
+        stream = _chunk_feed(work, prefetch, budget_bytes, watchdog=watchdog,
+                             cancel=cancel)
     else:
         stream = ((r, path, i, None) for r, path, i in work)
     # consumer gate: the watchdog may only fire while the consumer is

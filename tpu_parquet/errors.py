@@ -66,7 +66,8 @@ class RetryExhaustedError(IOError):
 
 
 class OverloadError(RuntimeError):
-    """A scan service rejected a request because its admission queue is full.
+    """A scan service rejected a request because its admission queue is full
+    — or shed it under brownout (``TPQ_SERVE_BROWNOUT``).
 
     Raised by :class:`tpu_parquet.serve.ScanService` *at submission time* —
     a fast-reject, never a blocked caller: under overload the service sheds
@@ -75,14 +76,75 @@ class OverloadError(RuntimeError):
     a ParquetError (nothing is malformed) and not an IOError (nothing was
     read): it is a load-shedding signal.  ``queue_depth`` and ``in_flight``
     carry the admission state at rejection so the error itself says how
-    overloaded the service was.
+    overloaded the service was; ``retry_after_s`` (brownout sheds) is the
+    service's drain-rate-derived back-off hint, and ``shed_priority`` names
+    the priority band that was shed (None for a plain queue-full reject).
     """
 
     def __init__(self, message: str, queue_depth: "int | None" = None,
-                 in_flight: "int | None" = None):
+                 in_flight: "int | None" = None,
+                 retry_after_s: "float | None" = None,
+                 shed_priority: "int | None" = None):
         super().__init__(message)
         self.queue_depth = queue_depth
         self.in_flight = in_flight
+        self.retry_after_s = retry_after_s
+        self.shed_priority = shed_priority
+
+
+class DeadlineExceededError(TimeoutError):
+    """A request's end-to-end deadline expired before it finished.
+
+    Raised for the ONE caller whose :class:`tpu_parquet.serve.ScanRequest`
+    carried ``deadline_s`` (the deadline rides the scan's
+    :class:`~tpu_parquet.resilience.CancelToken` into every
+    ``ByteStore.read_range`` and is checked at unit boundaries in the
+    prefetch pipeline): the request stops issuing new IO, frees its
+    admission-budget charge, and surfaces here — no other request notices.
+    Rooted at TimeoutError (generic timeout handling catches it), NOT
+    ParquetError (nothing is malformed) and NOT IOError (the transport is
+    fine; the caller's clock ran out).  ``deadline_s`` echoes the budget
+    the request was given.
+    """
+
+    def __init__(self, message: str, deadline_s: "float | None" = None):
+        super().__init__(message)
+        self.deadline_s = deadline_s
+
+
+class CancelledError(RuntimeError):
+    """The caller cancelled its own request (``ScanTicket.cancel()``).
+
+    Same containment contract as :class:`DeadlineExceededError` — the
+    cancelled request stops issuing new IO at the next unit boundary and
+    releases what it held, everyone else is untouched.  A distinct type
+    from ``concurrent.futures.CancelledError`` on purpose: this is an
+    application-level verdict delivered through ``ticket.result()``, and
+    the fuzz oracle / retry machinery must never confuse it with a pool
+    internals error.
+    """
+
+
+class CircuitOpenError(RuntimeError):
+    """A per-file circuit breaker is open: the file is failing repeatedly
+    and requests touching it fast-fail instead of re-paying the full
+    retry/deadline cost.
+
+    Raised by :class:`tpu_parquet.serve.ScanService` before any byte of the
+    named file is read, once :class:`~tpu_parquet.resilience.BreakerBoard`
+    has seen N classified failures inside its window (``TPQ_CIRCUIT_FAILS``
+    / ``TPQ_CIRCUIT_WINDOW_S``).  ``file`` names the poisoned file,
+    ``retry_after_s`` the cooldown remaining until a half-open probe is
+    admitted.  NOT a ParquetError: the file MAY be malformed, but this
+    error reports the breaker's memory of earlier failures, not a fresh
+    diagnosis — the original classified error is what said why.
+    """
+
+    def __init__(self, message: str, file: "str | None" = None,
+                 retry_after_s: "float | None" = None):
+        super().__init__(message)
+        self.file = file
+        self.retry_after_s = retry_after_s
 
 
 class DataIntegrityError(ParquetError):

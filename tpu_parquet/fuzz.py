@@ -867,6 +867,83 @@ def crafted_scan_plan_blobs() -> "list[bytes]":
     ]
 
 
+def fuzz_chaos_schedule(data: bytes) -> None:
+    """Fuzz target #17: chaos-schedule blob adoption + planner invariants
+    (resilience.py).
+
+    A chaos schedule is a TEST plan that drives fault injection over live
+    services, so a hostile blob must never become a hostile test run:
+    ``from_blob`` either raises ParquetError or yields a schedule whose
+    invariants hold (phases sorted + disjoint, every stall bounded — no
+    schedule may encode an unbounded stall), whose round-trip is exact
+    (``from_blob(to_blob(s)) == s``, bytes stable), and whose phase lookup
+    never crashes on arbitrary ordinals.  Seeded GENERATION must be
+    deterministic too: same seed, same schedule, byte for byte."""
+    from .resilience import MAX_CHAOS_STALL_S, ChaosSchedule
+
+    try:
+        s = ChaosSchedule.from_blob(data)
+    except ParquetError:
+        s = None
+    if s is not None:
+        blob = s.to_blob()
+        q = ChaosSchedule.from_blob(blob)  # our own output must readopt
+        assert q == s, "schedule broke round-trip"
+        assert q.to_blob() == blob, "to_blob not stable across round-trip"
+        prev_end = None
+        for p in s.phases:
+            assert p.end > p.start
+            assert prev_end is None or p.start >= prev_end, "overlap"
+            assert not (p.kind == "stall"
+                        and p.stall_s > MAX_CHAOS_STALL_S), "unbounded stall"
+            prev_end = p.end
+        # phase lookup over arbitrary coordinates — never a crash
+        for ordinal in (0, 1, 17, 1 << 20):
+            s.phase_at(ordinal, file_index=ordinal % 3 - 1)
+    # seeded generation: deterministic and self-adopting for ANY params
+    seed = int.from_bytes(data[:4], "little") if len(data) >= 4 else len(data)
+    n = data[4] % 9 if len(data) > 4 else 4
+    files = (data[5] % 4) + 1 if len(data) > 5 else 1
+    g1 = ChaosSchedule.generate(seed, n_phases=n, horizon=128, files=files)
+    g2 = ChaosSchedule.generate(seed, n_phases=n, horizon=128, files=files)
+    assert g1 == g2, "generate() is not deterministic"
+    assert ChaosSchedule.from_blob(g1.to_blob()) == g1
+
+
+def crafted_chaos_blobs() -> "list[bytes]":
+    """Hand-crafted ``chaos_schedule`` inputs (and corpus blobs): a valid
+    generated schedule plus the hostile shapes adoption must reject."""
+    import struct as _struct
+
+    from .resilience import ChaosSchedule
+
+    good = ChaosSchedule.generate(7, n_phases=4, horizon=128, files=3) \
+        .to_blob()
+    head = good[:11]
+
+    def phase(start, end, kind, intensity=1, fidx=0, stall=0.25):
+        return _struct.pack("<IIBBIf", start, end, kind, intensity, fidx,
+                            stall)
+
+    def blob(*phases):
+        return (b"TPQC\x01" + _struct.pack("<IH", 7, len(phases))
+                + b"".join(phases))
+
+    return [
+        good,
+        good[:9],                        # truncated header
+        b"TPQX" + good[4:],              # bad magic
+        b"TPQC\xff" + good[5:],          # unknown version
+        head + b"\x00" * 7,              # length lies about phase count
+        blob(phase(10, 5, 0)),           # end <= start
+        blob(phase(0, 10, 0), phase(5, 20, 1)),   # overlapping phases
+        blob(phase(0, 10, 9)),           # unknown kind
+        blob(phase(0, 10, 0, stall=60.0)),        # unbounded stall
+        blob(phase(0, 10, 0, intensity=0)),       # zero intensity
+        blob(phase(0, 10, 0, stall=float("nan"))),  # NaN smuggle
+    ]
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -884,6 +961,7 @@ TARGETS = {
     "io_ranges": fuzz_io_ranges,
     "page_corrupt": fuzz_page_corrupt,
     "scan_plan": fuzz_scan_plan,
+    "chaos_schedule": fuzz_chaos_schedule,
 }
 
 
@@ -1083,6 +1161,8 @@ def _seed_inputs(target: str) -> list[bytes]:
         return crafted_page_corrupt_blobs()
     if target == "scan_plan":
         return crafted_scan_plan_blobs()
+    if target == "chaos_schedule":
+        return crafted_chaos_blobs()
     if target == "loader_state":
         from .data import checkpoint as ck
 

@@ -49,12 +49,13 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from .errors import ParquetError, RetryExhaustedError, TransientIOError
-from .obs import env_float, env_int, register_flight_source
+from .obs import (LatencyHistogram, env_float, env_int,
+                  register_flight_source)
 
 __all__ = [
     "ByteStore", "CoalescedFetcher", "FaultInjectingStore", "FaultSpec",
     "GenericRangeStore", "IOConfig", "IOStats", "LocalStore", "RetryBudget",
-    "plan_coalesced", "require_full", "resolve_store",
+    "ScanToken", "plan_coalesced", "require_full", "resolve_store",
 ]
 
 # ceiling on one coalesced span: bounds the extra bytes a merged fetch can
@@ -65,6 +66,10 @@ MAX_COALESCED_SPAN = 64 << 20
 # for the REST of the scan (ladder step: the store is evidently unhappy
 # with large reads; stop paying a failed big fetch per row group)
 COALESCE_DISABLE_AFTER = 2
+# minimum successful fetches before the learned (auto) hedge delay trusts
+# the latency histogram's p90 — hedging on a cold histogram would duplicate
+# everything or nothing
+HEDGE_MIN_SAMPLES = 16
 
 
 def require_full(buf: bytes, offset: int, size: int,
@@ -106,6 +111,15 @@ class IOConfig:
       of wheel-spinning, not after retries × chunks sleeps.
     - ``coalesce_gap``   merge adjacent ranges when the hole between them
       is at most this many bytes (0 disables coalescing).
+    - ``hedge_ms``       tail-latency hedging: a fetch still in flight
+      after this many milliseconds gets a duplicate issued, first success
+      wins (``TPQ_IO_HEDGE_MS``).  ``0`` disables (the default — hedging
+      duplicates bytes and must be opted into); ``auto`` (any negative
+      value) learns the delay from the store's own fetch-latency p90 once
+      enough samples exist — "duplicate the slowest decile".
+    - ``hedge_max``      cap on concurrently outstanding hedge duplicates
+      per store (``TPQ_IO_HEDGE_MAX``): a melting store must not be
+      hammered with one duplicate per stuck read.
     """
 
     deadline_s: float = 0.0
@@ -113,15 +127,22 @@ class IOConfig:
     backoff_ms: float = 25.0
     retry_budget: int = 64
     coalesce_gap: int = 1 << 16
+    hedge_ms: float = 0.0
+    hedge_max: int = 4
 
     @classmethod
     def from_env(cls) -> "IOConfig":
+        raw_hedge = os.environ.get("TPQ_IO_HEDGE_MS", "")
+        hedge_ms = (-1.0 if raw_hedge.strip().lower() == "auto"
+                    else env_float("TPQ_IO_HEDGE_MS", 0.0))
         return cls(
             deadline_s=env_float("TPQ_IO_DEADLINE_S", 0.0, lo=0.0),
             retries=env_int("TPQ_IO_RETRIES", 4, lo=0),
             backoff_ms=env_float("TPQ_IO_BACKOFF_MS", 25.0, lo=0.0),
             retry_budget=env_int("TPQ_IO_RETRY_BUDGET", 64, lo=0),
             coalesce_gap=env_int("TPQ_IO_COALESCE_GAP", 1 << 16, lo=0),
+            hedge_ms=hedge_ms,
+            hedge_max=env_int("TPQ_IO_HEDGE_MAX", 4, lo=1),
         )
 
 
@@ -140,6 +161,49 @@ class RetryBudget:
                 return False
             self.spent += 1
             return True
+
+
+class ScanToken:
+    """One scan's lifecycle state on a store: its OWN retry budget,
+    coalescing-degradation state, request deadline, and cancel token.
+
+    ``begin_scan()`` used to reset store-WIDE state, which was wrong the
+    moment two requests shared one store (the serve tier's instance-store
+    form): one request's ``begin_scan`` refreshed the budget another was
+    mid-way through spending, and one flaky request's retries drained
+    everyone's.  Now every scan holds its token and passes it down
+    (``read_range(scan=...)``, :class:`CoalescedFetcher`), so budgets and
+    degradation ladders are request-scoped; the store keeps a default
+    token only for direct single-scan callers.
+
+    ``deadline`` is an absolute ``time.monotonic()`` point the retry loop
+    folds into every attempt's timeout; ``cancel`` is the request's
+    :class:`~tpu_parquet.resilience.CancelToken`, checked between attempts
+    so a cancelled/expired request raises its TYPED verdict instead of
+    burning the transport.
+    """
+
+    __slots__ = ("budget", "deadline", "cancel", "coalesce_failures",
+                 "coalesce_disabled", "_lock")
+
+    def __init__(self, budget: "RetryBudget | None" = None,
+                 deadline: "float | None" = None, cancel=None,
+                 coalesce_disabled: bool = False):
+        self.budget = budget if budget is not None else RetryBudget(0)
+        self.deadline = deadline
+        self.cancel = cancel
+        self.coalesce_failures = 0
+        self.coalesce_disabled = coalesce_disabled
+        self._lock = threading.Lock()
+
+    def note_coalesce_failure(self) -> bool:
+        """Count one failed coalesced span; True when the ladder says this
+        scan should stop planning coalesced fetches."""
+        with self._lock:
+            self.coalesce_failures += 1
+            if self.coalesce_failures >= COALESCE_DISABLE_AFTER:
+                self.coalesce_disabled = True
+            return self.coalesce_disabled
 
 
 class IOStats:
@@ -164,6 +228,16 @@ class IOStats:
         self.coalesced_spans = 0
         self.coalesced_bytes = 0
         self.coalesce_fallbacks = 0
+        # tail-latency hedging (GenericRangeStore._hedged_fetch): issued
+        # duplicates, races the duplicate won, the loser's bytes (paid but
+        # unused — the cost side of the p99 cut), and verified-identity
+        # violations (both sides returned, bytes differed)
+        self.hedges_issued = 0
+        self.hedges_won = 0
+        self.hedges_wasted_bytes = 0
+        self.hedge_mismatches = 0
+        # successful-fetch latency (the learned hedge delay's p90 source)
+        self.fetch_hist = LatencyHistogram()
         # thread ident -> (offset, size, started) of the fetch in flight
         self._inflight: dict[int, tuple[int, int, float]] = {}
 
@@ -221,6 +295,10 @@ class IOStats:
                 "coalesced_spans": self.coalesced_spans,
                 "coalesced_bytes": self.coalesced_bytes,
                 "coalesce_fallbacks": self.coalesce_fallbacks,
+                "hedges_issued": self.hedges_issued,
+                "hedges_won": self.hedges_won,
+                "hedges_wasted_bytes": self.hedges_wasted_bytes,
+                "hedge_mismatches": self.hedge_mismatches,
             }
 
 
@@ -252,7 +330,8 @@ class ByteStore:
     identity_token: "str | None" = None
 
     def read_range(self, offset: int, size: int,
-                   deadline: "float | None" = None) -> bytes:
+                   deadline: "float | None" = None,
+                   scan: "ScanToken | None" = None) -> bytes:
         raise NotImplementedError
 
     def size(self) -> int:
@@ -261,9 +340,14 @@ class ByteStore:
         remote object per read."""
         raise NotImplementedError
 
-    def begin_scan(self) -> None:
-        """Scan boundary hook: resets the per-scan retry budget and the
-        coalescing degradation state (no-op for plain stores)."""
+    def begin_scan(self, deadline: "float | None" = None,
+                   cancel=None) -> "ScanToken | None":
+        """Scan boundary hook: mints this scan's :class:`ScanToken` (its
+        own retry budget + coalescing state, carrying the request's
+        ``deadline``/``cancel``) which the scan passes back on every
+        ``read_range(scan=...)``.  Plain stores return None — a local fd
+        has no retry state to scope."""
+        return None
 
     def abort(self, exc: BaseException) -> None:
         """Poison the store: in-flight and future reads raise ``exc``.
@@ -308,7 +392,8 @@ class LocalStore(ByteStore):
         return self._fd is not None
 
     def read_range(self, offset: int, size: int,
-                   deadline: "float | None" = None) -> bytes:
+                   deadline: "float | None" = None,
+                   scan: "ScanToken | None" = None) -> bytes:
         if self._fd is not None:
             parts = []
             pos = offset
@@ -343,6 +428,49 @@ class LocalStore(ByteStore):
 # ---------------------------------------------------------------------------
 
 _store_seq = iter(range(1, 1 << 62))
+
+
+class _FetchRace:
+    """First-success-wins rendezvous between a primary fetch and its hedge.
+
+    ``settle`` is called by each racer exactly once; the first SUCCESS
+    claims the win and wakes the waiter immediately — the loser drains in
+    the background, its bytes accounted (``hedges_wasted_bytes``) and its
+    payload verified against the winner's (a mismatch means the transport
+    returned different bytes for the same range: ``hedge_mismatches``,
+    the same class of lie the torn-read verifier exists for).  If every
+    racer fails, the waiter wakes with the first error.
+    """
+
+    __slots__ = ("lock", "event", "launched", "resolved", "winner_role",
+                 "winner_buf", "errors")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.event = threading.Event()
+        self.launched = 0
+        self.resolved = 0
+        self.winner_role: "str | None" = None
+        self.winner_buf: "bytes | None" = None
+        self.errors: list = []
+
+    def settle(self, role: str, buf: "bytes | None",
+               err: "BaseException | None", stats: "IOStats") -> None:
+        with self.lock:
+            self.resolved += 1
+            if err is not None:
+                self.errors.append(err)
+            elif self.winner_buf is None:
+                self.winner_role = role
+                self.winner_buf = buf
+                self.event.set()
+            else:
+                # loser success: paid, unused — account and verify
+                stats.add("hedges_wasted_bytes", len(buf))
+                if buf != self.winner_buf:
+                    stats.add("hedge_mismatches")
+            if self.resolved >= self.launched and self.winner_buf is None:
+                self.event.set()
 
 
 class GenericRangeStore(ByteStore):
@@ -383,9 +511,20 @@ class GenericRangeStore(ByteStore):
         self.stats = IOStats()
         self._rng = random.Random(seed)
         self._rng_lock = threading.Lock()
-        self._scan_budget = RetryBudget(self.config.retry_budget)
-        self._coalesce_failures = 0
-        self.coalesce_disabled = self.coalesce_gap <= 0
+        # the default scan token: direct single-scan callers (footer
+        # reads, cache warms) ride it; real scans mint their own via
+        # begin_scan() so concurrent requests never share retry budgets
+        self._default_scan = ScanToken(
+            RetryBudget(self.config.retry_budget),
+            coalesce_disabled=self.coalesce_gap <= 0)
+        # tail-latency hedging state (read_range -> _hedged_fetch): the
+        # semaphore caps outstanding duplicates, the thread set lets
+        # close() reap in-flight racers (loser accounted, never leaked)
+        self._hedge_sem = threading.BoundedSemaphore(
+            max(int(self.config.hedge_max), 1))
+        self._hedges_outstanding = 0  # mirror of the semaphore's deficit
+        self._hedge_threads: "set[threading.Thread]" = set()
+        self._hedge_lock = threading.Lock()
         # watchdog abort plumbing (see ByteStore.abort): checked between
         # attempts, and implementations poll it inside long waits
         self._abort_exc: "BaseException | None" = None
@@ -406,33 +545,171 @@ class GenericRangeStore(ByteStore):
 
     # -- scan lifecycle -------------------------------------------------------
 
-    def begin_scan(self) -> None:
-        self._scan_budget = RetryBudget(self.config.retry_budget)
-        self._coalesce_failures = 0
-        self.coalesce_disabled = self.coalesce_gap <= 0
+    def begin_scan(self, deadline: "float | None" = None,
+                   cancel=None) -> ScanToken:
+        """Mint a fresh :class:`ScanToken` for one scan.  Concurrent scans
+        each hold their own and pass it on every read, so none of them can
+        drain or refresh another's budget.  The store's DEFAULT token (the
+        one scan-less ``read_range`` callers ride) is refreshed to a
+        sibling sharing the new budget but carrying NO deadline/cancel —
+        a footer read or cache warm on a shared store must never inherit
+        some other request's expiry verdict."""
+        if deadline is None and cancel is not None:
+            deadline = getattr(cancel, "deadline", None)
+        token = ScanToken(RetryBudget(self.config.retry_budget),
+                          deadline=deadline, cancel=cancel,
+                          coalesce_disabled=self.coalesce_gap <= 0)
+        self._default_scan = ScanToken(
+            token.budget, coalesce_disabled=self.coalesce_gap <= 0)
         self._abort_exc = None
         self._abort_event.clear()
+        return token
+
+    @property
+    def coalesce_disabled(self) -> bool:
+        """Default-token view of the coalescing ladder (back-compat for
+        callers without a token; token holders read their own)."""
+        return self._default_scan.coalesce_disabled
 
     def abort(self, exc: BaseException) -> None:
         self._abort_exc = exc
         self._abort_event.set()
 
-    def note_coalesce_failure(self) -> None:
+    def note_coalesce_failure(self, scan: "ScanToken | None" = None) -> None:
         """A coalesced span exhausted its retries: after
         ``COALESCE_DISABLE_AFTER`` of these in one scan, stop planning
-        coalesced fetches entirely (ladder: coalesced → single-range)."""
+        coalesced fetches entirely (ladder: coalesced → single-range).
+        Scoped to the failing SCAN's token — one request's unhappy store
+        no longer degrades its neighbors.  The default token mirrors the
+        note so the store-level ``coalesce_disabled`` view (single-scan
+        callers, post-mortem inspection) keeps its pre-token semantics;
+        the next ``begin_scan`` resets it as it always did."""
         self.stats.add("coalesce_fallbacks")
-        self._coalesce_failures += 1
-        if self._coalesce_failures >= COALESCE_DISABLE_AFTER:
-            self.coalesce_disabled = True
+        if scan is not None and scan is not self._default_scan:
+            scan.note_coalesce_failure()
+        self._default_scan.note_coalesce_failure()
+
+    def close(self) -> None:
+        """Reap in-flight hedge racers: every spawned fetch thread is
+        joined (their fetches are bounded by the config deadline/stall
+        caps), so a closed store leaves nothing for the bench leak gate
+        to find."""
+        with self._hedge_lock:
+            racers = list(self._hedge_threads)
+        for t in racers:
+            t.join(timeout=30)
+
+    # -- tail-latency hedging -------------------------------------------------
+
+    def _hedge_delay_s(self) -> "float | None":
+        """The delay after which a slow fetch earns a duplicate: None =
+        hedging off (the default), a fixed ``hedge_ms`` when configured,
+        or the store's own successful-fetch p90 once enough samples exist
+        (``hedge_ms`` < 0 = auto) — "duplicate the slowest decile"."""
+        ms = self.config.hedge_ms
+        if ms == 0:
+            return None
+        if ms > 0:
+            return ms / 1e3
+        hist = self.stats.fetch_hist
+        if hist.count < HEDGE_MIN_SAMPLES:
+            return None
+        p90 = hist.quantile(0.9)
+        return p90 if p90 > 0 else None
+
+    def _spawn_racer(self, race: "_FetchRace", role: str, offset: int,
+                     size: int, timeout: "float | None",
+                     release_sem: bool = False) -> None:
+        with race.lock:
+            race.launched += 1
+
+        def run():
+            stats = self.stats
+            stats.enter(offset, size)  # flight dumps see the racer's range
+            t0 = time.monotonic()
+            try:
+                try:
+                    buf = self._fetch_once(offset, size, timeout)
+                    err = None
+                except BaseException as e:  # noqa: BLE001 — re-raised by loser/winner logic
+                    buf, err = None, e
+            finally:
+                stats.exit()
+            if err is None:
+                stats.fetch_hist.record(time.monotonic() - t0)
+            race.settle(role, buf, err, stats)
+            if release_sem:
+                with self._hedge_lock:
+                    self._hedges_outstanding -= 1
+                self._hedge_sem.release()
+            with self._hedge_lock:
+                self._hedge_threads.discard(threading.current_thread())
+
+        t = threading.Thread(target=run, name="tpq-hedge", daemon=True)
+        with self._hedge_lock:
+            self._hedge_threads.add(t)
+        t.start()
+
+    def _hedged_fetch(self, offset: int, size: int,
+                      timeout: "float | None", delay: float) -> bytes:
+        """One hedged attempt: the primary fetch runs on a racer thread;
+        if it is still out after ``delay`` (and the hedge cap has room), a
+        duplicate is issued — first SUCCESS wins, the loser is drained in
+        the background with its bytes accounted (``hedges_wasted_bytes``)
+        and its payload verified against the winner's
+        (``hedge_mismatches``), never leaked (close() joins racers)."""
+        race = _FetchRace()
+        self._spawn_racer(race, "primary", offset, size, timeout)
+        if not race.event.wait(delay):
+            if self._hedge_sem.acquire(blocking=False):
+                with self._hedge_lock:
+                    self._hedges_outstanding += 1
+                self.stats.add("hedges_issued")
+                self._spawn_racer(race, "hedge", offset, size, timeout,
+                                  release_sem=True)
+        race.event.wait()  # first success, or every racer failed
+        with race.lock:
+            if race.winner_buf is not None:
+                if race.winner_role == "hedge":
+                    self.stats.add("hedges_won")
+                return race.winner_buf
+            raise race.errors[0]
 
     # -- the retry loop -------------------------------------------------------
 
+    def _fetch(self, offset: int, size: int,
+               timeout: "float | None") -> bytes:
+        """One attempt, hedged when the store has a hedge delay (see
+        :meth:`_hedged_fetch`); the plain direct call otherwise.  The
+        racer path costs one thread spawn per attempt, so it is skipped
+        outright while the hedge cap is saturated — a fetch that could
+        not earn a duplicate anyway must not pay the race overhead."""
+        delay = self._hedge_delay_s()
+        if delay is None or \
+                self._hedges_outstanding >= self.config.hedge_max:
+            t0 = time.monotonic()
+            buf = self._fetch_once(offset, size, timeout)
+            self.stats.fetch_hist.record(time.monotonic() - t0)
+            return buf
+        return self._hedged_fetch(offset, size, timeout, delay)
+
     def read_range(self, offset: int, size: int,
-                   deadline: "float | None" = None) -> bytes:
+                   deadline: "float | None" = None,
+                   scan: "ScanToken | None" = None) -> bytes:
         cfg = self.config
-        if deadline is None and cfg.deadline_s > 0:
-            deadline = time.monotonic() + cfg.deadline_s
+        if scan is None:
+            scan = self._default_scan
+        # the binding deadline is the TIGHTEST of: the caller's explicit
+        # point, the scan token's request deadline, and the store's
+        # per-request config ceiling
+        if cfg.deadline_s > 0:
+            cfg_deadline = time.monotonic() + cfg.deadline_s
+            deadline = (cfg_deadline if deadline is None
+                        else min(deadline, cfg_deadline))
+        if scan.deadline is not None:
+            deadline = (scan.deadline if deadline is None
+                        else min(deadline, scan.deadline))
+        cancel = scan.cancel
         attempts: list[dict] = []
         torn_prefix: "bytes | None" = None
         backoff = cfg.backoff_ms / 1e3
@@ -442,6 +719,11 @@ class GenericRangeStore(ByteStore):
             for attempt in range(cfg.retries + 1):
                 if self._abort_exc is not None:
                     raise self._abort_exc
+                if cancel is not None:
+                    # typed per-request verdict (DeadlineExceededError /
+                    # CancelledError) — an expired or cancelled request
+                    # stops issuing transport attempts right here
+                    cancel.check()
                 t0 = time.monotonic()
                 try:
                     timeout = None
@@ -452,7 +734,7 @@ class GenericRangeStore(ByteStore):
                                 f"deadline exceeded before attempt "
                                 f"{attempt} of range [{offset}, "
                                 f"{offset + size})")
-                    buf = self._fetch_once(offset, size, timeout)
+                    buf = self._fetch(offset, size, timeout)
                     if len(buf) == size and offset + size > self.size():
                         # a full-length response for a range that provably
                         # extends past EOF is fabricated bytes (a store
@@ -496,6 +778,10 @@ class GenericRangeStore(ByteStore):
                         # the watchdog fired mid-attempt: its error (with
                         # the dump path) outranks the transport's
                         raise self._abort_exc from e
+                    if cancel is not None:
+                        # an expired/cancelled request's typed verdict
+                        # outranks the transport error its expiry caused
+                        cancel.check()
                     stats.add("transient_errors")
                     attempts.append({
                         "attempt": attempt,
@@ -521,12 +807,12 @@ class GenericRangeStore(ByteStore):
                             f"after {attempt + 1} attempt(s): {e}",
                             attempts=attempts, offset=offset, size=size,
                         ) from e
-                    if not self._scan_budget.spend():
+                    if not scan.budget.spend():
                         stats.add("exhausted")
                         raise RetryExhaustedError(
                             f"range [{offset}, {offset + size}): per-scan "
                             f"retry budget "
-                            f"({self._scan_budget.max_retries}) exhausted",
+                            f"({scan.budget.max_retries}) exhausted",
                             attempts=attempts, offset=offset, size=size,
                         ) from e
                     # decorrelated jitter: sleep ~U(base, prev*3), capped
@@ -624,17 +910,31 @@ class FaultInjectingStore(GenericRangeStore):
         """Unblock every current and future injected stall."""
         self._unstall.set()
 
+    def close(self) -> None:
+        # stalls die with the store: close() must never leave a racer (or
+        # a test teardown) waiting out a full stall_s
+        self.release()
+        super().close()
+
     def size(self) -> int:
         return self.inner.size()
 
+    def _spec_for(self, offset: int, size: int, attempt: int) -> FaultSpec:
+        """The spec governing one fetch attempt.  The base store's spec is
+        static; :class:`~tpu_parquet.resilience.ChaosSchedule` subclasses
+        override this to drive PHASES (stall storms, transient bursts,
+        per-file blackouts) off a shared read-ordinal clock."""
+        return self.spec
+
     def _fetch_once(self, offset: int, size: int,
                     timeout: "float | None") -> bytes:
-        spec = self.spec
-        if spec.match is not None and not spec.match(offset, size):
+        if (self.spec.match is not None
+                and not self.spec.match(offset, size)):
             return self.inner.read_range(offset, size)
         with self._attempts_lock:
             n = self._attempts.get(offset, 0)
             self._attempts[offset] = n + 1
+        spec = self._spec_for(offset, size, n)
         if spec.latency_s > 0:
             wait = spec.latency_s
             if timeout is not None and wait > timeout:
@@ -749,8 +1049,10 @@ class CoalescedFetcher:
 
     def __init__(self, store: ByteStore, ranges,
                  gap: "int | None" = None,
-                 max_span: int = MAX_COALESCED_SPAN):
+                 max_span: int = MAX_COALESCED_SPAN,
+                 scan: "ScanToken | None" = None):
         self.store = store
+        self.scan = scan  # the owning scan's token: budget + ladder scope
         g = store.coalesce_gap if gap is None else gap
         self._by_member: dict[tuple, _Group] = {}
         for grp in plan_coalesced(ranges, g, max_span):
@@ -763,11 +1065,12 @@ class CoalescedFetcher:
     def read(self, offset: int, size: int) -> bytes:
         grp = self._by_member.get((offset, size))
         if grp is None:
-            return self.store.read_range(offset, size)
+            return self.store.read_range(offset, size, scan=self.scan)
         with grp.lock:
             if grp.buf is None and not grp.degraded:
                 try:
-                    buf = self.store.read_range(grp.offset, grp.size)
+                    buf = self.store.read_range(grp.offset, grp.size,
+                                                scan=self.scan)
                     if len(buf) != grp.size:
                         # short span: EOF mid-group or a lying store —
                         # per-member reads diagnose precisely
@@ -785,7 +1088,7 @@ class CoalescedFetcher:
                     note = getattr(self.store, "note_coalesce_failure",
                                    None)
                     if note is not None:
-                        note()
+                        note(self.scan)
             if grp.buf is not None:
                 lo = offset - grp.offset
                 out = grp.buf[lo: lo + size]
@@ -796,7 +1099,7 @@ class CoalescedFetcher:
         # degraded: individual single-range fetch (outside the group lock,
         # so members recover in parallel); its own retries still apply, and
         # ITS failure is the ladder's final rung — the error propagates
-        return self.store.read_range(offset, size)
+        return self.store.read_range(offset, size, scan=self.scan)
 
 
 # ---------------------------------------------------------------------------

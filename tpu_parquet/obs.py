@@ -1143,6 +1143,9 @@ _MERGE_MAXED = frozenset((
     # names are serve-specific — a generic "bytes" here would max the
     # device section's h2d byte FLOW)
     "queue_depth_peak", "held_bytes", "capacity_bytes", "entries",
+    # circuit-breaker gauge: circuits open RIGHT NOW on one board — two
+    # snapshots of the same board must not sum
+    "open_now",
 ))
 # ratios/rates derived from the flows: summing them is meaningless (four
 # files' overlap_efficiency is not their sum) — the merge drops them and
@@ -1622,6 +1625,11 @@ DOCTOR_VERDICTS = {
 # model enough that re-running with the recalibrated TPQ_LINK_MBPS is the
 # next step (inside it, re-banking changes no route choice worth chasing)
 DOCTOR_ERROR_BAND = (0.8, 1.25)
+# hedging advisory thresholds: below this many issued hedges the win rate
+# is noise; below this win rate with wasted bytes on the books the hedge
+# delay is mis-set (too aggressive) and doctor says so
+HEDGE_VERDICT_MIN_ISSUED = 8
+HEDGE_VERDICT_MIN_WIN_RATE = 0.2
 
 
 def doctor_registry(tree: dict) -> "dict | None":
@@ -1743,6 +1751,36 @@ def doctor_registry(tree: dict) -> "dict | None":
             from .ship import recalibrate_device_mbps
 
             out["recalibrate_device_mbps"] = recalibrate_device_mbps(dev_bps)
+    circ = serve.get("circuit")
+    circ = circ if isinstance(circ, dict) else {}
+    if g(circ, "open_now") > 0:
+        # a tripped breaker names its file: the operator's next step is
+        # the FILE (quarantine/replace it), not the service's tuning
+        out["circuit_open"] = {
+            "verdict": "circuit-open",
+            "files": [str(f) for f in (circ.get("open_files") or [])],
+            "fast_fails": int(g(circ, "fast_fails")),
+            "opened": int(g(circ, "opened") + g(circ, "reopened")),
+        }
+    io_sec = tree.get("io")
+    io_sec = io_sec if isinstance(io_sec, dict) else {}
+    hedges_issued = g(io_sec, "hedges_issued")
+    if hedges_issued >= HEDGE_VERDICT_MIN_ISSUED:
+        hedges_won = g(io_sec, "hedges_won")
+        wasted = g(io_sec, "hedges_wasted_bytes")
+        win_rate = hedges_won / hedges_issued
+        if win_rate < HEDGE_VERDICT_MIN_WIN_RATE and wasted > 0:
+            # duplicates were paid but the primary almost always won the
+            # race anyway: the hedge delay is below the real p90 —
+            # raise TPQ_IO_HEDGE_MS (or let auto re-learn) before the
+            # wasted bytes outweigh the tail they were buying down
+            out["hedge"] = {
+                "verdict": "hedge-ineffective",
+                "issued": int(hedges_issued),
+                "won": int(hedges_won),
+                "win_rate": round(win_rate, 3),
+                "wasted_bytes": int(wasted),
+            }
     fb = reader.get("ship_feedback")
     routes = (fb or {}).get("routes") or {}
     if routes:
@@ -1926,6 +1964,11 @@ def autopsy_dump(doc: dict) -> dict:
                                "path": oldest[1].get("path"),
                                "age_s": oldest[1].get("age_s")}
                               if oldest is not None else None),
+            # open circuits at dump time (BreakerBoard.open_files shape):
+            # the verdict names the first file when nothing more specific
+            # explains the dump
+            "circuit_open": [c for c in (sv.get("circuit_open") or [])
+                             if isinstance(c, dict) and c.get("file")],
         }
     # the rule table, most specific first.  Data corruption never hangs —
     # an explicit data-integrity error (or quarantined failures on a crash
@@ -1978,6 +2021,16 @@ def autopsy_dump(doc: dict) -> dict:
         cause = (f"lane {stalled_first!r} stopped advancing first with no "
                  f"classified blocked thread — likely stuck in user code or "
                  f"a long single unit of work")
+    elif serve_state and serve_state.get("circuit_open"):
+        # nothing wedged or corrupt, but circuits are open: the dump's
+        # most actionable fact is WHICH file keeps failing
+        first_open = serve_state["circuit_open"][0]
+        verdict = "circuit-open"
+        cause = (f"circuit open for {first_open['file']!r} "
+                 f"(next probe in {first_open.get('retry_after_s', '?')}s)"
+                 f" — the file keeps failing its requests; inspect or "
+                 f"replace it (pq_tool quarantine shows contained errors), "
+                 f"healthy files are unaffected")
     else:
         verdict = "inconclusive"
         cause = ("no blocked thread classified and no stalled lane recorded"

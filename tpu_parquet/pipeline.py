@@ -271,6 +271,14 @@ class SharedReader:
 
             store = LocalStore(f)
         self.store = store
+        # the CURRENT scan's iostore.ScanToken (set by the reader at each
+        # scan boundary): rides every pread so a store shared between
+        # concurrent requests charges THIS scan's retry budget and honors
+        # THIS request's deadline/cancel — never a neighbor's
+        self._scan = None
+
+    def set_scan(self, token) -> None:
+        self._scan = token
 
     @property
     def parallel(self) -> bool:
@@ -284,6 +292,8 @@ class SharedReader:
         return _PReadFile(self)
 
     def pread(self, offset: int, size: int) -> bytes:
+        if self._scan is not None:
+            return self.store.read_range(offset, size, scan=self._scan)
         return self.store.read_range(offset, size)
 
 
@@ -312,6 +322,7 @@ def prefetch_map(
     budget: Optional[InFlightBudget] = None,
     cost: Optional[Callable[[T], int]] = None,
     stats: Optional[PipelineStats] = None,
+    cancel=None,
 ) -> Iterator[R]:
     """Ordered overlapped map: run ``fn`` over ``items`` on a bounded pool.
 
@@ -328,11 +339,19 @@ def prefetch_map(
     wait happens only with nothing in flight (the oversize-item case, which
     :class:`InFlightBudget` admits alone).
 
+    ``cancel`` (a :class:`~tpu_parquet.resilience.CancelToken`) is checked
+    at every unit boundary — before each submission and each yield — so a
+    cancelled or deadline-expired request stops issuing new work, raises
+    its TYPED verdict at the consumer, and still runs the full cleanup
+    path (window drained, budget released, pool joined: nothing orphaned).
+
     ``prefetch <= 0`` degrades to a plain sequential map with zero threads —
     the bit-identical baseline the tests compare against.
     """
     if prefetch <= 0:
         for item in items:
+            if cancel is not None:
+                cancel.check()
             yield fn(item)
         return
 
@@ -361,6 +380,11 @@ def prefetch_map(
     try:
         exhausted = False
         while True:
+            if cancel is not None:
+                # the unit-boundary gate: stop issuing new IO the moment
+                # the request is cancelled/expired; the finally below still
+                # drains the window and releases every charged byte
+                cancel.check()
             while not exhausted and len(pending) < prefetch:
                 if carried is None:
                     try:
@@ -375,7 +399,7 @@ def prefetch_map(
                         if pending:
                             break  # drain the head; its release frees room
                         t0 = time.perf_counter()
-                        budget.acquire(c)
+                        budget.acquire(c, cancel=cancel)
                         if stats is not None:
                             stats.add_stall(time.perf_counter() - t0, t0)
                     if stats is not None:

@@ -74,6 +74,7 @@ class FileReader:
         quarantine=None,
         plan=None,
         dict_cache=None,
+        cancel=None,
     ):
         from .obs import resolve_tracer
         from .quarantine import Quarantine, resolve_validate
@@ -99,6 +100,11 @@ class FileReader:
         # DeviceFileReader's host half) so the budget and ledger are global
         self.quarantine = (quarantine if quarantine is not None
                            else Quarantine(on_data_error))
+        # per-request lifecycle token (resilience.CancelToken): its
+        # deadline/cancellation is checked at every unit boundary and
+        # rides the scan token into every store read — the serve tier's
+        # deadline-propagation contract
+        self._cancel = cancel
         validate_crc = resolve_validate(validate_crc)
         try:
             self.metadata = (metadata if metadata is not None
@@ -295,7 +301,12 @@ class FileReader:
         budget = InFlightBudget(self.alloc.max_size)
         sr = self._sr
         store = self._store
-        store.begin_scan()  # fresh per-scan retry budget + coalescing state
+        # fresh per-scan retry budget + coalescing state, scoped to THIS
+        # scan's token (a store shared between concurrent requests never
+        # shares budgets); the request deadline/cancel rides it into every
+        # read_range
+        scan_tok = store.begin_scan(cancel=self._cancel)
+        sr.set_scan(scan_tok)
         q = self.quarantine
         contain = contain and q.contains
         if contain:
@@ -324,12 +335,15 @@ class FileReader:
                 # (remote/fault-injecting; the local path pays nothing,
                 # not even the range collection below)
                 if (store.prefers_coalescing
-                        and not store.coalesce_disabled and len(items) > 1):
+                        and not (scan_tok.coalesce_disabled
+                                 if scan_tok is not None
+                                 else store.coalesce_disabled)
+                        and len(items) > 1):
                     ranges = []
                     for it in items:
                         _md, offset = validate_chunk_meta(it[2], it[3])
                         ranges.append((offset, _md.total_compressed_size))
-                    fetcher = CoalescedFetcher(store, ranges)
+                    fetcher = CoalescedFetcher(store, ranges, scan=scan_tok)
                     for it in items:
                         it[4] = fetcher
                 pending[i] = {
@@ -386,7 +400,7 @@ class FileReader:
         stats.touch_wall()
         for i, name, cd in prefetch_map(gen_items(), decode_item, k,
                                         budget=budget, cost=chunk_cost,
-                                        stats=stats):
+                                        stats=stats, cancel=self._cancel):
             slot = pending[i]
             if name is not None:
                 if isinstance(cd, _ChunkFailed):
@@ -456,13 +470,15 @@ class FileReader:
         # unit on this path is one row group (a looser retry-budget bound
         # than the pipelined whole-iteration scan, but bounded) — and a
         # watchdog abort from a previous scan never poisons this one.
-        self._store.begin_scan()
+        self._sr.set_scan(self._store.begin_scan(cancel=self._cancel))
         f = self._sr.as_file()
         # the one shared footer walk (scanplan.py): unselected chunks'
         # bytes are never read (skipChunk parity)
         from .scanplan import row_group_chunks
 
         for path, leaf, chunk, md, offset in row_group_chunks(rg, by_path):
+            if self._cancel is not None:
+                self._cancel.check()  # unit boundary: stop issuing new IO
             out[".".join(path)] = read_chunk(
                 f, chunk, leaf,
                 validate_crc=self.validate_crc, alloc=self.alloc,

@@ -15,9 +15,11 @@ queue-wait dominates.
 """
 
 from .cache import BoundDictCache, CacheStats, PlanCache
-from .service import ScanRequest, ScanService, ScanTicket, ServeStats
+from .service import (PRIORITY_HIGH, PRIORITY_LOW, PRIORITY_NORMAL,
+                      ScanRequest, ScanService, ScanTicket, ServeStats)
 
 __all__ = [
     "BoundDictCache", "CacheStats", "PlanCache",
+    "PRIORITY_HIGH", "PRIORITY_LOW", "PRIORITY_NORMAL",
     "ScanRequest", "ScanService", "ScanTicket", "ServeStats",
 ]

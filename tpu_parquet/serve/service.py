@@ -37,14 +37,31 @@ import itertools
 import queue
 import threading
 import time
+import weakref
 
 from ..alloc import InFlightBudget
-from ..errors import OverloadError
-from ..obs import (LatencyHistogram, env_int, register_flight_source,
-                   resolve_hang_s)
+from ..errors import (CancelledError, DeadlineExceededError, HangError,
+                      OverloadError, ParquetError, RetryExhaustedError,
+                      TransientIOError)
+from ..obs import (LatencyHistogram, env_float, env_int,
+                   register_flight_source, resolve_hang_s)
+from ..resilience import BreakerBoard, CancelToken
 from .cache import BoundDictCache, PlanCache
 
 __all__ = ["ScanRequest", "ScanService", "ScanTicket", "ServeStats"]
+
+# request priority bands (ScanRequest.priority): brownout sheds from the
+# bottom up — LOW goes first, NORMAL under deeper pressure, HIGH only when
+# the queue is physically full
+PRIORITY_LOW, PRIORITY_NORMAL, PRIORITY_HIGH = 0, 1, 2
+
+# the failure classes a circuit breaker counts: transport exhaustion,
+# transient-surfaced faults, malformed data, and transport wedges — all
+# properties of the FILE/STORE, not of the caller (deadline expiry and
+# caller cancellation are deliberately absent: an impatient client must
+# never open a healthy file's circuit)
+_CLASSIFIED_FAILURES = (RetryExhaustedError, TransientIOError, ParquetError,
+                        HangError)
 
 _req_ids = itertools.count(1)
 
@@ -75,14 +92,24 @@ class ScanRequest:
     ``DeviceFileReader`` (host ``FileReader`` otherwise — the fixed shape
     of a batched response is the loader's job; this service returns the
     reader's columnar output per file).
+
+    ``deadline_s`` is the request's END-TO-END budget (queue wait
+    included): when it expires the request stops issuing new IO at the
+    next unit boundary, frees its admission-budget charge, and raises
+    :class:`~tpu_parquet.errors.DeadlineExceededError` for this caller
+    only.  ``priority`` (:data:`PRIORITY_LOW` / ``NORMAL`` / ``HIGH``)
+    feeds brownout shedding: under ``TPQ_SERVE_BROWNOUT`` pressure the
+    low band is shed first with a drain-rate ``retry_after_s`` hint while
+    high-priority traffic still admits.
     """
 
     __slots__ = ("paths", "columns", "filter", "prefetch", "device",
-                 "validate_crc")
+                 "validate_crc", "deadline_s", "priority")
 
     def __init__(self, paths, columns=None, filter=None,  # noqa: A002
                  prefetch: int = 0, device: bool = False,
-                 validate_crc=None):
+                 validate_crc=None, deadline_s: "float | None" = None,
+                 priority: int = PRIORITY_NORMAL):
         import os
 
         self.paths = ([paths] if isinstance(paths, (str, bytes, os.PathLike))
@@ -92,17 +119,23 @@ class ScanRequest:
         self.prefetch = int(prefetch)
         self.device = bool(device)
         self.validate_crc = validate_crc
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.priority = min(max(int(priority), PRIORITY_LOW), PRIORITY_HIGH)
 
 
 class ScanTicket:
     """The admission receipt: ``result(timeout)`` blocks for the response
-    (re-raising the request's failure), ``done()`` polls."""
+    (re-raising the request's failure), ``done()`` polls, ``cancel()``
+    takes the request back — it stops issuing new IO at the next unit
+    boundary, releases what it held, and ``result()`` raises
+    :class:`~tpu_parquet.errors.CancelledError`."""
 
-    __slots__ = ("id", "_event", "_result", "_exc", "queue_wait_s",
+    __slots__ = ("id", "token", "_event", "_result", "_exc", "queue_wait_s",
                  "exec_s")
 
-    def __init__(self, rid: int):
+    def __init__(self, rid: int, token: "CancelToken | None" = None):
         self.id = rid
+        self.token = token if token is not None else CancelToken()
         self._event = threading.Event()
         self._result = None
         self._exc: "BaseException | None" = None
@@ -111,6 +144,14 @@ class ScanTicket:
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def cancel(self) -> None:
+        """Cancel this request (idempotent; the first cause wins).  A
+        queued request fails the moment a worker picks it up; an executing
+        one stops at its next unit boundary — either way its budget bytes
+        release and no new IO is issued."""
+        self.token.cancel(CancelledError(
+            f"scan request #{self.id} cancelled by caller"))
 
     def result(self, timeout: "float | None" = None):
         if not self._event.wait(timeout):
@@ -139,6 +180,18 @@ class ServeStats:
         self.exec_seconds = 0.0
         self.rows = 0
         self.queue_depth_peak = 0
+        # request-lifecycle outcomes (subsets of `failed`).  Accounting
+        # contract: `submitted` counts ADMITTED requests only; `rejected`
+        # counts never-admitted ones (queue-full + brownout sheds, which
+        # never enter `submitted`) plus close()-drained tickets (which
+        # do) — so admitted work reconciles as submitted == completed +
+        # failed + drained, while sheds/fast-rejects stand apart as the
+        # load the service refused at the door.
+        self.deadline_exceeded = 0
+        self.cancelled = 0
+        # brownout sheds by priority band (subsets of `rejected`)
+        self.shed_low = 0
+        self.shed_normal = 0
 
     def as_dict(self) -> dict:
         with self.lock:
@@ -151,6 +204,9 @@ class ServeStats:
                 "exec_seconds": round(self.exec_seconds, 6),
                 "rows": self.rows,
                 "queue_depth_peak": self.queue_depth_peak,
+                "deadline_exceeded": self.deadline_exceeded,
+                "cancelled": self.cancelled,
+                "sheds": {"low": self.shed_low, "normal": self.shed_normal},
             }
 
 
@@ -161,7 +217,11 @@ class ScanService:
     def __init__(self, concurrency: "int | None" = None,
                  queue_depth: "int | None" = None, max_memory: int = 0,
                  cache: "PlanCache | None" = None, store=None,
-                 hang_s=None, validate_crc=None):
+                 hang_s=None, validate_crc=None,
+                 brownout: "float | None" = None,
+                 breakers: "BreakerBoard | None" = None):
+        from ..iostore import ByteStore
+
         if concurrency is None:
             concurrency = env_int("TPQ_SERVE_CONCURRENCY", 4, lo=1)
         if queue_depth is None:
@@ -169,9 +229,53 @@ class ScanService:
         self.concurrency = int(concurrency)
         self.cache = cache if cache is not None else PlanCache()
         self.stats = ServeStats()
-        self._store = store  # per-file ByteStore factory (iostore contract)
         self._hang_s = hang_s
         self._validate_crc = validate_crc
+        # brownout load shedding: when queue occupancy or held budget
+        # bytes cross this fraction, low-priority requests shed with a
+        # drain-rate retry_after_s; halfway from there to full, normal
+        # priority sheds too — high admits until the queue is physically
+        # full.  0 disables.
+        self.brownout = (env_float("TPQ_SERVE_BROWNOUT", 0.85, lo=0.0,
+                                   hi=1.0)
+                         if brownout is None else float(brownout))
+        # per-file circuit breakers keyed by the PlanCache generation key
+        # (a rewritten file starts with a clean breaker)
+        self.breakers = breakers if breakers is not None else BreakerBoard()
+        # per-file ByteStore factory (iostore contract), wrapped so the
+        # service can fold every created store's IOStats (retries, hedges)
+        # into its own registry tree.  Live stores are WEAKLY held (they
+        # stay collectable), and each factory store's counters are folded
+        # into a service-owned aggregate when its reader CLOSES it —
+        # without the fold, a completed request's stats would be
+        # garbage-collected with its store and the io section would
+        # report zeros for all finished work.
+        self._served_stores: "weakref.WeakSet" = weakref.WeakSet()
+        self._io_agg: dict = {}
+        self._io_agg_lock = threading.Lock()
+        if store is None:
+            self._store = None
+        elif isinstance(store, ByteStore):
+            self._store = store
+            if store.stats is not None:
+                self._served_stores.add(store)
+        elif callable(store):
+            def _capturing_factory(f, _orig=store):
+                st = _orig(f)
+                if getattr(st, "stats", None) is not None:
+                    self._served_stores.add(st)
+                    orig_close = st.close
+
+                    def _close_and_fold(_st=st, _close=orig_close):
+                        _close()
+                        self._fold_io(_st)
+
+                    st.close = _close_and_fold
+                return st
+
+            self._store = _capturing_factory
+        else:
+            self._store = store  # resolve_store raises its typed error
         # admission: bounded queue (fast-reject) + shared memory budget
         # (backpressure between ADMITTED requests, charged from the plan
         # IR's byte estimate before any byte is read)
@@ -201,10 +305,64 @@ class ScanService:
 
     # -- submission ------------------------------------------------------------
 
+    def _occupancy(self) -> float:
+        """Admission pressure in [0, 1]: the FULLER of the request queue
+        and the in-flight memory budget (either one saturating is the
+        brownout signal — a deep queue of tiny requests and a shallow
+        queue of huge ones both mean new work will wait)."""
+        q_frac = self._q.qsize() / self._q.maxsize if self._q.maxsize else 0.0
+        b = self._budget
+        b_frac = (b.held / b.max_bytes) if b.max_bytes > 0 else 0.0
+        return max(q_frac, min(b_frac, 1.0))
+
+    def _retry_after_s(self) -> float:
+        """Back-off hint from the observed drain rate: roughly how long
+        until the current backlog clears one worker slot (floored so a
+        cold service never tells a caller to retry in 0 seconds)."""
+        with self.stats.lock:
+            completed = self.stats.completed
+            exec_s = self.stats.exec_seconds
+        avg = (exec_s / completed) if completed else 0.05
+        backlog = self._q.qsize() + len(self._inflight)
+        return round(max(backlog * avg / max(self.concurrency, 1), 0.05), 3)
+
+    def _maybe_shed(self, request: ScanRequest) -> None:
+        """Brownout gate: shed low-priority work at ``brownout``
+        occupancy and normal-priority work halfway from there to full —
+        graceful degradation instead of a cliff, with the shed caller
+        handed ``retry_after_s`` and the admission snapshot."""
+        if self.brownout <= 0 or request.priority >= PRIORITY_HIGH:
+            return
+        occ = self._occupancy()
+        threshold = self.brownout
+        if request.priority >= PRIORITY_NORMAL:
+            threshold = self.brownout + (1.0 - self.brownout) / 2
+        if occ < threshold:
+            return
+        with self.stats.lock:
+            self.stats.rejected += 1
+            if request.priority <= PRIORITY_LOW:
+                self.stats.shed_low += 1
+            else:
+                self.stats.shed_normal += 1
+            inflight = len(self._inflight)
+        band = "low" if request.priority <= PRIORITY_LOW else "normal"
+        raise OverloadError(
+            f"scan service browning out ({occ:.0%} occupancy >= "
+            f"{threshold:.0%}): shedding {band}-priority work",
+            queue_depth=self._q.qsize(), in_flight=inflight,
+            retry_after_s=self._retry_after_s(),
+            shed_priority=request.priority)
+
     def submit(self, request: ScanRequest) -> ScanTicket:
         """Admit one request; raises :class:`OverloadError` IMMEDIATELY
-        when the queue is full (load shedding, never a blocked caller)."""
-        ticket = ScanTicket(next(_req_ids))
+        when the queue is full (load shedding, never a blocked caller) or
+        when brownout sheds this priority band (``retry_after_s`` set).
+        The returned ticket's ``cancel()`` and the request's
+        ``deadline_s`` both flow into every downstream read."""
+        ticket = ScanTicket(next(_req_ids),
+                            CancelToken.with_timeout(request.deadline_s))
+        self._maybe_shed(request)
         try:
             with self._submit_lock:
                 if self._closed:
@@ -217,7 +375,8 @@ class ScanService:
             raise OverloadError(
                 f"scan service overloaded: queue full "
                 f"({self._q.maxsize} queued, {inflight} in flight)",
-                queue_depth=self._q.maxsize, in_flight=inflight) from None
+                queue_depth=self._q.maxsize, in_flight=inflight,
+                retry_after_s=self._retry_after_s()) from None
         with self.stats.lock:
             self.stats.submitted += 1
             self.stats.queue_depth_peak = max(self.stats.queue_depth_peak,
@@ -244,7 +403,10 @@ class ScanService:
             with self._inflight_lock:
                 self._inflight[ticket.id] = (str(first), t_start)
             try:
-                result, exc = self._execute(request), None
+                # a request that expired (or was cancelled) while queued
+                # fails HERE, typed, before any byte is charged or read
+                ticket.token.check()
+                result, exc = self._execute(request, ticket.token), None
             except BaseException as e:  # noqa: BLE001 — delivered to caller
                 result, exc = None, e
             # ALL bookkeeping lands before _finish sets the ticket's event:
@@ -261,6 +423,10 @@ class ScanService:
                 self.stats.exec_seconds += ticket.exec_s
                 if exc is not None:
                     self.stats.failed += 1
+                    if isinstance(exc, DeadlineExceededError):
+                        self.stats.deadline_exceeded += 1
+                    elif isinstance(exc, CancelledError):
+                        self.stats.cancelled += 1
                 else:
                     self.stats.completed += 1
                     self.stats.rows += _count_rows(result)
@@ -268,6 +434,17 @@ class ScanService:
                 ticket._finish(exc=exc)
             else:
                 ticket._finish(result=result)
+
+    def _fold_io(self, store) -> None:
+        """Bank a closing store's IOStats into the service aggregate (the
+        registry io section's durable half) and drop it from the live
+        view so obs_registry never double-counts it."""
+        from ..obs import _merge_num_tree
+
+        d = store.stats.as_dict()
+        self._served_stores.discard(store)
+        with self._io_agg_lock:
+            _merge_num_tree(self._io_agg, d)
 
     def _resolve_filter(self, request: ScanRequest):
         flt = request.filter
@@ -277,51 +454,69 @@ class ScanService:
             return parse_filter(flt)
         return flt
 
-    def _execute(self, request: ScanRequest) -> dict:
-        """Run one request over the shared cache: per file, read the
-        footer/plan through it, charge the plan's byte estimate against
-        the admission budget, then scan with a plan-replaying reader.
-        Returns ``{path: {column: ColumnData}}`` in request order."""
+    def _execute(self, request: ScanRequest,
+                 token: "CancelToken | None" = None) -> dict:
+        """Run one request over the shared cache: per file, gate on the
+        file's circuit breaker, read the footer/plan through the cache,
+        charge the plan's byte estimate against the admission budget, then
+        scan with a plan-replaying reader carrying the request's cancel
+        token.  Returns ``{path: {column: ColumnData}}`` in request order.
+
+        Classified failures (transport exhaustion, malformed data, wedges)
+        are noted against the file's breaker so a persistently-failing
+        file fast-fails future requests; deadline/cancel verdicts are NOT
+        — an impatient caller never opens a healthy file's circuit."""
         from ..reader import FileReader
 
         pred = self._resolve_filter(request)
         out: dict = {}
         for path in request.paths:
+            if token is not None:
+                token.check()  # file boundary: stop before the next file
             key = self.cache.file_key(path)
-            meta, schema = self.cache.footer(path)
-            plan = self.cache.plan(key, request.columns, pred,
-                                   meta=meta, schema=schema)
-            charge = min(plan.estimated_bytes(),
-                         max(self._budget.max_bytes, 0)) \
-                if self._budget.max_bytes > 0 else 0
-            if charge:
-                self._budget.acquire(charge)
+            bkey = key if key is not None else ("path", str(path))
+            self.breakers.admit(bkey, str(path))
             try:
-                kw = dict(columns=request.columns, metadata=meta,
-                          row_filter=pred, prefetch=request.prefetch,
-                          validate_crc=(request.validate_crc
-                                        if request.validate_crc is not None
-                                        else self._validate_crc),
-                          store=self._store, plan=plan,
-                          dict_cache=BoundDictCache(self.cache, key))
-                if request.device:
-                    from ..device_reader import DeviceFileReader
-
-                    with DeviceFileReader(path, hang_s=self._hang_s,
-                                          **kw) as r:
-                        cols: dict = {}
-                        for group in r.iter_row_groups():
-                            for name, cd in group.items():
-                                cols.setdefault(name, []).append(cd)
-                        out[str(path)] = {
-                            name: parts[0] if len(parts) == 1 else parts
-                            for name, parts in cols.items()}
-                else:
-                    with FileReader(path, **kw) as r:
-                        out[str(path)] = self._read_watched(r)
-            finally:
+                meta, schema = self.cache.footer(path)
+                plan = self.cache.plan(key, request.columns, pred,
+                                       meta=meta, schema=schema)
+                charge = min(plan.estimated_bytes(),
+                             max(self._budget.max_bytes, 0)) \
+                    if self._budget.max_bytes > 0 else 0
                 if charge:
-                    self._budget.release(charge)
+                    self._budget.acquire(charge, cancel=token)
+                try:
+                    kw = dict(columns=request.columns, metadata=meta,
+                              row_filter=pred, prefetch=request.prefetch,
+                              validate_crc=(request.validate_crc
+                                            if request.validate_crc
+                                            is not None
+                                            else self._validate_crc),
+                              store=self._store, plan=plan,
+                              dict_cache=BoundDictCache(self.cache, key),
+                              cancel=token)
+                    if request.device:
+                        from ..device_reader import DeviceFileReader
+
+                        with DeviceFileReader(path, hang_s=self._hang_s,
+                                              **kw) as r:
+                            cols: dict = {}
+                            for group in r.iter_row_groups():
+                                for name, cd in group.items():
+                                    cols.setdefault(name, []).append(cd)
+                            out[str(path)] = {
+                                name: parts[0] if len(parts) == 1 else parts
+                                for name, parts in cols.items()}
+                    else:
+                        with FileReader(path, **kw) as r:
+                            out[str(path)] = self._read_watched(r)
+                finally:
+                    if charge:
+                        self._budget.release(charge)
+            except _CLASSIFIED_FAILURES:
+                self.breakers.note(bkey, str(path), ok=False)
+                raise
+            self.breakers.note(bkey, str(path), ok=True)
         return out
 
     def _read_watched(self, r) -> dict:
@@ -342,6 +537,12 @@ class ScanService:
         wd.watch("pipeline", lambda: r._pipe_stats.sample())
         wd.watch("iostore", r._store.stats.progress)
         wd.add_abort_hook(r._store.abort)
+        # ALSO poison the request's own cancel token: on a SHARED store a
+        # neighbor's begin_scan legitimately clears the store-wide abort,
+        # but this request's unit boundaries must still observe the wedge
+        # verdict and stop
+        if r._cancel is not None:
+            wd.add_abort_hook(r._cancel.cancel)
         wd.start()
         try:
             out = r.read_all()
@@ -398,18 +599,28 @@ class ScanService:
             "queue_depth": self._q.qsize(),
             "in_flight": len(inflight),
             "oldest_request_s": oldest,
+            "occupancy": round(self._occupancy(), 4),
+            "brownout": self.brownout,
             "requests": inflight,
             "cache": self.cache.counters(),
+            # open circuits by file, oldest first — the autopsy/doctor
+            # `circuit-open` evidence rides every flight dump
+            "circuit_open": self.breakers.open_files(),
         }
 
     def serve_stats(self) -> dict:
-        """The registry ``serve`` section: counters + cache counters."""
-        return {**self.stats.as_dict(), "cache": self.cache.counters()}
+        """The registry ``serve`` section: counters + cache counters +
+        circuit-breaker transitions."""
+        return {**self.stats.as_dict(), "cache": self.cache.counters(),
+                "circuit": self.breakers.counters()}
 
     def obs_registry(self):
-        """Unified metrics tree: the ``serve`` section plus the request
+        """Unified metrics tree: the ``serve`` section, the request
         latency histograms (``serve.queue_wait`` / ``serve.exec`` /
-        ``serve.request`` — the p50/p95 SLO surface)."""
+        ``serve.request`` — the p50/p95/p99 SLO surface), and the ``io``
+        section folded from every store this service's requests read
+        through (retries, hedges issued/won/wasted — the hedge
+        effectiveness evidence doctor reads)."""
         from ..obs import StatsRegistry
 
         reg = StatsRegistry()
@@ -417,4 +628,10 @@ class ScanService:
         reg.histogram("serve.queue_wait").merge_from(self._hist_wait)
         reg.histogram("serve.exec").merge_from(self._hist_exec)
         reg.histogram("serve.request").merge_from(self._hist_total)
+        with self._io_agg_lock:
+            if self._io_agg:
+                reg.add_io(dict(self._io_agg))
+        for st in list(self._served_stores):
+            if st.stats is not None:
+                reg.add_io(st.stats)
         return reg
