@@ -977,6 +977,93 @@ def bench_serve(path, rows, clients_sweep=(1, 4, 16)):
     return out
 
 
+def bench_fused(files, smoke=False):
+    """Fused-vs-unfused decode A/B per dominant kernel family (ISSUE 13).
+
+    For each family the PR 9 registry names as dominant on the bench
+    configs — ``plain`` (plain_int64's fixed-width lane) and
+    ``narrow_snappy`` (lineitem16's narrow lane) — one forced-route scan
+    per side (``TPQ_FORCE_ROUTE`` accepts the fused names exactly for
+    this A/B), banking the registry ``device`` section's per-route
+    ``device_seconds`` / ``dispatches`` / ``device_passes`` plus the
+    degrade counter.  The structural bar holds in ANY mode: fused routes
+    must show device_passes == dispatches (one pass per (row group,
+    column)) where the unfused twin shows >= 3 per dispatch.  The TIMING
+    bar (fused device_seconds <= unfused) only binds on compiled (Mosaic)
+    runs — ``pallas_mode`` rides the record so the ledger knows which
+    kind this was; interpret-mode seconds are not kernel measurements.
+    Skip with BENCH_FUSED=0; --smoke runs it tiny.
+    """
+    from tpu_parquet.device_reader import DeviceFileReader
+    from tpu_parquet.pallas_kernels import pallas_mode
+
+    def one(path, route):
+        # save/restore, not pop: an operator-forced route must survive this
+        # section for the later ones and the ledger env fingerprint
+        prev = os.environ.get("TPQ_FORCE_ROUTE")
+        os.environ["TPQ_FORCE_ROUTE"] = route
+        try:
+            t0 = time.perf_counter()
+            with DeviceFileReader(path) as r:
+                for _ in r.iter_row_groups():
+                    pass
+                wall = time.perf_counter() - t0
+                st = r.stats().as_dict()
+                dev = (r.obs_registry().as_dict().get("device")
+                       or {}).get("routes") or {}
+        finally:
+            if prev is None:
+                os.environ.pop("TPQ_FORCE_ROUTE", None)
+            else:
+                os.environ["TPQ_FORCE_ROUTE"] = prev
+        c = dev.get(route) or {}
+        return {
+            "route": route,
+            "wall_seconds": round(wall, 4),
+            "device_seconds": c.get("device_seconds", 0.0),
+            "dispatches": c.get("dispatches", 0),
+            "device_passes": c.get("device_passes", 0),
+            "streams": (st["ship_routes"].get(route) or {}).get("streams", 0),
+            "fused_fallbacks": st.get("fused_fallbacks", 0),
+        }
+
+    prev_fuse = os.environ.get("TPQ_FUSE")
+    os.environ["TPQ_FUSE"] = "1"
+    out = {"pallas_mode": pallas_mode(), "families": {}}
+    try:
+        for family, fused_route, path in (
+                ("plain", "fused_plain", files.get("plain_int64")),
+                ("narrow_snappy", "fused_narrow_snappy",
+                 files.get("lineitem16"))):
+            if path is None:
+                continue
+            fused = one(path, fused_route)
+            unfused = one(path, family)
+            fam = {"fused": fused, "unfused": unfused}
+            if fused["dispatches"]:
+                fam["fused_passes_per_dispatch"] = round(
+                    fused["device_passes"] / fused["dispatches"], 3)
+            if unfused["dispatches"]:
+                fam["unfused_passes_per_dispatch"] = round(
+                    unfused["device_passes"] / unfused["dispatches"], 3)
+            if fused["device_seconds"] and unfused["device_seconds"]:
+                fam["device_seconds_ratio"] = round(
+                    fused["device_seconds"] / unfused["device_seconds"], 4)
+            out["families"][family] = fam
+            log(f"  fused[{family}]: fused {fused['dispatches']} disp/"
+                f"{fused['device_passes']} passes "
+                f"{fused['device_seconds']:.6f}s (fallbacks "
+                f"{fused['fused_fallbacks']}) vs unfused "
+                f"{unfused['dispatches']} disp/{unfused['device_passes']} "
+                f"passes {unfused['device_seconds']:.6f}s")
+    finally:
+        if prev_fuse is None:
+            os.environ.pop("TPQ_FUSE", None)
+        else:
+            os.environ["TPQ_FUSE"] = prev_fuse
+    return out
+
+
 def bench_serve_faults(path, rows, smoke=False):
     """Fault-injected serve sweep (ISSUE 11): the same shared ScanService
     under a seeded stall storm, hedging OFF vs ON.
@@ -1688,6 +1775,22 @@ def main(argv=None):
                 ppath, prows, smoke=args.smoke)
         except Exception as e:  # noqa: BLE001
             log(f"serve_faults bench FAILED: {e!r}")
+
+    # Fused-vs-unfused device decode A/B on the dominant kernel families
+    # (ISSUE 13): forced-route scans banking device_seconds + dispatch/
+    # pass counts per side.  Skip with BENCH_FUSED=0; smoke runs it tiny
+    # (the structural pass-count bar holds even in interpret mode).
+    if os.environ.get("BENCH_FUSED", "1") != "0" and not over_budget():
+        try:
+            fused_files = {}
+            for cfg_key, cname in (("1", "plain_int64"), ("4", "lineitem16")):
+                try:
+                    fused_files[cname] = _config_file(cfg_key)[0]
+                except Exception as e:  # noqa: BLE001
+                    log(f"fused bench: no {cname} file: {e!r}")
+            results["fused"] = bench_fused(fused_files, smoke=args.smoke)
+        except Exception as e:  # noqa: BLE001
+            log(f"fused bench FAILED: {e!r}")
 
     # Writer throughput (host encode; ~10s).  Skip with BENCH_WRITES=0.
     if os.environ.get("BENCH_WRITES", "1") != "0" and not over_budget():

@@ -77,7 +77,7 @@ def test_device_stats_as_dict_golden_keys():
     assert set(d["routes"]) == {"plain", "device_snappy"}
     for r in d["routes"].values():
         assert set(r) == {"dispatches", "device_seconds", "bytes_in",
-                          "bytes_staged"}
+                          "bytes_staged", "device_passes"}
     assert set(d["kernels"]) == {"plain", "snappy_resolve"}
     for k in d["kernels"].values():
         assert set(k) == {"dispatches", "device_seconds"}

@@ -782,8 +782,8 @@ def test_narrow_int_transcode_exact(tmp_path):
     hits = {}
     orig = DR._ChunkAssembler._plan_narrow_ints
 
-    def spy(self, common, stager, name):
-        r = orig(self, common, stager, name)
+    def spy(self, common, stager, name, **kw):
+        r = orig(self, common, stager, name, **kw)
         hits[".".join(self.leaf.path)] = r is not None
         return r
 

@@ -299,6 +299,7 @@ def test_registry_tree_golden_keys():
     assert set(fb["routes"]) == {"plain", "recompress"}
     r = fb["routes"]["recompress"]
     assert {"streams", "shipped_bytes", "predicted_seconds",
+            "device_unfused_predicted_seconds",
             "measured_seconds", "error_ratio",
             "device_predicted_seconds", "device_measured_seconds",
             "device_error_ratio"} == set(r)
@@ -422,10 +423,11 @@ def test_reader_stats_as_dict_golden_keys():
         "planner_link_mbps", "host_seconds", "stage_seconds",
         "dispatch_seconds",
         "wall_seconds", "rows_per_sec", "bytes_per_sec", "pages_per_chunk",
+        "fused_fallbacks",
     }
     assert set(d["ship_routes"]["plain"]) == {
         "streams", "logical", "shipped", "predicted_s",
-        "predicted_device_s"}
+        "predicted_device_s", "predicted_unfused_device_s"}
     assert d["ship_routes"]["plain"]["predicted_s"] == 0.5
     assert d["ship_routes"]["plain"]["predicted_device_s"] == 0.25
 

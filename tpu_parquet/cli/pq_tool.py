@@ -386,6 +386,14 @@ def cmd_doctor(args, out=sys.stdout) -> int:
             out.write(f"recalibrate: re-run with TPQ_DEVICE_MBPS={drecal:g} "
                       f"(the measured device-resolve rate) to align the "
                       f"planner's device lane\n")
+        fw = rep.get("fusion_win")
+        if fw:
+            out.write(
+                f"fusion-win: {fw['route']!r} measured "
+                f"{fw['measured_seconds']:.6f}s vs unfused chain prediction "
+                f"{fw['unfused_predicted_seconds']:.6f}s "
+                f"({fw['speedup']:.2f}x) — the fused megakernel beats the "
+                f"staged chain; keep TPQ_FUSE on for this workload\n")
     else:
         # records predating the device registry section (or runs with
         # TPQ_DEVICE_TIMING=0): explicitly n/a, never a KeyError

@@ -944,6 +944,104 @@ def crafted_chaos_blobs() -> "list[bytes]":
     ]
 
 
+def fuzz_fused_plan(data: bytes) -> None:
+    """Fuzz target #18: fused-route planner invariants (ship.py).
+
+    The fused megakernel rows ride the same cost table as every other
+    route, so a hostile fact set must never break the table's contracts:
+
+    - a fused row is present ⇔ fusion is enabled AND the facts are
+      fused-eligible (``ship.fused_eligible`` — the ONE predicate the
+      planner, the device_reader builders, and this target share) AND the
+      unfused twin is priced feasible;
+    - a fused row never counts the unfused chain's inter-stage HBM term:
+      its device cost is the single output-sized pass, <= the twin's
+      device cost, and strictly below ``unfused_device_costs`` (the
+      spill-inclusive prediction the fusion-win verdict compares against);
+    - at equal modeled cost the fused variant outranks its twin — and a
+      costlier fused row never jumps the queue;
+    - a FORCED fused route on ineligible facts degrades (plan returns
+      ``[force, plain]`` and the cost table simply has no fused entry —
+      the builder falls through with a counter), never a crash;
+    - ``parse_route`` on arbitrary junk warns and returns None, never
+      raises (the TPQ_FORCE_ROUTE mid-scan degradation contract).
+    """
+    from .ship import (
+        FUSED_ROUTES, ROUTE_PLAIN as _PLAIN, ROUTES, UNFUSED_OF, ChunkFacts,
+        ShipPlanner, fused_eligible, parse_route,
+    )
+
+    if len(data) < 14:
+        data = data + b"\x00" * (14 - len(data))
+    flags = data[0]
+    fuse = bool(flags & 1)
+    force = (ROUTES[(flags >> 2) % len(ROUTES)] if flags & 2 else None)
+    logical = int.from_bytes(data[1:7], "little") % (1 << 33)
+    width = (0, 4, 8, 12)[data[7] % 4]
+    narrow_k = data[8] % 9
+    bits = data[9]
+    comp_bytes = int.from_bytes(data[10:14], "little") % (1 << 30)
+    f = ChunkFacts(
+        logical=logical, width=width, narrow_k=narrow_k,
+        narrow_possible=bool(bits & 1), comp_bytes=comp_bytes,
+        native=bool(bits & 2), host_bytes_ready=bool(bits & 4),
+        flat=bool(bits & 8),
+    )
+    p = ShipPlanner(link_mbps=1.0 + (data[7] % 97) * 13.0, force=force,
+                    fuse=fuse, device_mbps=1.0 + (data[8] % 89) * 11.0)
+    order, costs = p.plan(f)  # never raises, whatever the facts
+    assert _PLAIN in costs, "plain anchor missing"
+    eligible = set(fused_eligible(f))
+    for fr in FUSED_ROUTES:
+        present = fr in costs
+        expected = fuse and fr in eligible and UNFUSED_OF[fr] in costs
+        assert present == expected, (fr, present, expected, f)
+        if present:
+            dev = p.device_costs(f, routes=costs)
+            unf = p.unfused_device_costs(f, routes=costs)
+            assert dev[fr] <= dev[UNFUSED_OF[fr]] + 1e-12 or \
+                UNFUSED_OF[fr] == _PLAIN, (fr, dev)
+            assert unf[fr] > dev[fr] - 1e-18, (fr, unf, dev)
+            twin = UNFUSED_OF[fr]
+            if (force is None and twin in costs
+                    and abs(costs[fr] - costs[twin]) < 1e-15):
+                assert order.index(fr) < order.index(twin), order
+    if force is not None:
+        assert order[0] == force and order[-1] == _PLAIN
+        # forced-fused on an ineligible stream: no fused cost row, and the
+        # infallible plain tail is still there to degrade to
+        if force in FUSED_ROUTES and force not in costs:
+            assert _PLAIN in order
+    # env-validation degradation: junk never raises (candidates are a
+    # FIXED set — warn_env_once keys on the value, and a per-blob random
+    # string would grow its dedup set without bound over a long campaign)
+    junk = ("", "warp", "fusedplain", "FUSED_PLAIN", " plain ",
+            *ROUTES)[data[1] % (5 + len(ROUTES))]
+    assert parse_route(junk) in (None, *ROUTES)
+
+
+def crafted_fused_plan_blobs() -> "list[bytes]":
+    """Hand-crafted ``fused_plan`` inputs (and corpus blobs): each hits a
+    distinct planner branch — fused-on eligible, fused-off, non-flat,
+    width-ineligible, forced-fused-ineligible, zero logical, huge facts."""
+
+    def blob(flags, logical, width_sel, k, bits, comp):
+        return (bytes([flags]) + logical.to_bytes(6, "little")
+                + bytes([width_sel, k, bits]) + comp.to_bytes(4, "little"))
+
+    return [
+        blob(1, 8 << 20, 2, 3, 0b1011, 0),        # fuse on, flat int64
+        blob(0, 8 << 20, 2, 3, 0b1011, 0),        # fuse off: no fused rows
+        blob(1, 8 << 20, 2, 3, 0b0011, 0),        # not flat: ineligible
+        blob(1, 8 << 20, 0, 0, 0b1011, 0),        # width 0 (byte array)
+        # forced fused_narrow_snappy (index of it in ROUTES) on a float
+        # column that can never narrow — degrade path
+        blob(2 | 1 | (6 << 2), 8 << 20, 1, 0, 0b1010, 0),
+        blob(1, 0, 2, 3, 0b1011, 0),              # zero logical
+        blob(3 | (5 << 2), (1 << 33) - 1, 2, 8, 0b1111, (1 << 30) - 1),
+    ]
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -962,6 +1060,7 @@ TARGETS = {
     "page_corrupt": fuzz_page_corrupt,
     "scan_plan": fuzz_scan_plan,
     "chaos_schedule": fuzz_chaos_schedule,
+    "fused_plan": fuzz_fused_plan,
 }
 
 
@@ -1163,6 +1262,8 @@ def _seed_inputs(target: str) -> list[bytes]:
         return crafted_scan_plan_blobs()
     if target == "chaos_schedule":
         return crafted_chaos_blobs()
+    if target == "fused_plan":
+        return crafted_fused_plan_blobs()
     if target == "loader_state":
         from .data import checkpoint as ck
 

@@ -54,7 +54,8 @@ LEDGER_VERSION = 1
 # flip is visibly a different experiment.
 _ENV_KEYS = (
     "TPQ_LINK_MBPS", "TPQ_FORCE_ROUTE", "TPQ_TRACE", "TPQ_SAMPLE_MS",
-    "TPQ_DEVICE_SNAPPY", "TPQ_COMPILE_CACHE", "TPQ_FUSE_RG", "TPQ_PALLAS",
+    "TPQ_DEVICE_SNAPPY", "TPQ_COMPILE_CACHE", "TPQ_FUSE_RG", "TPQ_FUSE",
+    "TPQ_PALLAS",
     "TPQ_DEFER_DICT_CHECK", "TPQ_DEVICE_MBPS", "TPQ_DEVICE_TIMING",
     "TPQ_XPROF", "TPQ_SERVE_CONCURRENCY", "TPQ_SERVE_QUEUE",
     "TPQ_PLAN_CACHE_MB", "TPQ_SERVE_BROWNOUT", "TPQ_IO_HEDGE_MS",
@@ -109,6 +110,17 @@ def env_fingerprint() -> dict:
         v = os.environ.get(k)
         if v is not None:
             fp[k] = v
+    # whether Pallas kernels (the fused decode megakernels included) ran
+    # compiled (native Mosaic) or through the interpreter: an
+    # interpret-mode device number is bit-identical but NOT a kernel
+    # measurement, and a banked run must say which it was.  Best-effort:
+    # a ledger read on a jax-less host still fingerprints the rest.
+    try:
+        from .pallas_kernels import pallas_mode
+
+        fp["pallas_mode"] = pallas_mode()
+    except Exception:  # noqa: BLE001 — fingerprinting never raises
+        pass
     return fp
 
 

@@ -1426,6 +1426,9 @@ class StatsRegistry:
             dr = dev_routes.get(route) or {}
             dev_meas = (float(dr["device_seconds"])
                         if dr.get("dispatches") else None)
+            # fused routes carry the UNFUSED chain's device prediction too
+            # (null elsewhere) — the fusion-win comparison's bar
+            unf = float(c.get("predicted_unfused_device_s", 0.0) or 0.0)
             out[route] = {
                 "streams": c.get("streams", 0),
                 "shipped_bytes": c.get("shipped", 0),
@@ -1441,6 +1444,8 @@ class StatsRegistry:
                 "device_error_ratio": (round(dev_meas / dev_pred, 3)
                                        if dev_meas is not None and dev_pred
                                        else None),
+                "device_unfused_predicted_seconds": (round(unf, 9)
+                                                     if unf else None),
             }
         return {"link_bytes_per_sec": round(link_bps, 1), "routes": out}
 
@@ -1751,6 +1756,29 @@ def doctor_registry(tree: dict) -> "dict | None":
             from .ship import recalibrate_device_mbps
 
             out["recalibrate_device_mbps"] = recalibrate_device_mbps(dev_bps)
+        # fusion-win: a fused megakernel route whose MEASURED device
+        # seconds beat the UNFUSED chain's prediction for the same bytes
+        # (ship.ShipPlanner.unfused_device_costs, recorded on the fused
+        # ship records).  Reported for the dominant (most bytes_in) fused
+        # route; interpret-mode runs never qualify on timing grounds here
+        # because their measured seconds are not kernel measurements —
+        # the ledger fingerprint's pallas mode says which kind a run was.
+        from .ship import FUSED_ROUTES as _FUSED
+
+        fused = sorted((r for r in dev_routes if r in _FUSED),
+                       key=lambda r: (-g(dev_routes[r], "bytes_in"), r))
+        for r in fused:
+            fm = g(dev_routes[r], "device_seconds")
+            fp = float((routes_pred.get(r) or {})
+                       .get("predicted_unfused_device_s") or 0.0)
+            if fm and fp and fm < fp:
+                out["fusion_win"] = {
+                    "route": r,
+                    "measured_seconds": round(fm, 9),
+                    "unfused_predicted_seconds": round(fp, 9),
+                    "speedup": round(fp / fm, 2),
+                }
+                break
     circ = serve.get("circuit")
     circ = circ if isinstance(circ, dict) else {}
     if g(circ, "open_now") > 0:

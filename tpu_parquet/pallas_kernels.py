@@ -32,18 +32,62 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["unpack_bits_pallas", "unpack_bp_groups", "bp_groups_pad",
-           "build_planes", "pallas_available"]
+           "build_planes", "pallas_available", "pallas_mode",
+           "resolve_interpret", "fused_plain_words", "fused_narrow_words",
+           "fused_count_pad", "fused_narrow_count_pad"]
 
 _GROUPS_PER_TILE = 1024  # 8192 values per grid step; (1024,) = one 8x128 tile
 
+# probed once per process (satellite of ISSUE 13): the backend platform
+# cannot change under a live process, and the old per-call probe showed up
+# as jax.devices() churn on the dispatch hot path once every fused plan
+# asked it.  None = not probed yet.
+_AVAILABLE: "bool | None" = None
+
 
 def pallas_available() -> bool:
-    """True when the current default backend can run Mosaic TPU kernels."""
-    try:
-        plat = jax.devices()[0].platform
-    except Exception:  # noqa: BLE001
+    """True when the current default backend can run Mosaic TPU kernels
+    (cached after the first probe; ``_reset_available_cache`` un-caches for
+    tests that flip backends)."""
+    global _AVAILABLE
+    if _AVAILABLE is None:
+        try:
+            plat = jax.devices()[0].platform
+        except Exception:  # noqa: BLE001
+            plat = None
+        _AVAILABLE = plat in ("tpu", "axon")
+    return _AVAILABLE
+
+
+def _reset_available_cache() -> None:
+    global _AVAILABLE
+    _AVAILABLE = None
+
+
+def pallas_mode() -> str:
+    """``"compiled"`` (native Mosaic) or ``"interpret"`` — how any Pallas
+    kernel reached in this process actually runs.  Recorded in the ledger
+    env fingerprint so a banked bench number carries whether its fused
+    kernels were compiled or interpreted (an interpret-mode device time is
+    not a measurement of the kernel)."""
+    return "compiled" if pallas_available() else "interpret"
+
+
+def resolve_interpret(interpret: "bool | None" = None) -> bool:
+    """The ``interpret=`` every fused/pallas entry point resolves through:
+    explicit wins; otherwise native Mosaic when available, else the Pallas
+    interpreter with ONE process-wide breadcrumb (``warn_env_once`` — an
+    interpreted fused kernel is bit-identical but a perf cliff, worth one
+    line, never a failure)."""
+    if interpret is not None:
+        return bool(interpret)
+    if pallas_available():
         return False
-    return plat in ("tpu", "axon")
+    from .obs import warn_env_once
+
+    warn_env_once("TPQ_FUSE", "<no mosaic backend>",
+                  "pallas interpret mode (bit-identical, not a measurement)")
+    return True
 
 
 def _unpack_kernel(width: int, in_ref, out_ref):
@@ -189,3 +233,257 @@ def unpack_bits_pallas(buf, width: int, count: int, interpret: bool | None = Non
     with jax.named_scope("tpq.unpack"):
         return _unpack_pallas_jit(planes, width=width, count=count,
                                   interpret=bool(interpret))
+
+
+# ---------------------------------------------------------------------------
+# fused decode megakernels (ISSUE 13 / ROADMAP direction 2): ONE pallas_call
+# per ship route instead of the staged XLA chain.  The unfused routes run
+# decompress-resolve → gather → widen → validity as separate XLA fusions
+# with an HBM round trip between each stage; these kernels run the whole
+# pipeline per value tile in VMEM and write the finished words once.
+# Interpret mode (non-TPU backends) executes the SAME graph bit-identically,
+# so tier-1 proves correctness on CPU; only compiled (Mosaic) runs are
+# device-time measurements (pallas_mode in the ledger fingerprint records
+# which one a banked run was).
+# ---------------------------------------------------------------------------
+
+_FUSED_TILE = 1024      # values per grid step, fused plain kernel
+_FUSED_NS_TILE = 256    # values per grid step, fused narrow+snappy kernel
+# fused narrow+snappy eligibility caps — kernel properties, shared by the
+# device_reader builder and the bench/fuzz surfaces.  The op search is a
+# per-tile broadcast compare over the whole (VMEM-resident) op table and
+# the copy-chain chase is a static unroll, so streams beyond these bounds
+# keep the unfused resolve path (pointer doubling scales, VMEM does not).
+FUSED_MAX_OPS = 4096        # padded op-table rows held in VMEM per tile
+FUSED_MAX_DEPTH = 16        # copy-chain depth unrolled in the kernel
+FUSED_MAX_PAYLOAD = 4 << 20  # compressed payload bytes held in VMEM
+
+
+def fused_count_pad(count: int) -> int:
+    """Pad a value count to whole fused-plain tiles (bucketed first so the
+    executable set stays bounded across chunks — same contract as
+    :func:`bp_groups_pad`)."""
+    from .jax_decode import _bucket_count
+
+    b = _bucket_count(max(count, 1))
+    return -(-b // _FUSED_TILE) * _FUSED_TILE
+
+
+def fused_narrow_count_pad(count: int) -> int:
+    """Tile padding for the fused narrow+snappy kernel."""
+    from .jax_decode import _bucket_count
+
+    b = _bucket_count(max(count, 1))
+    return -(-b // _FUSED_NS_TILE) * _FUSED_NS_TILE
+
+
+def _fused_plain_kernel(width, in_ref, nv_ref, out_ref):
+    """One tile of the fused PLAIN fixed-width decode: (width, T) byte
+    planes -> (T, width//4) finished u32 words, validity tail baked in.
+
+    Same plane layout/indexing contract as :func:`_unpack_kernel` (leading-
+    dim static plane reads — never strided u8 column slices).  The only
+    dynamic input is ``nv`` (the real value count): lanes at or past it
+    write zero words, which is the "validity" the unfused chain leaves to
+    a separate tail-mask pass."""
+    from jax.experimental import pallas as pl
+
+    nv = nv_ref[0, 0]
+    base = pl.program_id(0) * _FUSED_TILE
+    pos = base + jax.lax.broadcasted_iota(jnp.int32, (_FUSED_TILE,), 0)
+    keep = pos < nv
+    for w in range(width // 4):
+        acc = in_ref[4 * w, :].astype(jnp.uint32)
+        for b in range(1, 4):
+            acc = acc | (in_ref[4 * w + b, :].astype(jnp.uint32)
+                         << jnp.uint32(8 * b))
+        out_ref[:, w] = jnp.where(keep, acc, jnp.uint32(0))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("width", "count_pad", "interpret")
+)
+def _fused_plain_jit(buf, vbase, nv, *, width, count_pad, interpret):
+    from jax.experimental import pallas as pl
+
+    raw = jax.lax.dynamic_slice(buf, (vbase,), (count_pad * width,))
+    planes = raw.reshape(count_pad, width).T
+    return pl.pallas_call(
+        functools.partial(_fused_plain_kernel, width),
+        out_shape=jax.ShapeDtypeStruct((count_pad, width // 4), jnp.uint32),
+        grid=(count_pad // _FUSED_TILE,),
+        in_specs=[
+            pl.BlockSpec((width, _FUSED_TILE), lambda t: (0, t)),
+            pl.BlockSpec((1, 1), lambda t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((_FUSED_TILE, width // 4),
+                               lambda t: (t, 0)),
+        interpret=interpret,
+    )(planes, nv.reshape(1, 1))
+
+
+def fused_plain_words(buf_dev, vbase, n_valid, *, width: int,
+                      count_pad: int, interpret: "bool | None" = None):
+    """Fused PLAIN fixed-width decode: staged value bytes at ``vbase`` ->
+    finished little-endian u32 words (``count_pad`` x ``width//4``), tail
+    past ``n_valid`` zeroed — decode and validity in ONE device pass.
+
+    ``count_pad`` must come from :func:`fused_count_pad`.  Traced x64-free
+    (Mosaic refuses i64 grid index maps — see unpack_bits_pallas); callers
+    bitcast the words to their value dtype under their own x64 scope.
+    """
+    if width not in (4, 8):
+        raise ValueError(f"fused plain supports widths 4/8, got {width}")
+    if count_pad % _FUSED_TILE:
+        raise ValueError(f"count_pad {count_pad} not a multiple of "
+                         f"{_FUSED_TILE}")
+    interpret = resolve_interpret(interpret)
+    if isinstance(vbase, (int, np.integer)):
+        vbase = np.int32(vbase)
+    if isinstance(n_valid, (int, np.integer)):
+        n_valid = np.int32(n_valid)
+    from .jax_kernels import enable_x64
+
+    with enable_x64(False), jax.named_scope("tpq.fused"):
+        return _fused_plain_jit(buf_dev, vbase, n_valid, width=width,
+                                count_pad=count_pad,
+                                interpret=bool(interpret))
+
+
+def _fused_narrow_kernel(k, width, depth, out_pad, pay_ref, ends_ref,
+                         asrc_ref, offs_ref, islit_ref, bias_ref, nv_ref,
+                         out_ref):
+    """One tile of the fused narrow+snappy decode: compressed payload +
+    op tables -> finished biased u32 words, all in VMEM.
+
+    Per output byte the snappy source resolves by a bounded copy-chain
+    chase (``depth`` static unrolled rounds; the host's tag walk computed
+    the exact max depth, so the unroll is exact, no loop carry): find the
+    byte's op with a broadcast compare over the sorted op ends, literals
+    read the payload directly, copies re-enter at their periodic source
+    position — the same encoding :func:`jax_kernels.snappy_resolve`
+    pointer-doubles over, chased per byte instead of materializing the
+    output-space source map to HBM.  Widen (k little-endian bytes), re-bias
+    (64-bit add as u32 word pairs with carry), and mask the validity tail —
+    the whole unfused stage chain, one pass."""
+    from jax.experimental import pallas as pl
+
+    ends = ends_ref[:]
+    asrc = asrc_ref[:]
+    offs = offs_ref[:]
+    islit = islit_ref[:]
+    n_ops = ends.shape[0]
+    nv = nv_ref[0, 0]
+    base = pl.program_id(0) * _FUSED_NS_TILE
+    vpos = base + jax.lax.broadcasted_iota(jnp.int32, (_FUSED_NS_TILE,), 0)
+    keep = vpos < nv
+    byte_vals = []
+    # every scalar below is an EXPLICIT i32: the interpret-mode kernel
+    # lowers inside the consumer's (x64-enabled) module, where a bare
+    # Python int becomes a weak i64 constant that trips the lowering's
+    # clip/minimum signatures (same discipline as _unpack_kernel's u32s)
+    i32 = jnp.int32
+    for b in range(k):
+        p = jnp.clip(vpos * i32(k) + i32(b), i32(0), i32(out_pad - 1))
+        src = jnp.zeros((_FUSED_NS_TILE,), jnp.int32)
+        done = jnp.zeros((_FUSED_NS_TILE,), jnp.bool_)
+        for _ in range(depth + 1):
+            # searchsorted(ends, p, 'right') as a broadcast compare: the
+            # padded table is VMEM-resident (FUSED_MAX_OPS cap), sorted,
+            # fill = out_pad so padded positions land on padded literals
+            op = jnp.minimum(
+                jnp.sum((ends[None, :] <= p[:, None]).astype(jnp.int32),
+                        axis=1),
+                i32(n_ops - 1))
+            start = jnp.where(op > i32(0),
+                              ends[jnp.maximum(op - i32(1), i32(0))], i32(0))
+            within = p - start
+            lit = islit[op] != 0
+            hit = jnp.logical_and(lit, jnp.logical_not(done))
+            src = jnp.where(hit, asrc[op] + within, src)
+            done = jnp.logical_or(done, lit)
+            # copies re-enter at the periodic source (overlapping RLE-style
+            # copies map straight past their own op — snappy_resolve's form)
+            p = jnp.where(lit, p,
+                          asrc[op] + within % jnp.maximum(offs[op], i32(1)))
+        idx = jnp.clip(src, i32(0), i32(pay_ref.shape[0] - 1))
+        byte_vals.append(pay_ref[idx].astype(jnp.uint32))
+    lo = byte_vals[0]
+    for b in range(1, min(k, 4)):
+        lo = lo | (byte_vals[b] << jnp.uint32(8 * b))
+    if width == 4:
+        out_ref[:, 0] = jnp.where(keep, bias_ref[0, 0] + lo, jnp.uint32(0))
+        return
+    hi = jnp.zeros((_FUSED_NS_TILE,), jnp.uint32)
+    for b in range(4, k):
+        hi = hi | (byte_vals[b] << jnp.uint32(8 * (b - 4)))
+    lo_sum = bias_ref[0, 0] + lo
+    carry = (lo_sum < lo).astype(jnp.uint32)
+    hi_sum = bias_ref[0, 1] + hi + carry
+    out_ref[:, 0] = jnp.where(keep, lo_sum, jnp.uint32(0))
+    out_ref[:, 1] = jnp.where(keep, hi_sum, jnp.uint32(0))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "width", "depth", "count_pad", "out_pad",
+                     "interpret"),
+)
+def _fused_narrow_jit(payload, ends, asrc, offs, islit, bias2, nv, *, k,
+                      width, depth, count_pad, out_pad, interpret):
+    from jax.experimental import pallas as pl
+
+    n_ops = ends.shape[0]
+    ppad = payload.shape[0]
+    words = width // 4
+    whole = lambda n: pl.BlockSpec((n,), lambda t: (0,))  # noqa: E731
+    return pl.pallas_call(
+        functools.partial(_fused_narrow_kernel, k, width, depth, out_pad),
+        out_shape=jax.ShapeDtypeStruct((count_pad, words), jnp.uint32),
+        grid=(count_pad // _FUSED_NS_TILE,),
+        in_specs=[
+            whole(ppad), whole(n_ops), whole(n_ops), whole(n_ops),
+            whole(n_ops),
+            pl.BlockSpec((1, 2), lambda t: (0, 0)),
+            pl.BlockSpec((1, 1), lambda t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((_FUSED_NS_TILE, words), lambda t: (t, 0)),
+        interpret=interpret,
+    )(payload, ends, asrc, offs, islit, bias2, nv)
+
+
+def fused_narrow_words(payload, ends, asrc, offs, islit, bias2, n_valid, *,
+                       k: int, width: int, depth: int, count_pad: int,
+                       out_pad: int, interpret: "bool | None" = None):
+    """Fused narrow+snappy decode (ship.py ROUTE_FUSED_NARROW_SNAPPY):
+    decompress-resolve, gather, widen, re-bias, and validity in ONE
+    pallas pass over the compressed narrow transcode.
+
+    ``payload`` u8[ppad] — the staged compressed bytes (VMEM-resident,
+    FUSED_MAX_PAYLOAD cap); ``ends``/``asrc``/``offs``/``islit`` the
+    padded op tables with PAYLOAD-RELATIVE literal sources (the fused
+    builder packs its own tables — the staged-chain tables carry absolute
+    staged coordinates); ``bias2`` u32[1, 2] little-endian (lo, hi) words
+    of the narrow minimum; ``depth`` the exact max copy-chain depth from
+    the host tag walk (FUSED_MAX_DEPTH cap).  Returns u32[count_pad,
+    width//4] finished words, tail past ``n_valid`` zeroed; callers
+    bitcast under their own x64 scope.  Traced x64-free (Mosaic i64 grid
+    maps — see unpack_bits_pallas)."""
+    if width not in (4, 8) or not 1 <= k <= width:
+        raise ValueError(f"fused narrow: bad k={k}/width={width}")
+    if count_pad % _FUSED_NS_TILE:
+        raise ValueError(f"count_pad {count_pad} not a multiple of "
+                         f"{_FUSED_NS_TILE}")
+    if depth > FUSED_MAX_DEPTH:
+        raise ValueError(f"depth {depth} over FUSED_MAX_DEPTH")
+    interpret = resolve_interpret(interpret)
+    if isinstance(n_valid, (int, np.integer)):
+        n_valid = np.int32(n_valid)
+    from .jax_kernels import enable_x64
+
+    with enable_x64(False), jax.named_scope("tpq.fused"):
+        return _fused_narrow_jit(
+            payload, ends, asrc, offs, islit, bias2,
+            jnp.asarray(n_valid, jnp.int32).reshape(1, 1), k=k, width=width,
+            depth=depth, count_pad=count_pad, out_pad=out_pad,
+            interpret=bool(interpret))

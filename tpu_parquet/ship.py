@@ -19,6 +19,14 @@ device_snappy    the file's own snappy page payloads, decompressed on device
 recompress       host re-compresses the stream to snappy, ships compressed
 ===============  ============================================================
 
+Two FUSED variants (``fused_plain``, ``fused_narrow_snappy``) ship exactly
+their twin's bytes but run the device half as one Pallas megakernel pass
+(pallas_kernels): no inter-stage HBM spill term in the cost model, one
+dispatch in the registry's ``device`` section.  Offered when ``TPQ_FUSE``
+permits (default: exactly when the backend compiles Mosaic natively) and
+the stream is fused-eligible (``fused_eligible``); at equal modeled cost
+the planner prefers the fused variant.
+
 Cost per route = host prep time + link time + device resolve time, each a
 bytes/throughput term.  Link bandwidth comes from ``TPQ_LINK_MBPS`` when set
 (bench.py exports its measured probe there); the host/device terms are
@@ -46,8 +54,24 @@ ROUTE_NARROW = "narrow"
 ROUTE_NARROW_SNAPPY = "narrow_snappy"
 ROUTE_DEVICE_SNAPPY = "device_snappy"
 ROUTE_RECOMPRESS = "recompress"
+# fused megakernel variants (pallas_kernels): the SAME bytes over the link
+# as their unfused twin, but the device half runs as ONE Pallas pass
+# (resolve → gather → widen → validity) instead of a chain of XLA calls
+# with an HBM round trip between each stage
+ROUTE_FUSED_PLAIN = "fused_plain"
+ROUTE_FUSED_NARROW_SNAPPY = "fused_narrow_snappy"
+# THE route-name registry: planner ranking, device_reader dispatch, the
+# TPQ_FORCE_ROUTE validation, and the ScanPlan route memo all share this
+# one table (parse_route below is the one env-validation entry point), so
+# a fused name added here is automatically legal at every site.
 ROUTES = (ROUTE_PLAIN, ROUTE_NARROW, ROUTE_NARROW_SNAPPY,
-          ROUTE_DEVICE_SNAPPY, ROUTE_RECOMPRESS)
+          ROUTE_DEVICE_SNAPPY, ROUTE_RECOMPRESS,
+          ROUTE_FUSED_PLAIN, ROUTE_FUSED_NARROW_SNAPPY)
+# fused route -> the unfused twin whose link bytes / host work it shares
+UNFUSED_OF = {ROUTE_FUSED_PLAIN: ROUTE_PLAIN,
+              ROUTE_FUSED_NARROW_SNAPPY: ROUTE_NARROW_SNAPPY}
+FUSED_OF = {v: k for k, v in UNFUSED_OF.items()}
+FUSED_ROUTES = tuple(UNFUSED_OF)
 
 # link bandwidth the model assumes when TPQ_LINK_MBPS is absent: the tunneled
 # TPU link's typical mid-weather rate from the bench probes (BENCH_r05 logs
@@ -77,6 +101,50 @@ MIN_COMPRESS_BYTES = 1 << 16
 # costs one GB/s-class host pass on the overlapped pool, never link bytes)
 EST_NARROW_SNAPPY_RATIO = 0.6  # narrow output: low-entropy residuals
 EST_RECOMPRESS_RATIO = 0.5     # strings/dates/ids under snappy
+# inter-stage HBM spill the UNFUSED decode chain pays beyond its resolve
+# term: each extra XLA stage re-reads and re-writes the output-sized
+# intermediate (PR 9's per-kernel device timing is what made this term
+# attributable).  Used only for the fused-vs-unfused device prediction
+# (`unfused_device_costs` → the doctor's `fusion-win` line), never for
+# ranking the unfused routes against each other — their relative order is
+# untouched by the fusion work.
+HBM_SPILL_PASSES = 2
+
+
+def parse_route(raw, *, source: str = "TPQ_FORCE_ROUTE") -> "str | None":
+    """Validate a route name from the environment against the ONE registry
+    (``ROUTES``).  Malformed values degrade — one ``warn_env_once`` line,
+    then cost-ranked routing — instead of turning every reader
+    construction (or a scan already in flight re-reading the env through
+    ``default_planner``) into a raise.  Returns the canonical name or
+    None."""
+    v = (raw or "").strip()
+    if not v:
+        return None
+    if v not in ROUTES:
+        from .obs import warn_env_once
+
+        warn_env_once(source, v, "cost-ranked routes (unforced)")
+        return None
+    return v
+
+
+def fuse_enabled() -> bool:
+    """Whether the planner offers fused megakernel routes (``TPQ_FUSE``).
+
+    Same contract as ``TPQ_PALLAS``: unset → on exactly when the backend
+    compiles Mosaic kernels natively (the fused graph is a perf feature,
+    not a semantic one); ``1`` forces it on non-TPU backends through the
+    Pallas interpreter (tier-1 exercises the fused graph bit-identically
+    on CPU this way); ``0`` forces it off everywhere."""
+    env = os.environ.get("TPQ_FUSE", "").strip()
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    from .pallas_kernels import pallas_available
+
+    return pallas_available()
 
 
 @dataclass(frozen=True)
@@ -93,7 +161,10 @@ class ChunkFacts:
     ``host_bytes_ready`` whether the decompressed host bytes already exist
     (dictionary tables, level-carrying pages) — when False and
     ``comp_bytes`` > 0, every host-bytes route additionally pays the
-    decompress the lazy pages skipped.
+    decompress the lazy pages skipped.  ``flat`` whether the column is
+    required and unrepeated (no def/rep level lanes) — the fused
+    megakernel routes claim only flat streams, where "validity" is the
+    tail mask the single pass bakes in.
     """
 
     logical: int
@@ -103,6 +174,19 @@ class ChunkFacts:
     comp_bytes: int = 0
     native: bool = True
     host_bytes_ready: bool = False
+    flat: bool = True
+
+
+def fused_eligible(f: ChunkFacts) -> "tuple[str, ...]":
+    """The fused routes these facts admit — the ONE eligibility predicate
+    (planner pricing, device_reader dispatch, and the ``fused_plan`` fuzz
+    invariants all call it, so the three sites cannot drift).  A fused row
+    additionally requires its unfused twin to be priced feasible (the
+    planner checks that; forced-fused on a stream whose twin build fails
+    degrades in the builder with a counter, never a crash)."""
+    if not f.flat or f.width not in (4, 8) or f.logical <= 0:
+        return ()
+    return (ROUTE_FUSED_PLAIN, ROUTE_FUSED_NARROW_SNAPPY)
 
 
 class ShipPlanner:
@@ -115,7 +199,8 @@ class ShipPlanner:
 
     def __init__(self, link_mbps: "float | None" = None,
                  force: "str | None" = None,
-                 device_mbps: "float | None" = None):
+                 device_mbps: "float | None" = None,
+                 fuse: "bool | None" = None):
         from .obs import env_float
 
         if link_mbps is None:
@@ -125,11 +210,15 @@ class ShipPlanner:
             device_mbps = env_float("TPQ_DEVICE_MBPS", DEVICE_RESOLVE_MBPS)
         self.device_mbps = max(float(device_mbps), 1.0)
         if force is None:
-            force = os.environ.get("TPQ_FORCE_ROUTE", "").strip() or None
-        if force is not None and force not in ROUTES:
+            # env values degrade (parse_route: one warning, then unforced)
+            # — an env typo must never raise mid-scan; an explicit force=
+            # argument is a programming contract and still raises below
+            force = parse_route(os.environ.get("TPQ_FORCE_ROUTE", ""))
+        elif force not in ROUTES:
             raise ValueError(
-                f"TPQ_FORCE_ROUTE={force!r} not one of {ROUTES}")
+                f"forced route {force!r} not one of {ROUTES}")
         self.force = force
+        self.fuse = fuse_enabled() if fuse is None else bool(fuse)
 
     # -- cost terms (seconds) -------------------------------------------------
 
@@ -199,6 +288,28 @@ class ShipPlanner:
                 self._link(L * EST_RECOMPRESS_RATIO),
                 resolve,
             )
+        if self.fuse:
+            # fused megakernel rows: SAME host prep and link bytes as the
+            # unfused twin, device lane = one single-pass term (no
+            # inter-stage HBM spill, one dispatch).  Priced only for
+            # fused-eligible facts (fused_eligible); at equal modeled cost
+            # the tie goes to the fused variant (plan() below) — strictly
+            # fewer dispatches for the same bytes.
+            for fr in fused_eligible(f):
+                un = out.get(UNFUSED_OF[fr])
+                if un is None:
+                    continue
+                if fr == ROUTE_FUSED_PLAIN:
+                    out[fr] = max(mat, self._link(L), resolve)
+                else:  # fused narrow+snappy: the host/link terms of the
+                    # twin, minus its strictly-larger device term
+                    narrowed = L * k / f.width
+                    out[fr] = max(
+                        mat + self._t(L, HOST_TRANSCODE_MBPS)
+                        + self._t(narrowed, HOST_COMPRESS_MBPS),
+                        self._link(narrowed * EST_NARROW_SNAPPY_RATIO),
+                        resolve,
+                    )
         return out
 
     def device_costs(self, f: ChunkFacts, routes=None) -> dict:
@@ -232,9 +343,28 @@ class ShipPlanner:
                 # SAME term costs() uses — strictly more device work than
                 # bare narrow, never less
                 out[r] = self._t(L + narrowed, self.device_mbps)
-            else:  # narrow widen / snappy resolve: charged per output byte
+            else:
+                # narrow widen / snappy resolve — and BOTH fused routes:
+                # the megakernel's device lane is one output-sized pass,
+                # never the unfused chain's L + narrowed composite
                 out[r] = self._t(L, self.device_mbps)
         return out
+
+    def unfused_device_costs(self, f: ChunkFacts, routes=None) -> dict:
+        """Per FUSED route: the modeled device seconds its UNFUSED twin's
+        stage chain would pay for the same stream — the twin's
+        :meth:`device_costs` term plus ``HBM_SPILL_PASSES`` output-sized
+        inter-stage round trips.  Recorded on fused ship records
+        (``predicted_unfused_device_s``) so the registry carries the
+        prediction the measured fused lane has to beat — the doctor's
+        ``fusion-win`` verdict is exactly that comparison.  Never used to
+        rank the unfused routes against each other."""
+        c = routes if routes is not None else self.costs(f)
+        dev = self.device_costs(f, routes=c)
+        spill = self._t(float(f.logical) * HBM_SPILL_PASSES,
+                        self.device_mbps)
+        return {r: dev.get(UNFUSED_OF[r], 0.0) + spill
+                for r in c if r in UNFUSED_OF}
 
     def routes(self, f: ChunkFacts) -> list:
         """Ordered candidate routes, cheapest modeled cost first.
@@ -258,7 +388,13 @@ class ShipPlanner:
             order = ([self.force, ROUTE_PLAIN] if self.force != ROUTE_PLAIN
                      else [ROUTE_PLAIN])
             return order, c
-        return sorted(c, key=lambda r: (c[r], ROUTES.index(r))), c
+        # equal-cost tie goes to the fused variant: same bytes, same host
+        # work, ONE device dispatch instead of a stage chain (the common
+        # fused_plain-vs-plain case on link-bound streams is exactly this
+        # tie).  A fused row priced WORSE than its twin (slow device) still
+        # ranks after it — the tie-rank only breaks equality.
+        return sorted(c, key=lambda r: (c[r], r not in UNFUSED_OF,
+                                        ROUTES.index(r))), c
 
     def decision_table(self, f: ChunkFacts) -> dict:
         """Route → modeled milliseconds (README/debug surface)."""
@@ -299,7 +435,8 @@ def default_planner() -> ShipPlanner:
     global _default
     key = (os.environ.get("TPQ_LINK_MBPS", ""),
            os.environ.get("TPQ_FORCE_ROUTE", ""),
-           os.environ.get("TPQ_DEVICE_MBPS", ""))
+           os.environ.get("TPQ_DEVICE_MBPS", ""),
+           os.environ.get("TPQ_FUSE", ""))
     with _default_lock:
         if _default is None or getattr(_default, "_env_key", None) != key:
             _default = ShipPlanner()
