@@ -977,6 +977,152 @@ def bench_serve(path, rows, clients_sweep=(1, 4, 16)):
     return out
 
 
+def bench_serve_cache(path, rows, smoke=False):
+    """Tiered result-cache A/B over the serve tier (ISSUE 14).
+
+    Three phases, all against real ``ScanService`` instances:
+
+    1. **hot/cold A/B** — the same repeated scan of the bench file with the
+       result tier OFF (``result_cache_mb=0`` — the PR 10 plan/footer/dict
+       cache baseline) vs ON; banks per-phase p50 and
+       ``warm_speedup_p50`` (cold p50 / warm p50 — the decode work a hot
+       request no longer does);
+    2. **zipfian mix** — a hot-set + long-tail access pattern over K small
+       generated files with the cache sized to hold roughly the hot set:
+       banks p50/p95/p99 and per-tier hit rates (the realistic "millions
+       of users re-scan hot files" shape);
+    3. **mutation mid-sweep** — a warmed file is rewritten in place
+       (generation moves): banks the exact ``invalidations`` delta and
+       proves the served bytes are the NEW file's, never stale.
+
+    Skip with BENCH_SERVE_CACHE=0; ``--smoke`` runs every phase tiny.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from tpu_parquet.obs import LatencyHistogram as _LH
+    from tpu_parquet.serve import ScanRequest, ScanService
+
+    reps = 6 if smoke else int(os.environ.get("BENCH_SERVE_CACHE_QUERIES",
+                                              "16"))
+    out = {"rows": rows, "queries": reps}
+
+    def latencies(svc, reqs):
+        lat = []
+        for rq in reqs:
+            t0 = time.perf_counter()
+            svc.scan(rq)
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        return lat
+
+    def q(lat, f):
+        return lat[min(int(f * len(lat)), len(lat) - 1)] if lat else 0.0
+
+    # -- phase 1: hot/cold A/B on the bench file ---------------------------
+    with ScanService(concurrency=2, result_cache_mb=0) as svc:
+        svc.scan(ScanRequest(path))  # warm the plan/footer/dict cache
+        cold = latencies(svc, [ScanRequest(path) for _ in range(reps)])
+    with ScanService(concurrency=2, result_cache_mb=1024) as svc:
+        svc.scan(ScanRequest(path))  # one populating scan
+        warm = latencies(svc, [ScanRequest(path) for _ in range(reps)])
+        ch = svc.cache.results.counters()["host"]
+    out["cold_p50_ms"] = round(q(cold, 0.5) * 1e3, 3)
+    out["warm_p50_ms"] = round(q(warm, 0.5) * 1e3, 3)
+    out["warm_speedup_p50"] = round(
+        q(cold, 0.5) / q(warm, 0.5), 2) if q(warm, 0.5) else 0.0
+    out["warm_hit_rate"] = round(
+        ch["hits"] / (ch["hits"] + ch["misses"]), 4) \
+        if ch["hits"] + ch["misses"] else 0.0
+    log(f"  serve_cache A/B: cold p50 {out['cold_p50_ms']:.2f}ms, warm p50 "
+        f"{out['warm_p50_ms']:.2f}ms ({out['warm_speedup_p50']:.1f}x, "
+        f"hit rate {out['warm_hit_rate']:.0%})")
+
+    # -- small generated files for the zipf + mutation phases --------------
+    def write_small(p, seed, n):
+        from tpu_parquet.format import (CompressionCodec,
+                                        FieldRepetitionType as FRT, Type)
+        from tpu_parquet.schema.core import build_schema, data_column
+        from tpu_parquet.writer import FileWriter
+
+        rng = np.random.default_rng(seed)
+        schema = build_schema([data_column("a", Type.INT64, FRT.REQUIRED),
+                               data_column("b", Type.INT64, FRT.REQUIRED)])
+        with open(p, "wb") as fh:
+            with FileWriter(fh, schema,
+                            codec=CompressionCodec.SNAPPY) as w:
+                for _g in range(2):
+                    w.write_columns({
+                        "a": rng.integers(-(1 << 40), 1 << 40, n // 2),
+                        "b": rng.integers(0, 1 << 20, n // 2)})
+                    w.flush_row_group()
+        return p
+
+    tmp = tempfile.mkdtemp(prefix="tpq_serve_cache_")
+    try:
+        n_files = 5 if smoke else 8
+        n_rows = 2_000 if smoke else 50_000
+        zq = 40 if smoke else 200
+        files = [write_small(os.path.join(tmp, f"z{i}.parquet"), i, n_rows)
+                 for i in range(n_files)]
+        # size the cache to ~2.5 files' decoded bytes (rounded UP to the
+        # MB knob granularity): the hot set fits, the long tail churns —
+        # the shape the tier exists for
+        per_file = max(n_rows * 16, 1)
+        cache_mb = max(-(-int(2.5 * per_file) // (1 << 20)), 1)
+        rng = np.random.default_rng(7)
+        ranks = np.minimum(rng.zipf(1.3, zq) - 1, n_files - 1)
+        with ScanService(concurrency=2, result_cache_mb=cache_mb) as svc:
+            lat = latencies(svc, [ScanRequest(files[r]) for r in ranks])
+            tree = svc.obs_registry().as_dict()
+        ct = tree["cache"]["host"]
+        hist = (tree.get("histograms") or {}).get("serve.request") or {}
+        zipf = {
+            "files": n_files, "queries": zq, "cache_mb": cache_mb,
+            "p50_ms": round(q(lat, 0.5) * 1e3, 3),
+            "p95_ms": round(q(lat, 0.95) * 1e3, 3),
+            "p99_ms": round(
+                _LH.from_dict(hist).quantile(0.99) * 1e3
+                if hist else q(lat, 0.99) * 1e3, 3),
+            "host_hit_rate": round(
+                ct["hits"] / (ct["hits"] + ct["misses"]), 4)
+            if ct["hits"] + ct["misses"] else 0.0,
+            "evictions": ct["evictions"],
+        }
+        out["zipf"] = zipf
+        log(f"  serve_cache zipf: {zq} queries over {n_files} files, p50 "
+            f"{zipf['p50_ms']:.2f}ms p99 {zipf['p99_ms']:.2f}ms, host hit "
+            f"rate {zipf['host_hit_rate']:.0%}, "
+            f"{zipf['evictions']} evictions")
+
+        # -- phase 3: mutation mid-sweep ----------------------------------
+        mut = os.path.join(tmp, "mut.parquet")
+        write_small(mut, 100, n_rows)
+        with ScanService(concurrency=2, result_cache_mb=cache_mb) as svc:
+            first = svc.scan(ScanRequest(mut))[mut]
+            svc.scan(ScanRequest(mut))  # provably warm
+            inv0 = svc.cache.results.counters()["host"]["invalidations"]
+            write_small(mut, 101, n_rows)  # new generation, new bytes
+            st = os.stat(mut)
+            os.utime(mut, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+            after = svc.scan(ScanRequest(mut))[mut]
+            inv1 = svc.cache.results.counters()["host"]["invalidations"]
+        stale = bool(np.array_equal(first["a"].values, after["a"].values))
+        out["mutation"] = {"invalidations": inv1 - inv0,
+                           "stale_served": stale}
+        log(f"  serve_cache mutation: {inv1 - inv0} invalidations, "
+            f"stale_served={stale}")
+        if stale or inv1 - inv0 <= 0:
+            raise RuntimeError(
+                f"result-cache mutation phase failed: stale={stale}, "
+                f"invalidations={inv1 - inv0}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def bench_fused(files, smoke=False):
     """Fused-vs-unfused decode A/B per dominant kernel family (ISSUE 13).
 
@@ -1763,6 +1909,21 @@ def main(argv=None):
             results["serve"] = bench_serve(ppath, prows)
         except Exception as e:  # noqa: BLE001
             log(f"serve bench FAILED: {e!r}")
+
+    # Tiered result cache (ISSUE 14): hot/cold A/B (warm-vs-cold speedup),
+    # zipfian hot-set + long-tail mix, and mutation-mid-sweep invalidation
+    # accounting.  Skip with BENCH_SERVE_CACHE=0; smoke runs it tiny.
+    if (os.environ.get("BENCH_SERVE_CACHE", "1") != "0"
+            and not over_budget()):
+        try:
+            ppath, prows = _config_file("4")
+            entry = bench_serve_cache(ppath, prows, smoke=args.smoke)
+            if isinstance(results.get("serve"), dict):
+                results["serve"]["result_cache"] = entry
+            else:
+                results["serve"] = {"result_cache": entry}
+        except Exception as e:  # noqa: BLE001
+            log(f"serve_cache bench FAILED: {e!r}")
 
     # Request-lifecycle resilience: the serve sweep under a seeded stall
     # storm, hedging off vs on (p99 cut + win rate), a brownout shed

@@ -109,6 +109,13 @@ def test_make_record_fingerprint(monkeypatch):
     # the fingerprint says so
     assert rec["env"]["TPQ_LINK_MBPS"] == "350"
     assert rec["env"]["TPQ_FORCE_ROUTE"] == "plain"
+    # the result-cache knobs are part of the experiment identity (ISSUE 14):
+    # a warm-cache run and a cache-off run are different experiments
+    monkeypatch.setenv("TPQ_RESULT_CACHE_MB", "128")
+    monkeypatch.setenv("TPQ_RESULT_CACHE_HBM_MB", "32")
+    rec2 = ledger.make_record(_record(c=_cfg()), ts=123.5)
+    assert rec2["env"]["TPQ_RESULT_CACHE_MB"] == "128"
+    assert rec2["env"]["TPQ_RESULT_CACHE_HBM_MB"] == "32"
     assert "python" in rec["env"]
     # inside this repo the short revision resolves
     rev = rec["git_rev"]

@@ -285,12 +285,13 @@ def _full_registry():
 def test_registry_tree_golden_keys():
     tree = _full_registry().as_dict()
     assert set(tree) == {"obs_version", "pipeline", "reader", "loader",
-                         "io", "data_errors", "device", "serve", "alloc",
-                         "histograms"}
+                         "io", "data_errors", "device", "serve", "cache",
+                         "alloc", "histograms"}
     assert tree["io"] is None  # no IO-backend stats were folded in
     assert tree["data_errors"] is None  # no quarantine engine folded in
     assert tree["device"] is None  # no device timing was folded in
     assert tree["serve"] is None  # no scan service folded in
+    assert tree["cache"] is None  # no result cache folded in
     assert tree["obs_version"] == OBS_VERSION
     assert tree["alloc"] == {"peak_bytes": 4096, "device_peak_bytes": 0}
     assert set(tree["histograms"]) == {"stage.io", "stage.stage"}
@@ -332,6 +333,47 @@ def test_registry_merge_from_and_dict():
     assert a.as_dict()["pipeline"]["chunks"] == 3
     with pytest.raises(ValueError):
         a.merge_dict({"obs_version": 99})
+
+
+def test_registry_cache_section_golden_keys_and_merge():
+    """The result-cache `cache` section (ISSUE 14): per-tier golden keys,
+    and the merge contract — flows add, the byte/entry gauges max (two
+    snapshots of one shared cache must not sum its footprint)."""
+    from tpu_parquet.serve import ResultCache
+
+    rc = ResultCache(max_bytes=1 << 20, hbm_bytes=1 << 20,
+                     chunks_enabled=True)
+    fk = ("file", "/x", 10, 1)
+    rc.put(ResultCache.chunk_key(fk, 0, "a", ("host", "v1")), b"v", 8,
+           "host")
+    rc.get(ResultCache.chunk_key(fk, 0, "a", ("host", "v1")))
+    rc.get(ResultCache.chunk_key(fk, 1, "a", ("host", "v1")))  # miss
+    reg = StatsRegistry()
+    reg.add_cache(rc.counters())
+    tree = reg.as_dict()
+    c = tree["cache"]
+    assert set(c) == {"single_flight_waits", "host", "device"}
+    for tier in ("host", "device"):
+        assert set(c[tier]) == {
+            "hits", "misses", "evictions", "invalidations", "rejected",
+            "held_bytes", "capacity_bytes", "entries", "evict_files",
+            "budget_knob"}
+    assert c["host"]["budget_knob"] == "TPQ_RESULT_CACHE_MB"
+    assert c["device"]["budget_knob"] == "TPQ_RESULT_CACHE_HBM_MB"
+    assert c["host"]["hits"] == 1 and c["host"]["misses"] == 1
+    assert c["host"]["held_bytes"] == 8 and c["host"]["entries"] == 1
+    json.dumps(tree)
+    # merge: flows add, gauges max — twice the same tree doubles hits but
+    # never the held bytes/capacity/entry gauges
+    other = StatsRegistry()
+    other.merge_dict(tree)
+    other.merge_dict(tree)
+    t2 = other.as_dict()["cache"]
+    assert t2["host"]["hits"] == 2 and t2["host"]["misses"] == 2
+    assert t2["host"]["held_bytes"] == c["host"]["held_bytes"]
+    assert t2["host"]["capacity_bytes"] == c["host"]["capacity_bytes"]
+    assert t2["host"]["entries"] == c["host"]["entries"]
+    assert t2["host"]["evict_files"] == {}
 
 
 def test_registry_merge_recomputes_derived_ratios():

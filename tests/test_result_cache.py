@@ -1,0 +1,683 @@
+"""ISSUE 14: the tiered decoded-result cache — hot scans skip decode.
+
+The contracts under test, in rough order of importance:
+
+- STRUCTURAL hit-path proof: a repeated identical scan with the cache warm
+  performs ZERO ``ByteStore.read_range`` calls and ZERO device decode
+  dispatches (the registry ``io``/``device`` sections are unchanged
+  between hit N and hit N+1), and returns bit-identical arrays vs a cold
+  scan — at prefetch {0, 4} x CRC {on, off}, host and device shapes;
+- the ScanService hit path serves straight from the cache (no reader, no
+  store even constructed) and charges the ACTUAL cached decoded size
+  against the admission budget, not the plan's full-decode estimate;
+- the HBM tier registers residency on the cache's AllocTracker device
+  ledger, is visible in flight-dump tracker snapshots, and evicts under
+  device-memory pressure so ``device_peak`` stays bounded;
+- a mutated file invalidates with EXACT accounting — never stale bytes;
+- builds are single-flight: N concurrent first-touches decode once;
+- the PR 10 dict seam is folded in: dictionaries live in the SAME LRU
+  under the same byte budget, and PlanCache's dict counters still work.
+"""
+
+import io
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_parquet.column import ByteArrayData, ColumnData
+from tpu_parquet.device_reader import DeviceFileReader, scan_files
+from tpu_parquet.format import CompressionCodec, FieldRepetitionType as FRT, Type
+from tpu_parquet.iostore import FaultInjectingStore, LocalStore
+from tpu_parquet.reader import FileReader
+from tpu_parquet.schema.core import build_schema, data_column
+from tpu_parquet.serve import (PlanCache, ResultCache, ScanRequest,
+                               ScanService)
+from tpu_parquet.serve.result_cache import column_nbytes
+from tpu_parquet.writer import FileWriter
+
+
+def _strings(vals):
+    return ColumnData(values=ByteArrayData(
+        offsets=np.cumsum([0] + [len(v) for v in vals]),
+        heap=np.frombuffer(b"".join(vals), np.uint8).copy(),
+    ))
+
+
+def _write_file(path, seed=0, groups=2, rows=400):
+    rng = np.random.default_rng(seed)
+    schema = build_schema([
+        data_column("a", Type.INT64, FRT.REQUIRED),
+        data_column("s", Type.BYTE_ARRAY, FRT.REQUIRED),
+    ])
+    pool = [b"alpha", b"beta", b"gamma", b"delta", b""]
+    with open(path, "wb") as fh:
+        with FileWriter(fh, schema, codec=CompressionCodec.SNAPPY) as w:
+            for _g in range(groups):
+                svals = [pool[i] for i in rng.integers(0, len(pool), rows)]
+                w.write_columns({
+                    "a": rng.integers(-(1 << 40), 1 << 40, rows),
+                    "s": _strings(svals),
+                })
+                w.flush_row_group()
+    return path
+
+
+@pytest.fixture(scope="module")
+def afile(tmp_path_factory):
+    d = tmp_path_factory.mktemp("result_cache")
+    return _write_file(str(d / "f.parquet"))
+
+
+def _warm_cache():
+    return PlanCache(result_cache_mb=64, result_cache_hbm_mb=64)
+
+
+def _counting_factory(stores):
+    def factory(f):
+        st = FaultInjectingStore(LocalStore(f))
+        stores.append(st)
+        return st
+    return factory
+
+
+def _assert_cols_equal(got, want):
+    assert set(got) == set(want)
+    for name in want:
+        g, w = got[name], want[name]
+        if isinstance(w.values, ByteArrayData):
+            np.testing.assert_array_equal(g.values.offsets, w.values.offsets)
+            np.testing.assert_array_equal(g.values.heap, w.values.heap)
+        else:
+            np.testing.assert_array_equal(g.values, w.values)
+
+
+# ---------------------------------------------------------------------------
+# the structural acceptance: warm scan = zero reads, zero dispatches,
+# bit-identical, at prefetch {0,4} x CRC {on,off}
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+@pytest.mark.parametrize("crc", [True, False])
+def test_warm_host_scan_zero_reads_bit_identical(afile, prefetch, crc):
+    cache = _warm_cache()
+    stores = []
+    factory = _counting_factory(stores)
+
+    def scan():
+        kw = cache.reader_kwargs(afile, device=False, validate_crc=crc)
+        assert "result_cache" in kw
+        with FileReader(afile, prefetch=prefetch, validate_crc=crc,
+                        store=factory, **kw) as r:
+            out = r.read_all()
+            reg = r.obs_registry().as_dict()
+        return out, reg, stores[-1].stats.reads
+
+    cold, _reg0, cold_reads = scan()
+    assert cold_reads > 0  # the cold scan actually read bytes
+    warm1, reg1, reads1 = scan()
+    warm2, reg2, reads2 = scan()
+    # ZERO store reads on the warm path, both times
+    assert reads1 == 0 and reads2 == 0
+    # the registry io section is unchanged between hit N and hit N+1
+    assert reg1["io"] == reg2["io"]
+    assert reg1["io"]["reads"] == 0
+    _assert_cols_equal(warm1, cold)
+    _assert_cols_equal(warm2, cold)
+
+
+def _dispatches(reg):
+    dev = reg.get("device") or {}
+    return sum(int(c.get("dispatches", 0))
+               for c in (dev.get("routes") or {}).values())
+
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+@pytest.mark.parametrize("crc", [True, False])
+def test_warm_device_scan_zero_reads_zero_dispatches(afile, prefetch, crc):
+    cache = _warm_cache()
+    stores = []
+    factory = _counting_factory(stores)
+
+    def scan():
+        kw = cache.reader_kwargs(afile, device=True, validate_crc=crc)
+        assert "result_cache" in kw
+        with DeviceFileReader(afile, prefetch=prefetch, validate_crc=crc,
+                              store=factory, **kw) as r:
+            out = [{k: np.asarray(v.to_host()) for k, v in g.items()}
+                   for g in r.iter_row_groups()]
+            reg = r.obs_registry().as_dict()
+        return out, reg, stores[-1].stats.reads
+
+    cold, reg0, cold_reads = scan()
+    assert cold_reads > 0
+    assert _dispatches(reg0) > 0  # the cold scan dispatched device work
+    warm1, reg1, reads1 = scan()
+    warm2, reg2, reads2 = scan()
+    # ZERO reads and ZERO new device decode dispatches on the warm path
+    assert reads1 == 0 and reads2 == 0
+    assert _dispatches(reg1) == 0 and _dispatches(reg2) == 0
+    # io and device registry sections unchanged between hit N and hit N+1
+    assert reg1["io"] == reg2["io"]
+    assert reg1["device"] == reg2["device"]
+    assert len(warm1) == len(cold) == 2
+    for g1, g2, g3 in zip(cold, warm1, warm2):
+        for k in g1:
+            np.testing.assert_array_equal(g1[k], g2[k])
+            np.testing.assert_array_equal(g1[k], g3[k])
+
+
+def test_scan_files_plan_cache_second_sweep_reads_nothing(tmp_path):
+    files = [_write_file(str(tmp_path / f"z{i}.parquet"), seed=i)
+             for i in range(3)]
+    cache = _warm_cache()
+    stores = []
+    factory = _counting_factory(stores)
+
+    def sweep():
+        stores.clear()
+        out = []
+        for cols in scan_files(files, columns=["a"], plan_cache=cache,
+                               store=factory):
+            out.append(np.asarray(cols["a"].to_host()))
+        return np.concatenate(out), sum(st.stats.reads for st in stores)
+
+    first, reads1 = sweep()
+    second, reads2 = sweep()
+    assert reads1 > 0 and reads2 == 0  # the whole second sweep read NOTHING
+    np.testing.assert_array_equal(first, second)
+    c = cache.results.counters()["device"]
+    assert c["hits"] >= 6  # 3 files x 2 row groups x 1 column
+
+
+# ---------------------------------------------------------------------------
+# ScanService: the hit path and the admission-charge satellite
+# ---------------------------------------------------------------------------
+
+def test_service_hit_path_constructs_no_store(afile):
+    stores = []
+    factory = _counting_factory(stores)
+    with ScanService(concurrency=2, store=factory,
+                     result_cache_mb=64) as svc:
+        cold = svc.scan(ScanRequest(afile))[afile]
+        n_after_cold = len(stores)
+        warm = svc.scan(ScanRequest(afile))[afile]
+        # the hit path never opened a reader — so no store was constructed
+        assert len(stores) == n_after_cold
+        _assert_cols_equal(warm, cold)
+        c = svc.cache.results.counters()["host"]
+        assert c["hits"] >= 4  # 2 row groups x 2 columns served from cache
+
+
+def test_service_hit_path_charges_actual_cached_bytes(afile):
+    cache = _warm_cache()
+    with ScanService(concurrency=1, cache=cache) as svc:
+        svc.scan(ScanRequest(afile))  # populate
+    key = cache.file_key(afile)
+    plan = cache.plan(key, None, None)
+    units = [ResultCache.chunk_key(key, rg, c, ("host", "v1"))
+             for rg in plan.selected_ordinals() for c in plan.columns]
+    got = cache.results.lookup_units(units)
+    assert got is not None
+    actual = sum(n for _v, n in got)
+    estimate = plan.estimated_bytes()
+    assert actual != estimate  # the two charges are distinguishable here
+    with ScanService(concurrency=1, cache=cache,
+                     max_memory=1 << 30) as svc2:
+        out = svc2.scan(ScanRequest(afile))[afile]
+        assert out["a"].num_leaf_slots > 0
+        # the satellite fix: the hit path charged the ACTUAL cached size,
+        # not plan.estimated_bytes() — hot traffic never queues behind a
+        # phantom full-decode charge
+        assert svc2._budget.peak == actual
+
+
+def test_service_without_result_cache_unchanged(afile):
+    # TPQ_RESULT_CACHE_MB unset: the tier is off, requests run readers
+    with ScanService(concurrency=1) as svc:
+        assert not svc.cache.results.chunks_enabled
+        a = svc.scan(ScanRequest(afile))[afile]
+        b = svc.scan(ScanRequest(afile))[afile]
+        _assert_cols_equal(a, b)
+        c = svc.cache.results.counters()["host"]
+        assert c["entries"] >= 0  # dictionaries may live there; chunks not
+        assert all(k[0] != "chunk" for k in svc.cache.results._entries)
+
+
+# ---------------------------------------------------------------------------
+# HBM tier: residency ledger + eviction under device pressure
+# ---------------------------------------------------------------------------
+
+def test_hbm_tier_residency_and_eviction_bound(tmp_path):
+    path = _write_file(str(tmp_path / "big.parquet"), seed=3, groups=6,
+                       rows=600)
+    # an HBM budget that fits any single column but far below the file's
+    # decoded size: the device tier must evict under pressure (columns
+    # larger than the whole cap would be REJECTED instead — a different
+    # code path) and its peak must stay bounded
+    cap = 24 << 10
+    cache = PlanCache(results=ResultCache(max_bytes=1 << 20, hbm_bytes=cap,
+                                          chunks_enabled=True))
+    kw = cache.reader_kwargs(path, device=True)
+    with DeviceFileReader(path, **kw) as r:
+        for _ in r.iter_row_groups():
+            pass
+    rc = cache.results
+    c = rc.counters()["device"]
+    in_use, peak = rc.tracker.device_snapshot()
+    assert c["evictions"] > 0  # pressure actually evicted
+    assert in_use == c["held_bytes"] <= cap
+    assert peak <= cap  # the bound held at EVERY instant, not just now
+    # residency is visible to flight dumps via the live tracker registry
+    from tpu_parquet.alloc import tracker_snapshots
+
+    assert any(t["device_in_use"] == in_use and t["device_peak"] == peak
+               for t in tracker_snapshots())
+
+
+def test_warm_response_column_order_matches_cold(tmp_path):
+    """Cache temperature must never transpose a response's column order:
+    the warm assembly follows the footer chunk order the readers fill in,
+    not plan.columns' sorted order."""
+    path = str(tmp_path / "order.parquet")
+    schema = build_schema([
+        data_column("zz", Type.INT64, FRT.REQUIRED),
+        data_column("aa", Type.INT64, FRT.REQUIRED),
+    ])
+    rng = np.random.default_rng(2)
+    with open(path, "wb") as fh:
+        with FileWriter(fh, schema, codec=CompressionCodec.SNAPPY) as w:
+            w.write_columns({"zz": rng.integers(0, 9, 100),
+                             "aa": rng.integers(0, 9, 100)})
+    with ScanService(concurrency=1, result_cache_mb=64) as svc:
+        cold = svc.scan(ScanRequest(path))[path]
+        warm = svc.scan(ScanRequest(path))[path]
+    assert list(cold) == ["zz", "aa"]  # footer order, not sorted
+    assert list(warm) == list(cold)
+    _assert_cols_equal(warm, cold)
+
+
+def test_device_pending_publish_bounded_by_tier_capacity(tmp_path):
+    """The publish-at-finalize ledger must not pin every decoded group
+    until the end of the scan: pending residency stays within the device
+    tier's capacity (oldest pending groups are dropped unpublished)."""
+    path = _write_file(str(tmp_path / "big2.parquet"), seed=8, groups=6,
+                       rows=600)
+    cap = 8 << 10
+    cache = PlanCache(results=ResultCache(max_bytes=1 << 20, hbm_bytes=cap,
+                                          chunks_enabled=True))
+    kw = cache.reader_kwargs(path, device=True)
+    with DeviceFileReader(path, **kw) as r:
+        peak_pending = 0
+        for _ in r.iter_row_groups():
+            peak_pending = max(peak_pending, r._rc_pending_bytes)
+        # within 2x the tier cap (the documented pinning bound), modulo
+        # the newest group (never dropped)
+        assert peak_pending <= 2 * cap + (8 << 10)
+        assert len(r._rc_pending) < 6  # old groups were dropped, not kept
+
+
+def test_oversized_entry_rejected_not_admitted():
+    rc = ResultCache(max_bytes=64, hbm_bytes=0, chunks_enabled=True)
+    full = ResultCache.chunk_key(("file", "/x", 1, 1), 0, "a", ("host", "v0"))
+    assert not rc.put(full, b"x" * 100, 100, "host")
+    c = rc.counters()["host"]
+    assert c["rejected"] == 1 and c["entries"] == 0 and c["held_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# invalidation: a mutated file can never serve stale decoded bytes
+# ---------------------------------------------------------------------------
+
+def test_mutation_invalidates_exactly_never_stale(tmp_path):
+    path = _write_file(str(tmp_path / "mut.parquet"), seed=5)
+    cache = _warm_cache()
+    with ScanService(concurrency=1, cache=cache) as svc:
+        first = svc.scan(ScanRequest(path))[path]
+        svc.scan(ScanRequest(path))  # provably warm
+        entries_before = cache.results.counters()["host"]["entries"]
+        inv0 = cache.results.counters()["host"]["invalidations"]
+        assert entries_before > 0
+        _write_file(path, seed=6)  # new bytes, same shape
+        st = os.stat(path)
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        after = svc.scan(ScanRequest(path))[path]
+        inv1 = cache.results.counters()["host"]["invalidations"]
+    # exact accounting: EVERY entry of the old generation was invalidated
+    assert inv1 - inv0 == entries_before
+    # and the served bytes are the new file's, never stale
+    assert not np.array_equal(first["a"].values, after["a"].values)
+    with FileReader(path) as r:
+        fresh = r.read_all()
+    _assert_cols_equal(after, fresh)
+
+
+# ---------------------------------------------------------------------------
+# single-flight: one decode populates all concurrent waiters
+# ---------------------------------------------------------------------------
+
+def test_single_flight_builds_once_for_concurrent_waiters():
+    rc = ResultCache(max_bytes=1 << 20, hbm_bytes=0, chunks_enabled=True)
+    full = ResultCache.chunk_key(("file", "/x", 1, 1), 0, "a", ("host", "v0"))
+    builds = []
+    gate = threading.Event()
+    started = threading.Event()
+
+    def build():
+        builds.append(threading.get_ident())
+        started.set()
+        gate.wait(5)
+        return b"value", 5
+
+    results, errors = [], []
+
+    def worker():
+        try:
+            results.append(rc.get_or_build(full, build, "host"))
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    threads[0].start()
+    started.wait(5)  # the first builder is inside build()
+    for t in threads[1:]:
+        t.start()
+    import time
+
+    time.sleep(0.1)  # let the waiters queue up on the build lock
+    gate.set()
+    for t in threads:
+        t.join(10)
+    assert not errors, errors
+    assert len(builds) == 1  # ONE decode populated all six callers
+    assert all(v == b"value" for v in results)
+    assert rc.single_flight_waits >= 1
+    c = rc.counters()["host"]
+    assert c["misses"] == 1 and c["hits"] == 5
+
+
+def test_single_flight_failed_build_not_published():
+    rc = ResultCache(max_bytes=1 << 20, hbm_bytes=0, chunks_enabled=True)
+    full = ResultCache.chunk_key(("file", "/x", 1, 1), 0, "a", ("host", "v0"))
+
+    def bad():
+        raise ValueError("decode failed")
+
+    with pytest.raises(ValueError):
+        rc.get_or_build(full, bad, "host")
+    assert rc.get(full) is None  # a failed decode is never servable
+    assert rc.get_or_build(full, lambda: (b"ok", 2), "host") == b"ok"
+
+
+# ---------------------------------------------------------------------------
+# the dict-cache fold: one LRU, one byte budget
+# ---------------------------------------------------------------------------
+
+def test_dictionaries_fold_into_result_cache_lru(afile):
+    cache = PlanCache()  # result tier off: the dict store still works
+    kw = cache.reader_kwargs(afile, device=False)
+    with FileReader(afile, **kw) as r:
+        r.read_all()
+    with FileReader(afile, **cache.reader_kwargs(afile, device=False)) as r:
+        r.read_all()
+    c = cache.counters()
+    assert c["dict_hits"] > 0  # the PR 10 seam still serves
+    # the decoded dictionaries live in the RESULT cache's LRU (one LRU,
+    # one byte budget) — not in the plan cache's entry map
+    rcounters = cache.results.counters()["host"]
+    assert rcounters["entries"] > 0 and rcounters["held_bytes"] > 0
+    assert all(k[0] in ("footer", "plan") for k in cache._entries)
+    assert all(k[0] == "dict" for k in cache.results._entries)
+    # ...and the dict store is bounded by the plan cache's budget when the
+    # result tier is unsized
+    assert (cache.results.tier_capacity("host") == cache.max_bytes)
+
+
+def test_dict_fallback_shares_plan_budget():
+    """With the result tier unsized, dictionary bytes ride the plan
+    cache's ONE budget: the same footer load that fits an empty cache
+    evicts once dictionaries hold most of it."""
+    k1, k2 = ("file", "/x", 1, 1), ("file", "/y", 1, 1)
+    lean = PlanCache(max_bytes=1000)
+    lean._put("footer", (k1,), "f1", 300)
+    lean._put("footer", (k2,), "f2", 300)
+    assert lean.counters()["evictions"] == 0  # 600B fits the 1000B budget
+    full = PlanCache(max_bytes=1000)
+    assert full.results.dict_fallback_active
+    full.dict_put(k1, 0, "a", "host:v0", b"d", 900)
+    full._put("footer", (k1,), "f1", 300)
+    full._put("footer", (k2,), "f2", 300)
+    assert full.counters()["evictions"] >= 1  # displaced by dict bytes
+    # a sized result tier detaches the dictionary store from this budget
+    assert not _warm_cache().results.dict_fallback_active
+
+
+# ---------------------------------------------------------------------------
+# obs: doctor verdict + serve-stats CLI
+# ---------------------------------------------------------------------------
+
+def _thrash_tree():
+    return {
+        "obs_version": 1,
+        "pipeline": {"stage_seconds": 0.2, "io_seconds": 0.1},
+        "reader": {},
+        "cache": {
+            "single_flight_waits": 0,
+            "host": {"hits": 3, "misses": 17, "evictions": 40,
+                     "invalidations": 0, "rejected": 0,
+                     "held_bytes": 900, "capacity_bytes": 1024,
+                     "entries": 4, "budget_knob": "TPQ_PLAN_CACHE_MB",
+                     "evict_files": {"/data/hot.parquet": 25,
+                                     "/data/cold.parquet": 15}},
+            "device": {"hits": 0, "misses": 0, "evictions": 0,
+                       "invalidations": 0, "rejected": 0, "held_bytes": 0,
+                       "capacity_bytes": 0, "entries": 0,
+                       "evict_files": {}},
+        },
+    }
+
+
+def test_doctor_cache_thrash_verdict(tmp_path):
+    from tpu_parquet.obs import doctor_registry
+
+    rep = doctor_registry(_thrash_tree())
+    ct = rep["cache"]
+    assert ct["verdict"] == "cache-thrash"
+    assert ct["tier"] == "host"
+    assert ct["top_evict_file"] == "/data/hot.parquet"
+    assert ct["top_evict_count"] == 25
+    assert ct["evictions"] == 40
+    # merged registries ADD the per-file eviction counts, so the ranking
+    # stays truthful across snapshots (a scalar top-file pair could not)
+    from tpu_parquet.obs import StatsRegistry
+
+    merged = StatsRegistry()
+    merged.merge_dict(_thrash_tree())
+    merged.merge_dict(_thrash_tree())
+    mt = merged.as_dict()["cache"]["host"]["evict_files"]
+    assert mt == {"/data/hot.parquet": 50, "/data/cold.parquet": 30}
+    # a healthy cache (high hit rate) never trips the verdict
+    healthy = _thrash_tree()
+    healthy["cache"]["host"].update(hits=90, misses=10)
+    assert "cache" not in doctor_registry(healthy)
+    # CLI renders the verdict and names the knob
+    path = str(tmp_path / "reg.json")
+    with open(path, "w") as f:
+        json.dump(_thrash_tree(), f)
+    from tpu_parquet.cli import pq_tool
+
+    buf = io.StringIO()
+    rc = pq_tool.cmd_doctor(
+        type("A", (), {"file": path, "config": None})(), out=buf)
+    out = buf.getvalue()
+    assert rc == 0
+    assert "cache-thrash" in out and "hot.parquet" in out
+    # the advisory names the knob that GOVERNS the tier (the fixture is a
+    # dict-fallback host tier riding the plan cache's budget)
+    assert "TPQ_PLAN_CACHE_MB" in out
+
+
+def test_serve_stats_cli_result_cache_lines(afile, tmp_path):
+    with ScanService(concurrency=2, result_cache_mb=64) as svc:
+        for _ in range(3):
+            svc.scan(ScanRequest(afile))
+        tree = svc.obs_registry().as_dict()
+    path = str(tmp_path / "reg.json")
+    with open(path, "w") as f:
+        json.dump(tree, f)
+    from tpu_parquet.cli import pq_tool
+
+    buf = io.StringIO()
+    rc = pq_tool.cmd_serve_stats(
+        type("A", (), {"file": path, "config": None})(), out=buf)
+    out = buf.getvalue()
+    assert rc == 0
+    assert "result cache [host]" in out
+    assert "cache hits" in out  # the plan-cache line survives unchanged
+
+
+# ---------------------------------------------------------------------------
+# decode-signature discipline
+# ---------------------------------------------------------------------------
+
+def test_crc_tiers_never_share_entries(afile):
+    cache = _warm_cache()
+    kw = cache.reader_kwargs(afile, device=False, validate_crc=True)
+    with FileReader(afile, validate_crc=True, **kw) as r:
+        r.read_all()
+    hits_before = cache.results.counters()["host"]["hits"]
+    # a validate_crc=False scan has a different signature: it must MISS
+    kw2 = cache.reader_kwargs(afile, device=False, validate_crc=False)
+    with FileReader(afile, validate_crc=False, **kw2) as r:
+        r.read_all()
+    c = cache.results.counters()["host"]
+    assert c["hits"] == hits_before  # no cross-tier adoption
+    assert kw["result_cache"].sig != kw2["result_cache"].sig
+
+
+def test_host_and_device_shapes_never_share_entries(afile):
+    cache = _warm_cache()
+    with FileReader(afile, **cache.reader_kwargs(afile, device=False)) as r:
+        host = r.read_all()
+    kw = cache.reader_kwargs(afile, device=True)
+    with DeviceFileReader(afile, **kw) as r:
+        groups = list(r.iter_row_groups())
+    # both shapes decoded fresh (host hits 0 crossover), both correct
+    got = np.concatenate([np.asarray(g["a"].to_host()) for g in groups])
+    np.testing.assert_array_equal(got, host["a"].values)
+    sigs = {k[4][0] for k in cache.results._entries if k[0] == "chunk"}
+    assert sigs == {"host", "dev"}
+
+
+def test_mismatched_adapter_tier_dropped_not_adopted(afile):
+    """A device-signed adapter handed to a host FileReader (or vice
+    versa) is DROPPED, never adopted: publishing host ColumnData under a
+    device signature would serve host arrays to a later device reader."""
+    cache = _warm_cache()
+    dev_kw = cache.reader_kwargs(afile, device=True)
+    host_kw = dict(dev_kw)  # the wrong-shape hand-off
+    with FileReader(afile, **host_kw) as r:
+        assert r._result_cache is None  # dropped at the door
+        host = r.read_all()
+    # nothing was published under the device signature by the host read
+    assert all(k[4][0] != "dev" for k in cache.results._entries
+               if k[0] == "chunk")
+    # and the device reader now decodes fresh, correct device arrays
+    with DeviceFileReader(afile, **cache.reader_kwargs(afile,
+                                                       device=True)) as r:
+        groups = list(r.iter_row_groups())
+    got = np.concatenate([np.asarray(g["a"].to_host()) for g in groups])
+    np.testing.assert_array_equal(got, host["a"].values)
+    # symmetric: a host-signed adapter is dropped by the device reader
+    with DeviceFileReader(afile, **cache.reader_kwargs(afile,
+                                                       device=False)) as r:
+        assert r._result_cache is None
+
+
+def test_crc_or_fingerprint_mismatched_adapter_dropped(afile):
+    """Adoption validates the WHOLE signature, not just the tier: a
+    v0-signed adapter handed to a validate_crc=True reader (or a
+    device adapter signed for a different predicate fingerprint) is
+    dropped — never a vector for serving unvalidated or wrongly-pruned
+    decodes."""
+    cache = _warm_cache()
+    kw = cache.reader_kwargs(afile, device=False, validate_crc=False)
+    with FileReader(afile, validate_crc=True, **kw) as r:
+        assert r._result_cache is None
+    kwd = cache.reader_kwargs(afile, device=True, validate_crc=False)
+    with DeviceFileReader(afile, validate_crc=True, **kwd) as r:
+        assert r._result_cache is None
+    # a filter-fingerprint mismatch on the device shape is dropped too
+    kwf = cache.reader_kwargs(afile, device=True, row_filter=None)
+    kwf.pop("plan")  # the plan is filter-scoped; let the reader rebuild
+    from tpu_parquet.predicate import col
+
+    with DeviceFileReader(afile, row_filter=col("a") > 0, **kwf) as r:
+        assert r._result_cache is None
+    # and the matching hand-off is adopted
+    ok = cache.reader_kwargs(afile, device=True, validate_crc=True)
+    with DeviceFileReader(afile, validate_crc=True, **ok) as r:
+        assert r._result_cache is not None
+
+
+def test_stale_generation_publisher_rejected():
+    """A scan still bound to a pre-mutation generation must not roll the
+    generation map back: its put is rejected, the fresh warm set stays
+    intact, and its own stale bytes never become servable."""
+    rc = ResultCache(max_bytes=1 << 20, hbm_bytes=0, chunks_enabled=True)
+    g1 = ("file", "/x", 100, 1000)
+    g2 = ("file", "/x", 120, 2000)  # newer mtime: the real current file
+    old_key = ResultCache.chunk_key(g1, 0, "a", ("host", "v1"))
+    rc.put(old_key, b"old", 3, "host")
+    rc.note_generation(g2)  # the footer observed the mutation
+    new_key = ResultCache.chunk_key(g2, 0, "a", ("host", "v1"))
+    assert rc.put(new_key, b"new", 3, "host")
+    # the straggler publishes under g1: rejected, nothing wiped
+    assert not rc.put(old_key, b"stale", 5, "host")
+    assert rc.get(new_key) == b"new"
+    assert rc.get(old_key) is None
+    c = rc.counters()["host"]
+    assert c["rejected"] >= 1 and c["entries"] == 1
+    # a genuinely newer generation still supersedes via put alone
+    g3 = ("file", "/x", 130, 3000)
+    assert rc.put(ResultCache.chunk_key(g3, 0, "a", ("host", "v1")),
+                  b"v3", 2, "host")
+    assert rc.get(new_key) is None  # g2 invalidated by g3
+
+
+def test_straggling_footer_observation_does_not_wipe():
+    """A footer build that STARTED before a mutation and completes after
+    the new generation is warm (its generation is older by mtime) must
+    not roll the generation map back and wipe the fresh working set."""
+    rc = ResultCache(max_bytes=1 << 20, hbm_bytes=0, chunks_enabled=True)
+    g1 = ("file", "/x", 100, 1000)
+    g2 = ("file", "/x", 120, 2000)
+    rc.note_generation(g2)
+    k2 = ResultCache.chunk_key(g2, 0, "a", ("host", "v1"))
+    assert rc.put(k2, b"new", 3, "host")
+    rc.note_generation(g1)  # the straggler's observation: adopts nothing
+    assert rc.get(k2) == b"new"
+    assert rc.counters()["host"]["invalidations"] == 0
+
+
+def test_device_cold_misses_counted_at_prefetch(afile):
+    """The prefetch feed's skip probe is a cold group's ONLY lookup: it
+    must count the misses, or a churning device tier reads ~100% hit."""
+    cache = _warm_cache()
+    kw = cache.reader_kwargs(afile, device=True)
+    with DeviceFileReader(afile, prefetch=4, **kw) as r:
+        for _ in r.iter_row_groups():
+            pass
+    c = cache.results.counters()["device"]
+    assert c["misses"] >= 4  # 2 row groups x 2 columns, all cold
+
+
+def test_column_nbytes_accounting(afile):
+    with FileReader(afile) as r:
+        out = r.read_all()
+    n = column_nbytes(out["s"])
+    assert n == (out["s"].values.offsets.nbytes
+                 + out["s"].values.heap.nbytes)
+    assert column_nbytes(out["a"]) == out["a"].values.nbytes

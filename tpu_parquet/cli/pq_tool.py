@@ -399,6 +399,18 @@ def cmd_doctor(args, out=sys.stdout) -> int:
         # TPQ_DEVICE_TIMING=0): explicitly n/a, never a KeyError
         out.write("device: n/a (no device section — record predates device "
                   "timing, or TPQ_DEVICE_TIMING=0)\n")
+    ct = rep.get("cache")
+    if ct:
+        top = ct.get("top_evict_file")
+        knob = ct.get("budget_knob") or "TPQ_RESULT_CACHE_MB"
+        out.write(f"cache-thrash: {ct['tier']} tier churning "
+                  f"({ct['evictions']} evictions at "
+                  f"{100 * ct['hit_rate']:.0f}% hit rate"
+                  + (f"; top evictor {top} x{ct['top_evict_count']}"
+                     if top else "")
+                  + f") — the working set exceeds "
+                    f"{ct['capacity_bytes']} bytes; raise {knob} or shard "
+                    f"the hot set\n")
     co = rep.get("circuit_open")
     if co:
         out.write(f"circuit-open: {', '.join(co['files']) or '?'} "
@@ -553,6 +565,25 @@ def cmd_serve_stats(args, out=sys.stdout) -> int:
             + f"  [{cache.get('held_bytes', 0)} B held, "
               f"{cache.get('evictions', 0)} evicted, "
               f"{cache.get('invalidations', 0)} invalidated]\n")
+    rcache = tree.get("cache") or {}
+    for tier in ("host", "device"):
+        tc = rcache.get(tier)
+        if not isinstance(tc, dict):
+            continue
+        h, m = int(tc.get("hits", 0)), int(tc.get("misses", 0))
+        if not (h + m or tc.get("entries")):
+            continue
+        out.write(
+            f"result cache [{tier}]: {h}/{h + m} hits"
+            + (f" ({100 * h / (h + m):.0f}%)" if h + m else "")
+            + f", {tc.get('held_bytes', 0)}/{tc.get('capacity_bytes', 0)} B"
+              f" held, {tc.get('entries', 0)} entries, "
+              f"{tc.get('evictions', 0)} evicted, "
+              f"{tc.get('invalidations', 0)} invalidated\n")
+    if rcache.get("single_flight_waits"):
+        out.write(f"result cache: {rcache['single_flight_waits']} "
+                  f"single-flight wait(s) (concurrent first-touches "
+                  f"served by one decode)\n")
     hists = tree.get("histograms") or {}
     slo = [(name.split(".", 1)[1], LatencyHistogram.from_dict(hd))
            for name, hd in sorted(hists.items())

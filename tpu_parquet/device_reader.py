@@ -3766,7 +3766,8 @@ class DeviceFileReader:
                  row_filter=None, prefetch: int = 0, trace=None,
                  sample_ms=None, hang_s=None, hang_policy=None,
                  store=None, on_data_error=None, quarantine=None,
-                 metadata=None, plan=None, dict_cache=None, cancel=None):
+                 metadata=None, plan=None, dict_cache=None,
+                 result_cache=None, cancel=None):
         from .obs import (Sampler, Watchdog, register_flight_registry,
                           resolve_hang_s, resolve_sample_ms, resolve_tracer)
         from .pipeline import PipelineStats
@@ -3800,6 +3801,36 @@ class DeviceFileReader:
         # decoded-dictionary read-through cache (serve.BoundDictCache duck
         # type: get(rg, column, kind) / put(rg, column, kind, value, nbytes))
         self._dict_cache = dict_cache
+        # decoded device-result cache (serve.BoundResultCache bound to the
+        # DEVICE decode signature — deliberately NOT forwarded to the host
+        # FileReader above: host ColumnData and device arrays are
+        # different decode shapes and must never share entries).  An
+        # adapter whose signature doesn't match THIS reader's shape, CRC
+        # tier, or predicate fingerprint is dropped, not adopted — a
+        # validate_crc=True request must never adopt an unvalidated
+        # decode, and page-pruned output is only shared under the exact
+        # same fingerprint.  A row group whose every selected column is
+        # cached skips IO, staging, and every device kernel; misses
+        # populate at finalize — the one point that proves the deferred
+        # validity checks passed.
+        if result_cache is not None:
+            from .scanplan import predicate_fingerprint
+
+            sig = getattr(result_cache, "sig", None) or ()
+            want = ("dev", "v1" if validate_crc else "v0",
+                    predicate_fingerprint(self._host.row_filter))
+            if tuple(sig[:3]) != want:
+                result_cache = None
+        self._result_cache = result_cache
+        # rc-pending ledger: id(out dict) -> [rg index, out, dispatched,
+        # nbytes]; flushed to the cache by _flush_result_cache (via
+        # _finalize_many).  BOUNDED by the cache tier's capacity: a
+        # deferred-finalize multi-file scan must not pin every group's
+        # decoded output until the end — beyond the bound the OLDEST
+        # pending group is simply dropped (a forgone cache fill, never a
+        # correctness or memory cost).
+        self._rc_pending: dict = {}
+        self._rc_pending_bytes = 0
         # data-error containment engine, SHARED with the host half so the
         # budget and quarantine ledger span both decode paths
         self.quarantine = self._host.quarantine
@@ -3873,6 +3904,11 @@ class DeviceFileReader:
             # quarantined-unit accounting as a live curve: a corruption
             # burst is visible next to the lane it degraded
             self._sampler.add_source("data_errors", self.quarantine.progress)
+            if self._result_cache is not None:
+                # result-cache hit/miss/eviction flows as a live curve
+                # next to the decode lanes they spare
+                self._sampler.add_source("result_cache",
+                                         self._result_cache.cache.progress)
             if self._device_timer.enabled:
                 # the device lane as a curve (slope = live device
                 # throughput); on hosts where the timing lane dropped
@@ -4033,6 +4069,19 @@ class DeviceFileReader:
         overlapped by the iter_row_groups pipeline.
         """
         rg = self.metadata.row_groups[index]
+        if self._result_cache is not None:
+            fed_cached = collected is not None and collected.get("cached")
+            if fed_cached or collected is None:
+                hit = self._cached_group(index)
+                if hit is not None:
+                    # warm group: no IO, no staging, no device dispatch —
+                    # _dispatch_row_group sees zero plans and passes
+                    # straight through
+                    return hit, [], None
+                if fed_cached:
+                    # evicted between the feed's probe and here: decode
+                    # fresh on the sequential path (the feed read nothing)
+                    collected = None
         import time as _time
 
         t0 = _time.perf_counter()
@@ -4166,7 +4215,60 @@ class DeviceFileReader:
         tr = self._pipe_stats.tracer
         if tr is not None and tr.active:
             tr.complete("prepare", t0, now, rg=index, bytes=stager.total)
+        if self._result_cache is not None:
+            # miss path: remember this group's output dict (dispatch fills
+            # it in place); _flush_result_cache publishes it only after
+            # finalize proves the deferred checks passed AND the group was
+            # actually dispatched (a prepared-but-never-dispatched dict
+            # still holds placeholders, not results)
+            self._rc_pending[id(out)] = [index, out, False, 0]
         return out, plans, stager
+
+    def _cached_group(self, index: int) -> "dict | None":
+        """All-or-nothing decoded-result probe for row group ``index``:
+        every selected column cached under this reader's decode signature,
+        or None.  A hit counts into the reader's row/group accounting
+        (rows from the widest column's leaf-slot count — accounting only)
+        so throughput math keeps describing what was SERVED."""
+        rc = self._result_cache
+        names = [".".join(l.path) for l in self.schema.selected_leaves()]
+        if not names:
+            return None
+        got = rc.lookup_group(index, names)
+        if got is None:
+            return None
+        import time as _time
+
+        now = _time.perf_counter()
+        if self._t0 is None:
+            self._t0 = now
+        self._stats.row_groups += 1
+        self._stats.rows += max(
+            (int(getattr(c, "num_leaf_slots", 0) or 0) for c in got.values()),
+            default=0)
+        self._stats.wall_seconds = now - self._t0
+        tr = self._pipe_stats.tracer
+        if tr is not None and tr.active:
+            tr.instant("result_cache_hit", rg=index, columns=len(got))
+        return dict(got)
+
+    def _flush_result_cache(self) -> None:
+        """Publish dispatched groups' decoded columns to the result cache.
+        Called after the deferred validity checks pass (finalize /
+        _finalize_many) — never before: a value that would fail
+        finalization must never be servable."""
+        rc = self._result_cache
+        if rc is None or not self._rc_pending:
+            return
+        pending, self._rc_pending = self._rc_pending, {}
+        self._rc_pending_bytes = 0
+        from .serve.result_cache import device_column_nbytes
+
+        for index, out, dispatched, _nbytes in pending.values():
+            if not dispatched:
+                continue
+            for name, col in out.items():
+                rc.put(index, name, col, device_column_nbytes(col))
 
     def _note_staged(self, stager, buf_dev, t0: float) -> None:
         """One staged row-group buffer just shipped: account its HBM
@@ -4230,6 +4332,34 @@ class DeviceFileReader:
             with self._stats_lock:
                 self._stats.dispatch_seconds += _time.perf_counter() - t1
             self._note_dispatched(stager)
+        if self._result_cache is not None:
+            ent = self._rc_pending.get(id(out))
+            if ent is not None:
+                # the group's columns are now real decoded results (or it
+                # had no device work at all) — eligible to publish once
+                # finalize proves the deferred checks.  Pending residency
+                # is bounded by the tier's capacity: past it the oldest
+                # pending group is dropped unpublished, so a streaming
+                # consumer's memory profile stays within cache-budget of
+                # the cache-off scan even when finalize is deferred to
+                # the end of a multi-file sweep.
+                from .serve.result_cache import device_column_nbytes
+
+                ent[2] = True
+                ent[3] = sum(device_column_nbytes(c) for c in out.values())
+                self._rc_pending_bytes += ent[3]
+                # 2x the tier capacity: bounded pinning, while the flush
+                # can still OVERFILL the tier enough to exercise eviction
+                # (a bound at exactly the capacity would starve it)
+                cap = 2 * self._result_cache.cache.tier_capacity(
+                    self._result_cache.tier)
+                while (self._rc_pending_bytes > cap
+                       and len(self._rc_pending) > 1):
+                    oldest = next(iter(self._rc_pending))
+                    if oldest == id(out):
+                        break
+                    dropped = self._rc_pending.pop(oldest)
+                    self._rc_pending_bytes -= dropped[3]
         now = _time.perf_counter()
         if self._t0 is not None:
             self._stats.wall_seconds = now - self._t0
@@ -4458,17 +4588,20 @@ def _finalize_many(readers) -> None:
     readers costs one round trip total, and callers place it after the last
     dispatch so nothing downstream is poisoned."""
     deferred = [d for r in readers for d in r._deferred]
-    if not deferred:
-        return
-    host_max = np.asarray(_stack_jit([m for m, _, _ in deferred]))
-    for mx, (_, dict_len, path) in zip(host_max, deferred):
-        if int(mx) >= dict_len:
-            raise ParquetError(
-                f"dictionary index {int(mx)} out of range ({dict_len}) "
-                f"in column {path}"
-            )
+    if deferred:
+        host_max = np.asarray(_stack_jit([m for m, _, _ in deferred]))
+        for mx, (_, dict_len, path) in zip(host_max, deferred):
+            if int(mx) >= dict_len:
+                raise ParquetError(
+                    f"dictionary index {int(mx)} out of range ({dict_len}) "
+                    f"in column {path}"
+                )
+        for r in readers:
+            r._deferred = []
+    # the checks passed (or there were none): dispatched groups' decoded
+    # columns are now provably valid — publish them to the result cache
     for r in readers:
-        r._deferred = []
+        r._flush_result_cache()
 
 
 def _timed_stage(reader: DeviceFileReader, stager: _RowGroupStager):
@@ -4596,6 +4729,19 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None,
                 # to this scan's token (the reader's request deadline/
                 # cancel rides it into every store read)
                 sr.set_scan(sr.store.begin_scan(cancel=r._host._cancel))
+            rc = r._result_cache
+            if rc is not None and rc.has_group(
+                    i, [".".join(l.path) for l in r.schema.selected_leaves()],
+                    count_misses=True):
+                # decoded-result hit: the feed reads NOTHING for this
+                # group (no pruning walk, no chunk IO) — prepare re-probes
+                # authoritatively and falls back to a sequential decode in
+                # the rare evicted-in-between race
+                pending[(id(r), i)] = {"r": r, "path": path, "i": i,
+                                       "todo": 1, "chunks": {},
+                                       "rows_dropped": 0, "cached": True}
+                yield (r, None, i, None, None, None, None, None, None, None)
+                continue
             rg = r.metadata.row_groups[i]
             leaves = {l.path: l for l in r.schema.selected_leaves()}
             skip_pages, rows_dropped, planned_bufs = r._plan_page_pruning(
@@ -4705,6 +4851,7 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None,
                 yield r, slot["path"], slot["i"], {
                     "chunks": slot["chunks"],
                     "rows_dropped": slot["rows_dropped"],
+                    "cached": slot.get("cached", False),
                 }
     finally:
         # un-bind the dead feed's budget: a later flight dump (or a reused
@@ -4940,7 +5087,9 @@ def scan_files(paths, columns=None, validate_crc=None,
             # re-scanned file re-parses nothing (ROADMAP item 4's owed
             # footer cache, generalized)
             kw = (plan_cache.reader_kwargs(path, columns=columns,
-                                           row_filter=row_filter)
+                                           row_filter=row_filter,
+                                           device=True,
+                                           validate_crc=validate_crc)
                   if plan_cache is not None else {})
             r = DeviceFileReader(
                 path, columns=columns, validate_crc=validate_crc,

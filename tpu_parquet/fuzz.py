@@ -1042,6 +1042,136 @@ def crafted_fused_plan_blobs() -> "list[bytes]":
     ]
 
 
+def fuzz_result_cache(data: bytes) -> None:
+    """Fuzz target #19: tiered result-cache invariants under arbitrary op
+    streams (serve/result_cache.py).
+
+    The input is an op stream (4 bytes per op: opcode, file, row group,
+    size) driving a SMALL two-tier ResultCache through puts, gets,
+    generation bumps, dictionary traffic, and single-flight builds.  The
+    hard invariants hold after EVERY op:
+
+    - the per-tier byte bound is never exceeded (recomputed from the
+      entries, compared to the ledger — not trusted from the counters);
+    - the device-tier ledger reconciles with the AllocTracker's
+      ``device_snapshot`` at all times (the HBM residency accounting);
+    - a generation bump always invalidates: once a newer generation of a
+      file is cached, NO entry of an older generation is ever served;
+    - single-flight never double-builds: a ``get_or_build`` whose key is
+      already published must not invoke its builder;
+    - key round-trip: the (file key, rg, column, sig) tuple that stored a
+      value retrieves exactly that value while it stays resident.
+    """
+    from .serve.result_cache import ResultCache
+
+    if len(data) < 2:
+        return
+    host_cap = (data[0] % 64 + 1) * 16          # 16..1024 bytes
+    dev_cap = (data[1] % 64) * 16               # 0 = device tier off
+    rc = ResultCache(max_bytes=host_cap, hbm_bytes=dev_cap,
+                     chunks_enabled=True)
+    gens: dict[int, int] = {}
+
+    def fkey(f: int) -> tuple:
+        g = gens.setdefault(f, 0)
+        return ("file", f"f{f}", 64 + g, g)
+
+    def check_invariants() -> None:
+        with rc._lock:
+            by_tier = {"host": 0, "device": 0}
+            by_count = {"host": 0, "device": 0}
+            for (_v, n, t) in rc._entries.values():
+                by_tier[t] += n
+                by_count[t] += 1
+            for t, total in by_tier.items():
+                assert total == rc._bytes[t], "byte ledger drift"
+                # the per-tier recency index tracks the value map exactly
+                assert by_count[t] == len(rc._lru[t]), "LRU index drift"
+                cap = rc._caps[t]
+                if cap > 0:
+                    assert total <= cap, f"{t} byte bound exceeded"
+                else:
+                    assert total == 0, "entries admitted to a 0-cap tier"
+        dev_in_use, _peak = rc.tracker.device_snapshot()
+        assert dev_in_use == rc._bytes["device"], "HBM ledger drift"
+
+    pos = 2
+    while pos + 4 <= len(data):
+        op, f, rg, size = (data[pos], data[pos + 1] % 4, data[pos + 2] % 4,
+                           data[pos + 3])
+        pos += 4
+        col = f"c{(op >> 4) % 3}"
+        dev = bool(op & 0x08) and dev_cap > 0
+        sig = (("dev", "v1", None, None, False) if dev else ("host", "v1"))
+        tier = "device" if dev else "host"
+        full = ResultCache.chunk_key(fkey(f), rg, col, sig)
+        kind = op % 5
+        if kind == 0:
+            val = b"x" * max(size, 1)
+            if rc.put(full, val, max(size, 1), tier):
+                assert rc.get(full) is val, "key round-trip broke"
+        elif kind == 1:
+            rc.get(full)
+        elif kind == 2:
+            # generation bump: cache a unit under the NEW generation, then
+            # prove the old generation can never be served again
+            old = full
+            gens[f] = gens.get(f, 0) + 1
+            rc.put(ResultCache.chunk_key(fkey(f), 0, "c0", ("host", "v1")),
+                   b"g", 1, "host")
+            assert rc.get(old) is None, "stale generation served after bump"
+        elif kind == 3:
+            calls = []
+
+            def build(n=max(size, 1)):
+                calls.append(1)
+                return b"b" * n, n
+
+            rc.get_or_build(full, build, tier)
+            first = len(calls)
+            rc.get_or_build(full, build, tier)
+            if first == 1 and rc.contains_all([full]):
+                assert len(calls) == 1, "single-flight double-built"
+        else:
+            dk = ResultCache.dict_key(fkey(f), rg, col, "host:v1")
+            rc.put(dk, b"d" * max(size, 1), max(size, 1), "host")
+            rc.get(dk)
+        check_invariants()
+    rc.counters()  # reporting must never crash on any reachable state
+    rc.progress()
+
+
+def crafted_result_cache_blobs() -> "list[bytes]":
+    """Hand-crafted ``result_cache`` op streams (and corpus blobs): the
+    shapes a hot serve tier actually produces plus the hostile ones."""
+
+    def ops(*quads):
+        return bytes(b for q in quads for b in q)
+
+    tiny = bytes([0, 4])      # 16B host cap, 64B device cap
+    roomy = bytes([63, 63])   # 1024B host, 1008B device
+    # opcodes: kind = op % 5 (0 put, 1 get, 2 gen-bump, 3 build, 4 dict);
+    # op & 0x08 selects the device tier; bits 4-5 pick the column
+    PUT, GET, BUMP, BUILD, DICT = 0, 1, 2, 3, 4
+    PUT_DEV, BUILD_DEV = 40, 8  # 40 % 5 == 0 & bit3; 8 % 5 == 3 & bit3
+
+    return [
+        # eviction pressure: puts far past the 16B host cap
+        tiny + ops(*[(PUT, 0, i % 4, 12) for i in range(12)]),
+        # generation churn: put / bump / put / bump on one file
+        roomy + ops((PUT, 1, 0, 32), (BUMP, 1, 0, 0), (PUT, 1, 1, 32),
+                    (BUMP, 1, 1, 0), (GET, 1, 0, 0)),
+        # single-flight + dict traffic interleaved on both tiers
+        roomy + ops((BUILD, 0, 0, 64), (DICT, 0, 0, 24),
+                    (BUILD_DEV, 0, 1, 64), (BUILD, 0, 0, 64),
+                    (DICT, 0, 0, 24)),
+        # oversized values: every put must reject, bounds hold
+        tiny + ops((PUT, 2, 0, 255), (PUT_DEV, 2, 1, 255), (GET, 2, 0, 0)),
+        # device-tier pressure with the host tier idle
+        bytes([0, 2]) + ops(*[(PUT_DEV, 3, i % 4, 30) for i in range(8)]),
+    ]
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -1061,6 +1191,7 @@ TARGETS = {
     "scan_plan": fuzz_scan_plan,
     "chaos_schedule": fuzz_chaos_schedule,
     "fused_plan": fuzz_fused_plan,
+    "result_cache": fuzz_result_cache,
 }
 
 
@@ -1264,6 +1395,8 @@ def _seed_inputs(target: str) -> list[bytes]:
         return crafted_chaos_blobs()
     if target == "fused_plan":
         return crafted_fused_plan_blobs()
+    if target == "result_cache":
+        return crafted_result_cache_blobs()
     if target == "loader_state":
         from .data import checkpoint as ck
 

@@ -1218,6 +1218,7 @@ class StatsRegistry:
         self._data_errors: "dict | None" = None
         self._device: "dict | None" = None
         self._serve: "dict | None" = None
+        self._cache: "dict | None" = None
         self._alloc_peak = 0
         self._alloc_device_peak = 0
         self._hists: dict[str, LatencyHistogram] = {}
@@ -1310,6 +1311,19 @@ class StatsRegistry:
                 self._serve = {}
             _merge_num_tree(self._serve, d)
 
+    def add_cache(self, cache_counters) -> None:
+        """Fold a :class:`~tpu_parquet.serve.ResultCache`'s counters in
+        (the ``cache`` section: per-tier hit/miss/eviction/invalidation
+        flows, ``held_bytes``/``capacity_bytes``/``entries`` gauges — the
+        generic merge maxes those by name — and the single-flight wait
+        count).  Raw dicts accepted (they are the native form)."""
+        d = (cache_counters if isinstance(cache_counters, dict)
+             else cache_counters.counters())
+        with self._lock:
+            if self._cache is None:
+                self._cache = {}
+            _merge_num_tree(self._cache, d)
+
     def note_alloc_peak(self, tracker) -> None:
         """Record an :class:`~tpu_parquet.alloc.AllocTracker`'s high-water
         marks (host ``peak`` + device-bytes ``device_peak``; raw ints
@@ -1330,6 +1344,7 @@ class StatsRegistry:
                            if other._data_errors else None)
             device = dict(other._device) if other._device else None
             serve = dict(other._serve) if other._serve else None
+            cache = dict(other._cache) if other._cache else None
             peak = other._alloc_peak
             dev_peak = other._alloc_device_peak
             hists = dict(other._hists)
@@ -1337,7 +1352,8 @@ class StatsRegistry:
             for name, src in (("_pipeline", pipeline), ("_reader", reader),
                               ("_loader", loader), ("_io", io),
                               ("_data_errors", data_errors),
-                              ("_device", device), ("_serve", serve)):
+                              ("_device", device), ("_serve", serve),
+                              ("_cache", cache)):
                 if src is None:
                     continue
                 dst = getattr(self, name)
@@ -1357,7 +1373,8 @@ class StatsRegistry:
         for key, attr in (("pipeline", "_pipeline"), ("reader", "_reader"),
                           ("loader", "_loader"), ("io", "_io"),
                           ("data_errors", "_data_errors"),
-                          ("device", "_device"), ("serve", "_serve")):
+                          ("device", "_device"), ("serve", "_serve"),
+                          ("cache", "_cache")):
             src = tree.get(key)
             if src is None:
                 continue
@@ -1461,6 +1478,7 @@ class StatsRegistry:
                                 if self._data_errors else None),
                 "device": dict(self._device) if self._device else None,
                 "serve": dict(self._serve) if self._serve else None,
+                "cache": dict(self._cache) if self._cache else None,
                 "alloc": {"peak_bytes": self._alloc_peak,
                           "device_peak_bytes": self._alloc_device_peak},
                 "histograms": {n: h.as_dict()
@@ -1635,6 +1653,14 @@ DOCTOR_ERROR_BAND = (0.8, 1.25)
 # delay is mis-set (too aggressive) and doctor says so
 HEDGE_VERDICT_MIN_ISSUED = 8
 HEDGE_VERDICT_MIN_WIN_RATE = 0.2
+# cache-thrash advisory thresholds (the result cache's `cache` section):
+# a tier evicting at least this many entries while serving under this hit
+# rate is churning — the working set does not fit its byte budget, and
+# doctor names the tier (raise TPQ_RESULT_CACHE_MB / _HBM_MB) and the
+# top-evicting file (or shard it) instead of letting the tier burn decode
+# work it immediately throws away
+CACHE_THRASH_MIN_EVICTIONS = 8
+CACHE_THRASH_MAX_HIT_RATE = 0.5
 
 
 def doctor_registry(tree: dict) -> "dict | None":
@@ -1779,6 +1805,39 @@ def doctor_registry(tree: dict) -> "dict | None":
                     "speedup": round(fp / fm, 2),
                 }
                 break
+    cache_sec = tree.get("cache")
+    cache_sec = cache_sec if isinstance(cache_sec, dict) else {}
+    for tier in ("device", "host"):  # device pressure is the scarcer tier
+        tc = cache_sec.get(tier)
+        if not isinstance(tc, dict):
+            continue
+        ev, hits, misses = g(tc, "evictions"), g(tc, "hits"), g(tc, "misses")
+        lookups = hits + misses
+        rate = hits / lookups if lookups else 0.0
+        if (ev >= CACHE_THRASH_MIN_EVICTIONS and lookups
+                and rate < CACHE_THRASH_MAX_HIT_RATE):
+            # rank the top-evicting file from the per-file map (merged
+            # trees sum counts per file, so the ranking stays truthful
+            # across merged snapshots)
+            files = tc.get("evict_files")
+            files = files if isinstance(files, dict) else {}
+            top = max(files, key=lambda f: (files[f], f)) if files else None
+            out["cache"] = {
+                "verdict": "cache-thrash",
+                "tier": tier,
+                "evictions": int(ev),
+                "hit_rate": round(rate, 3),
+                "held_bytes": int(g(tc, "held_bytes")),
+                "capacity_bytes": int(g(tc, "capacity_bytes")),
+                "top_evict_file": top,
+                "top_evict_count": int(files.get(top, 0)) if top else 0,
+                # the knob that actually governs this tier's budget (the
+                # host tier may be riding the plan cache's in fallback)
+                "budget_knob": tc.get("budget_knob") or (
+                    "TPQ_RESULT_CACHE_HBM_MB" if tier == "device"
+                    else "TPQ_RESULT_CACHE_MB"),
+            }
+            break
     circ = serve.get("circuit")
     circ = circ if isinstance(circ, dict) else {}
     if g(circ, "open_now") > 0:

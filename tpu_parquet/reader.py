@@ -74,6 +74,7 @@ class FileReader:
         quarantine=None,
         plan=None,
         dict_cache=None,
+        result_cache=None,
         cancel=None,
     ):
         from .obs import resolve_tracer
@@ -136,6 +137,23 @@ class FileReader:
             # decoded-dictionary read-through cache (serve.BoundDictCache
             # duck type); threaded into every ChunkDecoder below
             self._dict_cache = dict_cache
+            # decoded column-chunk result cache (serve.BoundResultCache
+            # duck type, bound to this file generation + the HOST decode
+            # signature): a cached (row group, column) unit skips its IO +
+            # decompress + decode entirely; misses decode once under
+            # single-flight and publish for every concurrent waiter.
+            # Served values are shared READ-ONLY.  An adapter whose
+            # signature doesn't match THIS reader's decode shape is
+            # dropped, not adopted: a device-signed one would publish
+            # host ColumnData where jax arrays are expected, and one
+            # signed for a different CRC tier would let a
+            # validate_crc=True request adopt unvalidated decodes.
+            if result_cache is not None:
+                sig = getattr(result_cache, "sig", None) or ()
+                want = ("host", "v1" if validate_crc else "v0")
+                if tuple(sig[:2]) != want:
+                    result_cache = None
+            self._result_cache = result_cache
             from .scanplan import build_scan_plan, predicate_fingerprint
 
             fp = predicate_fingerprint(row_filter)
@@ -364,13 +382,16 @@ class FileReader:
             comp = max(md.total_compressed_size or 0, 0)
             return comp + max(md.total_uncompressed_size or 0, comp)
 
+        rc = self._result_cache
+
         def decode_item(item):
             i, path, chunk, leaf, fetcher = item
             if chunk is None:
                 return i, None, None
-            ctx = {"file": self._source_name, "row_group": i,
-                   "column": ".".join(path)}
-            try:
+            name = ".".join(path)
+            ctx = {"file": self._source_name, "row_group": i, "column": name}
+
+            def decode_chunk():
                 md, offset = validate_chunk_meta(chunk, leaf)
                 alloc = AllocTracker(self.alloc.max_size)
                 alloc.register(md.total_compressed_size)
@@ -379,13 +400,26 @@ class FileReader:
                            if fetcher is not None
                            else sr.pread(offset, md.total_compressed_size))
                 require_full(buf, offset, md.total_compressed_size,
-                             context=f"column {'.'.join(path)}")
+                             context=f"column {name}")
                 with stats.timed("decompress"):
                     dec = ChunkDecoder(leaf, validate_crc=self.validate_crc,
                                        alloc=alloc,
-                                       context={**ctx, "chunk_offset": offset},
+                                       context={**ctx,
+                                                "chunk_offset": offset},
                                        dict_cache=self._dict_cache)
-                    cd = dec.decode(buf, md.codec, md.num_values)
+                    return dec.decode(buf, md.codec, md.num_values)
+
+            try:
+                if rc is not None:
+                    # decoded-result seam (serve/result_cache.py): a warm
+                    # unit is returned without touching the store; a cold
+                    # one decodes ONCE (single-flight across every
+                    # concurrent scan of this file generation) and
+                    # publishes.  Failed decodes are never published.
+                    cd = rc.get_or_build(i, name,
+                                         _cache_build(decode_chunk))
+                else:
+                    cd = decode_chunk()
             except ParquetError as e:
                 # containment seam (quarantine.py): under a skip policy the
                 # failure becomes a marker + a poisoned unit instead of an
@@ -393,9 +427,9 @@ class FileReader:
                 # unit, ordered — so the ledger matches prefetch=0 exactly)
                 if not contain or isinstance(e, DataIntegrityError):
                     raise
-                return i, ".".join(path), _ChunkFailed(e)
+                return i, name, _ChunkFailed(e)
             stats.count_chunk()
-            return i, ".".join(path), cd
+            return i, name, cd
 
         stats.touch_wall()
         for i, name, cd in prefetch_map(gen_items(), decode_item, k,
@@ -476,15 +510,27 @@ class FileReader:
         # bytes are never read (skipChunk parity)
         from .scanplan import row_group_chunks
 
+        rc = self._result_cache
         for path, leaf, chunk, md, offset in row_group_chunks(rg, by_path):
             if self._cancel is not None:
                 self._cancel.check()  # unit boundary: stop issuing new IO
-            out[".".join(path)] = read_chunk(
-                f, chunk, leaf,
-                validate_crc=self.validate_crc, alloc=self.alloc,
-                context={"file": self._source_name, "row_group": index},
-                dict_cache=self._dict_cache, meta=(md, offset),
-            )
+            name = ".".join(path)
+
+            def decode_chunk(chunk=chunk, leaf=leaf, md=md, offset=offset):
+                return read_chunk(
+                    f, chunk, leaf,
+                    validate_crc=self.validate_crc, alloc=self.alloc,
+                    context={"file": self._source_name, "row_group": index},
+                    dict_cache=self._dict_cache, meta=(md, offset),
+                )
+
+            if rc is not None:
+                # decoded-result seam, sequential path: same contract as
+                # the pipelined one (see _decode_row_groups)
+                out[name] = rc.get_or_build(index, name,
+                                            _cache_build(decode_chunk))
+            else:
+                out[name] = decode_chunk()
         missing = set(".".join(p) for p in by_path) - set(out)
         if missing:
             raise ParquetError(f"row group {index} missing columns {sorted(missing)}")
@@ -600,6 +646,17 @@ class FileReader:
             leaf = self.schema.leaf_by_path(tuple(name.split(".")))
             out[name] = column_to_pylist(cd, leaf)
         return out
+
+
+def _cache_build(decode):
+    """Adapt a no-arg chunk decode to the result cache's get_or_build
+    contract (``build() -> (value, nbytes)``)."""
+    def build():
+        from .serve.result_cache import column_nbytes
+
+        cd = decode()
+        return cd, column_nbytes(cd)
+    return build
 
 
 def _concat_column_data(parts: list[ColumnData]) -> ColumnData:

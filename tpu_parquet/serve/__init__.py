@@ -3,23 +3,30 @@
 Many concurrent callers submit scan requests (file set + projection +
 predicate) to one :class:`ScanService`; requests execute over SHARED state —
 a bounded read-through :class:`PlanCache` of parsed footers, ScanPlan IR
-objects (:mod:`tpu_parquet.scanplan`), and decoded dictionary pages — behind
-admission control (bounded queue + :class:`~tpu_parquet.alloc
+objects (:mod:`tpu_parquet.scanplan`), and — above it — a tiered
+:class:`ResultCache` of decoded column-chunk results and dictionary pages
+(host RAM + device HBM; ``TPQ_RESULT_CACHE_MB``/``TPQ_RESULT_CACHE_HBM_MB``)
+so a repeated hot scan skips the IO→decompress→decode pipeline entirely —
+behind admission control (bounded queue + :class:`~tpu_parquet.alloc
 .InFlightBudget`; a full queue fast-rejects with
 :class:`~tpu_parquet.errors.OverloadError`), with per-request p50/p95
 latency SLOs in the registry ``serve`` section.
 
-See README "Serving concurrent scans"; ``pq_tool serve-stats`` prints a
-run's SLO table, and ``pq_tool doctor`` reads ``admission-bound`` when
-queue-wait dominates.
+See README "Serving concurrent scans" / "Serving hot scans from cache";
+``pq_tool serve-stats`` prints a run's SLO table and cache hit rates, and
+``pq_tool doctor`` reads ``admission-bound`` when queue-wait dominates or
+``cache-thrash`` when the result tier churns.
 """
 
 from .cache import BoundDictCache, CacheStats, PlanCache
+from .result_cache import (BoundResultCache, ResultCache, ResultTierStats,
+                           decode_signature)
 from .service import (PRIORITY_HIGH, PRIORITY_LOW, PRIORITY_NORMAL,
                       ScanRequest, ScanService, ScanTicket, ServeStats)
 
 __all__ = [
-    "BoundDictCache", "CacheStats", "PlanCache",
+    "BoundDictCache", "BoundResultCache", "CacheStats", "PlanCache",
     "PRIORITY_HIGH", "PRIORITY_LOW", "PRIORITY_NORMAL",
-    "ScanRequest", "ScanService", "ScanTicket", "ServeStats",
+    "ResultCache", "ResultTierStats", "ScanRequest", "ScanService",
+    "ScanTicket", "ServeStats", "decode_signature",
 ]

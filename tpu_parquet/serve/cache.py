@@ -69,10 +69,26 @@ class PlanCache:
     :class:`~tpu_parquet.serve.ScanService` (or passed to ``scan_files``
     via ``plan_cache=``)."""
 
-    def __init__(self, max_bytes: "int | None" = None):
+    def __init__(self, max_bytes: "int | None" = None, results=None,
+                 result_cache_mb: "int | None" = None,
+                 result_cache_hbm_mb: "int | None" = None):
+        from .result_cache import ResultCache
+
         if max_bytes is None:
             max_bytes = env_int("TPQ_PLAN_CACHE_MB", 256, lo=1) << 20
         self.max_bytes = int(max_bytes)
+        # the tiered decoded-result cache (result_cache.py) this plan cache
+        # feeds: decoded DICTIONARIES live there (one LRU, one byte budget
+        # — the PR 10 dict seam folded), and — when sized (the explicit
+        # MB args, else TPQ_RESULT_CACHE_MB/TPQ_RESULT_CACHE_HBM_MB) —
+        # decoded column-chunk results too.  With the result tier off the
+        # dictionary store stays bounded by THIS cache's budget.
+        self.results = (results if results is not None else ResultCache(
+            max_bytes=(None if result_cache_mb is None
+                       else int(result_cache_mb) << 20),
+            hbm_bytes=(None if result_cache_hbm_mb is None
+                       else int(result_cache_hbm_mb) << 20),
+            dict_fallback_bytes=self.max_bytes))
         self.stats = CacheStats()
         self._lock = threading.Lock()
         # full key -> (value, nbytes); insertion order = recency (LRU)
@@ -175,10 +191,12 @@ class PlanCache:
             # size, mtime_ns) or ("store", token, size); identity = kind +
             # name, generation = the full tuple.
             fk = key[0]
+            moved = False
             if isinstance(fk, tuple) and len(fk) >= 2:
                 ident = fk[:2]
                 prev = self._gen.get(ident)
                 if prev is not None and prev != fk:
+                    moved = True
                     stale = [f for f in self._entries
                              if isinstance(f[1], tuple)
                              and f[1][:2] == ident and f[1] != fk]
@@ -187,10 +205,25 @@ class PlanCache:
                         self._bytes -= n
                         self.stats.invalidations += 1
                 self._gen[ident] = fk
-            while self._bytes > self.max_bytes and len(self._entries) > 1:
+            # ONE byte budget: when the result tier is unsized, the
+            # dictionary store rides THIS cache's budget — its resident
+            # bytes displace footer/plan entries here (a 1/16 slice is
+            # always reserved for footers/plans so a dictionary flood
+            # cannot evict every footer)
+            limit = self.max_bytes
+            if self.results.dict_fallback_active:
+                limit = max(self.max_bytes - self.results.host_held(),
+                            self.max_bytes // 16, 1)
+            while self._bytes > limit and len(self._entries) > 1:
                 _f, (_v, n) = self._entries.popitem(last=False)
                 self._bytes -= n
                 self.stats.evictions += 1
+        if moved:
+            # decoded results invalidate at the same moment plans do — the
+            # mutated file's cached chunks/dictionaries can never be
+            # served, and the result cache's `invalidations` counters must
+            # account them NOW, not whenever a later decode happens by
+            self.results.note_generation(fk)
 
     # -- footers ---------------------------------------------------------------
 
@@ -248,29 +281,71 @@ class PlanCache:
         return self._read_through("plan", (key, cols_sig, fp), build)
 
     # -- decoded dictionaries --------------------------------------------------
+    # Folded into the tiered ResultCache (one LRU, one byte budget with the
+    # decoded chunk results — not a parallel dictionary budget); these
+    # delegates keep the PR 10 seam and its counters stable.
 
     def dict_get(self, key, rg, column, kind):
         if key is None:
             return None
-        return self._get("dict", (key, int(rg), column, kind))
+        from .result_cache import ResultCache
+
+        hit = self.results.get(ResultCache.dict_key(key, rg, column, kind))
+        with self._lock:
+            if hit is not None:
+                self.stats.hits["dict"] += 1
+            else:
+                self.stats.misses["dict"] += 1
+        return hit
 
     def dict_put(self, key, rg, column, kind, value, nbytes) -> None:
         if key is None:
             return
-        self._put("dict", (key, int(rg), column, kind), value, nbytes)
+        from .result_cache import ResultCache
+
+        self.results.put(ResultCache.dict_key(key, rg, column, kind),
+                         value, nbytes, "host")
 
     # -- reader integration ----------------------------------------------------
 
+    def bind_results(self, key, plan, row_filter=None, device: bool = False,
+                     validate_crc=None):
+        """The ONE bind gate for the decoded-result tier (shared by
+        :meth:`reader_kwargs` and ``ScanService``): a filtered DEVICE
+        scan whose predicate has no stable fingerprint gets no result
+        cache — two unfingerprintable predicates must never share
+        page-pruned device output.  Returns a
+        :class:`~tpu_parquet.serve.BoundResultCache` or None."""
+        if device and row_filter is not None and plan.filter_fp is None:
+            return None
+        return self.results.bind(key, device=device,
+                                 validate_crc=validate_crc,
+                                 filter_fp=plan.filter_fp)
+
     def reader_kwargs(self, source, columns=None, row_filter=None,
-                      store: "ByteStore | None" = None) -> dict:
-        """The ``metadata=``/``plan=``/``dict_cache=`` kwargs that make a
+                      store: "ByteStore | None" = None, device: bool = False,
+                      validate_crc=None) -> dict:
+        """The ``metadata=``/``plan=``/``dict_cache=`` (and, when the
+        result tier is sized, ``result_cache=``) kwargs that make a
         ``FileReader``/``DeviceFileReader`` (or ``scan_files``) run over
-        this cache's shared state."""
+        this cache's shared state.  ``device``/``validate_crc`` pin the
+        decode signature of the result tier (see :meth:`bind_results`)
+        and MUST match the consuming reader: the default (host shape,
+        env-resolved CRC) fits a bare ``FileReader``; pass
+        ``device=True`` for ``DeviceFileReader``/``scan_files``.  The
+        readers verify the signature at adoption and drop a mismatched
+        adapter rather than serve the wrong decode shape — a mismatch
+        costs the caching, never correctness."""
         key = self.file_key(source, store)
         meta, schema = self.footer(source, store)
         plan = self.plan(key, columns, row_filter, meta=meta, schema=schema)
-        return {"metadata": meta, "plan": plan,
-                "dict_cache": BoundDictCache(self, key)}
+        kw = {"metadata": meta, "plan": plan,
+              "dict_cache": BoundDictCache(self, key)}
+        rc = self.bind_results(key, plan, row_filter=row_filter,
+                               device=device, validate_crc=validate_crc)
+        if rc is not None:
+            kw["result_cache"] = rc
+        return kw
 
     # -- reporting -------------------------------------------------------------
 

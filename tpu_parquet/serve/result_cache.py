@@ -1,0 +1,571 @@
+"""ResultCache: the tiered decoded-result cache above PlanCache.
+
+PlanCache (ISSUE 10) made the *planning* side of a repeated scan nearly
+free, but every plan-cache hit still pays the dominant cost: the full
+IO→decompress→decode pipeline.  For the serve tier's workload — many users
+re-scanning a hot working set — the decoded values themselves are the
+layer to cache (the reference's L2/L5 split in PAPER.md §1: decoded values
+are a layer).  This module holds them behind ONE two-tier bounded LRU:
+
+- **host tier** (``TPQ_RESULT_CACHE_MB``): decoded column-chunk results
+  (host ``ColumnData``) and decoded dictionary pages — the PR 10
+  ``dict_cache`` seam is SUBSUMED here: one LRU, one byte budget, not two
+  (:class:`~tpu_parquet.serve.PlanCache` delegates ``dict_get``/
+  ``dict_put`` into this cache);
+- **device tier** (``TPQ_RESULT_CACHE_HBM_MB``): decoded
+  ``DeviceColumnData`` resident in HBM.  Residency is registered on the
+  cache's own :class:`~tpu_parquet.alloc.AllocTracker` device ledger
+  (``register_device``/``release_device``) so flight dumps and
+  ``device_snapshot()`` show the cache's HBM footprint, and eviction under
+  device-memory pressure happens WITHIN the device tier — host entries are
+  never sacrificed to relieve HBM, and vice versa.
+
+Keys are ``(file generation key, row group, column, decode signature)``,
+reusing :meth:`PlanCache.file_key` generation semantics: a mutated file
+changes its key, the stale generation is dropped eagerly (``invalidations``
+counted exactly), and stale decoded bytes can never be served.  The decode
+signature (:func:`decode_signature`) covers the decode SHAPE — host vs
+device arrays, the CRC tier, the filter fingerprint (page pruning shapes
+device output), and the ship/fuse route-relevant knobs — so two requests
+share an entry exactly when their decode is bit-identical by contract.
+(The projection dtype is a function of the file generation's schema, so
+the generation key already pins it.)
+
+Builds are SINGLE-FLIGHT on the host chunk seam (``get_or_build``): N
+concurrent first-touches of one chunk decode it once; late arrivals wait
+on the build and adopt the published entry (``single_flight_waits``
+counts them).  The DEVICE seam publishes at finalize instead (the one
+point that proves the deferred validity checks passed), so concurrent
+cold device scans of one file may each decode — the group probe dedupes
+all traffic once the first finalize publishes.  Cached values are shared
+READ-ONLY — the same contract the decoded-dictionary seam already
+carries.
+
+The chunk tier is OFF by default (``TPQ_RESULT_CACHE_MB`` unset/0): a
+plain reader pays nothing.  The serve tier (or ``scan_files(plan_cache=)``
+with a sized cache) turns it on; dictionaries are always cached, bounded
+by the plan cache's budget when no result budget is set.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ..alloc import AllocTracker
+from ..obs import env_int, register_flight_source
+
+__all__ = ["BoundResultCache", "ResultCache", "ResultTierStats",
+           "decode_signature", "column_nbytes", "device_column_nbytes"]
+
+TIERS = ("host", "device")
+
+# per-tier cap on the eviction-attribution map (doctor's `cache-thrash`
+# verdict names the top-evicting file; an unbounded map would let a
+# pathological key stream grow it without limit)
+_EVICT_FILES_CAP = 64
+
+
+def decode_signature(device: bool, validate_crc=None, filter_fp=None):
+    """The decode-shape half of a result key.
+
+    Two lookups may share a cached entry only when their decode is
+    bit-identical by contract: same output shape (host ``ColumnData`` vs
+    device arrays), same CRC tier (a ``validate_crc=True`` request must
+    never adopt an unvalidated decode — the dict-cache precedent), and —
+    for the device shape — the same filter fingerprint (page pruning drops
+    whole-page row runs from device output) and the same route-relevant
+    knobs (``TPQ_FORCE_ROUTE``/``TPQ_FUSE``; routes are bit-identical by
+    contract, the knobs ride the key as cheap insurance against a
+    mid-process knob flip serving a differently-shaped array).
+    """
+    from ..quarantine import resolve_validate
+
+    crc = "v1" if resolve_validate(validate_crc) else "v0"
+    if not device:
+        return ("host", crc)
+    import os
+
+    from ..ship import fuse_enabled
+
+    return ("dev", crc, filter_fp,
+            os.environ.get("TPQ_FORCE_ROUTE") or None,
+            bool(fuse_enabled()))
+
+
+def column_nbytes(cd) -> int:
+    """Accounting size of a host ColumnData (values + levels)."""
+    from ..column import ByteArrayData
+
+    n = 0
+    v = cd.values
+    if isinstance(v, ByteArrayData):
+        n += int(v.offsets.nbytes) + int(v.heap.nbytes)
+    elif v is not None:
+        n += int(v.nbytes)
+    for attr in ("def_levels", "rep_levels"):
+        a = getattr(cd, attr, None)
+        if a is not None:
+            n += int(a.nbytes)
+    return n
+
+
+def device_column_nbytes(cd) -> int:
+    """Accounting size of a DeviceColumnData (every device array it pins,
+    dictionary tables of a lazy DeviceDictColumn included)."""
+    n = 0
+    for attr in ("values", "offsets", "heap", "def_levels", "rep_levels",
+                 "indices", "dict_u8", "dict_offsets", "dict_heap"):
+        a = getattr(cd, attr, None)
+        if a is not None and hasattr(a, "nbytes"):
+            n += int(a.nbytes)
+    return n
+
+
+class ResultTierStats:
+    """One tier's counters.  All flows except the gauges the owner's
+    ``counters()`` computes; mutated only under the owning cache's lock."""
+
+    __slots__ = ("hits", "misses", "evictions", "invalidations", "rejected")
+
+    def __init__(self):
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.rejected = 0
+
+
+class ResultCache:
+    """Two-tier bounded LRU of decoded results.  Thread-safe; one instance
+    is shared by every consumer of a :class:`~tpu_parquet.serve.PlanCache`
+    (which owns one — ``PlanCache().results``).
+
+    ``max_bytes``/``hbm_bytes`` default from ``TPQ_RESULT_CACHE_MB`` /
+    ``TPQ_RESULT_CACHE_HBM_MB`` (MB; 0 disables the tier).  When the host
+    knob is unset the cache still serves as the decoded-DICTIONARY store
+    (the folded PR 10 seam) with ``chunks_enabled`` False — pass
+    ``dict_fallback_bytes`` (the plan cache's budget) so dictionaries stay
+    bounded by exactly one budget either way.
+    """
+
+    def __init__(self, max_bytes: "int | None" = None,
+                 hbm_bytes: "int | None" = None,
+                 chunks_enabled: "bool | None" = None,
+                 dict_fallback_bytes: int = 0):
+        if max_bytes is None:
+            max_bytes = env_int("TPQ_RESULT_CACHE_MB", 0, lo=0) << 20
+        if hbm_bytes is None:
+            hbm_bytes = env_int("TPQ_RESULT_CACHE_HBM_MB", 0, lo=0) << 20
+        if chunks_enabled is None:
+            chunks_enabled = max_bytes > 0 or hbm_bytes > 0
+        self.chunks_enabled = bool(chunks_enabled)
+        # per-tier chunk admission: an unset host knob leaves the host tier
+        # as the dictionary store alone (bounded by the plan cache's
+        # budget), never a silent chunk cache riding the fallback budget
+        self._chunk_tier_ok = {"host": max_bytes > 0, "device": hbm_bytes > 0}
+        # True when the host tier runs as the dictionary store alone on
+        # the PLAN cache's budget — PlanCache then counts these bytes
+        # against its own eviction limit (one budget, not a parallel one)
+        self.dict_fallback_active = max_bytes <= 0 and dict_fallback_bytes > 0
+        if max_bytes <= 0:
+            max_bytes = int(dict_fallback_bytes)
+        self._caps = {"host": int(max_bytes), "device": int(hbm_bytes)}
+        # HBM residency ledger: the device tier's bytes are visible in
+        # flight dumps / device_snapshot() like any staged buffer's
+        self.tracker = AllocTracker(0)
+        self.stats = {t: ResultTierStats() for t in TIERS}
+        self.single_flight_waits = 0
+        self._lock = threading.Lock()
+        # full key -> (value, nbytes, tier); recency lives in the
+        # per-tier index below — ONE combined order would make every
+        # eviction an O(total entries) scan for a same-tier victim
+        self._entries: "dict[tuple, tuple]" = {}
+        # per-tier LRU index: full key -> None, insertion order = recency
+        self._lru = {t: OrderedDict() for t in TIERS}
+        self._bytes = {t: 0 for t in TIERS}
+        # file identity -> current generation (eager stale-generation drop,
+        # same scheme as PlanCache)
+        self._gen: dict = {}
+        # single-flight build locks
+        self._building: dict = {}
+        # keys whose built value exceeded its tier cap: bypass the
+        # single-flight lock for them — otherwise N concurrent scans of an
+        # uncachable chunk would decode it N times SEQUENTIALLY behind the
+        # per-key build lock (each builder's put rejects, each waiter
+        # retries as the next builder).  Bounded; cleared when full.
+        self._uncachable: set = set()
+        # per-tier {file name: evictions} for doctor's cache-thrash verdict
+        self._evict_files = {t: {} for t in TIERS}
+        register_flight_source("result_cache", self, "counters")
+
+    # -- keys ------------------------------------------------------------------
+
+    @staticmethod
+    def chunk_key(file_key, rg: int, column: str, sig) -> tuple:
+        return ("chunk", file_key, int(rg), column, sig)
+
+    @staticmethod
+    def dict_key(file_key, rg: int, column: str, kind) -> tuple:
+        return ("dict", file_key, int(rg), column, kind)
+
+    def tier_capacity(self, tier: str) -> int:
+        return self._caps[tier]
+
+    def host_held(self) -> int:
+        """Host-tier resident bytes (PlanCache's shared-budget input)."""
+        with self._lock:
+            return self._bytes["host"]
+
+    def bind(self, file_key, device: bool = False, validate_crc=None,
+             filter_fp=None) -> "BoundResultCache | None":
+        """The per-(file, decode-shape) adapter the readers duck-call, or
+        None when this cache cannot serve chunk results for it (chunk tier
+        off, un-keyable source, or the shape's tier has no budget)."""
+        if not self.chunks_enabled or file_key is None:
+            return None
+        tier = "device" if device else "host"
+        if not self._chunk_tier_ok[tier] or self._caps[tier] <= 0:
+            return None
+        sig = decode_signature(device, validate_crc, filter_fp)
+        return BoundResultCache(self, file_key, sig)
+
+    # -- core LRU --------------------------------------------------------------
+
+    def _remove_locked(self, full) -> "tuple | None":
+        """Pop ``full`` from the value map AND its tier's recency index,
+        releasing its byte (and device-ledger) accounting."""
+        ent = self._entries.pop(full, None)
+        if ent is None:
+            return None
+        _v, n, tier = ent
+        self._lru[tier].pop(full, None)
+        self._bytes[tier] -= n
+        if tier == "device":
+            self.tracker.release_device(n)
+        return ent
+
+    @staticmethod
+    def _file_name(full) -> str:
+        fk = full[1]
+        if isinstance(fk, tuple) and len(fk) >= 2:
+            return str(fk[1])
+        return str(fk)
+
+    def _note_evict_locked(self, tier: str, full) -> None:
+        files = self._evict_files[tier]
+        name = self._file_name(full)
+        if name not in files and len(files) >= _EVICT_FILES_CAP:
+            return
+        files[name] = files.get(name, 0) + 1
+
+    def get(self, full: tuple):
+        with self._lock:
+            ent = self._entries.get(full)
+            if ent is not None:
+                self._lru[ent[2]].move_to_end(full)
+                self.stats[ent[2]].hits += 1
+                return ent[0]
+            # a get's tier isn't knowable from an absent key; misses are
+            # attributed by the key's kind signature (chunk sig vs dict)
+            self.stats[self._tier_of_key(full)].misses += 1
+            return None
+
+    @staticmethod
+    def _tier_of_key(full) -> str:
+        sig = full[4] if len(full) > 4 else None
+        return ("device" if isinstance(sig, tuple) and sig
+                and sig[0] == "dev" else "host")
+
+    def put(self, full: tuple, value, nbytes: int, tier: str = "host") -> bool:
+        """Insert (shared read-only).  Returns False when the entry was
+        rejected: tier disabled, or bigger than the whole tier — the bound
+        is a hard invariant, never exceeded even transiently, so an
+        oversized value is simply not cached."""
+        nbytes = max(int(nbytes), 1)
+        with self._lock:
+            cap = self._caps[tier]
+            if cap <= 0 or nbytes > cap:
+                self.stats[tier].rejected += 1
+                return False
+            if not self._invalidate_stale_locked(full):
+                # a STALE publisher (a scan still bound to a pre-mutation
+                # generation): rejecting it is the only safe move —
+                # adopting its generation would wipe the fresh warm set
+                # and leave its own stale bytes servable
+                self.stats[tier].rejected += 1
+                return False
+            self._remove_locked(full)
+            # make room FIRST, within this tier only: device-memory
+            # pressure evicts device entries (never host ones), and the
+            # byte bound holds at every instant.  O(1) per victim: each
+            # tier keeps its own recency index.
+            lru = self._lru[tier]
+            while self._bytes[tier] + nbytes > cap and lru:
+                victim = next(iter(lru))
+                self._remove_locked(victim)
+                self.stats[tier].evictions += 1
+                self._note_evict_locked(tier, victim)
+            self._entries[full] = (value, nbytes, tier)
+            lru[full] = None
+            self._bytes[tier] += nbytes
+            if tier == "device":
+                self.tracker.register_device(nbytes)
+            return True
+
+    @staticmethod
+    def _supersedes(new_fk, cur_fk) -> bool:
+        """Does ``new_fk`` supersede the adopted generation ``cur_fk``?
+
+        Local file keys carry ``(kind, path, size, mtime_ns)``: a strictly
+        newer mtime supersedes, an OLDER one is a stale publisher (a scan
+        that outlived a mutation) and must not; equal mtime with a
+        different size is a rewrite on a coarse-mtime filesystem —
+        supersede.  Store keys (``(kind, token, size)``) carry no order:
+        the incoming generation supersedes, as before — every
+        PlanCache-driven flow adopts via :meth:`note_generation` (the
+        authoritative footer observation) first anyway."""
+        if (new_fk[0] == "file" == cur_fk[0] and len(new_fk) >= 4
+                and len(cur_fk) >= 4):
+            if new_fk[3] != cur_fk[3]:
+                return new_fk[3] > cur_fk[3]
+        return True
+
+    def _invalidate_stale_locked(self, full) -> bool:
+        """Generation bookkeeping for an insert under key ``full``.
+
+        A new generation of a file drops EVERY entry of its previous
+        generation (chunks and dictionaries alike) — they can never be
+        served again, so aging them out of the LRU is pure waste, and the
+        ``invalidations`` counters account each one exactly.  Returns
+        False (and adopts nothing) when the inserting key belongs to a
+        generation the adopted one supersedes — a stale publisher (put OR
+        straggling footer observation) must never roll the map back and
+        wipe the fresh working set."""
+        fk = full[1]
+        if not (isinstance(fk, tuple) and len(fk) >= 2):
+            return True
+        ident = fk[:2]
+        prev = self._gen.get(ident)
+        if prev is None or prev == fk:
+            self._gen[ident] = fk
+            return True
+        if not self._supersedes(fk, prev):
+            return False
+        stale = [f for f in self._entries
+                 if isinstance(f[1], tuple) and f[1][:2] == ident
+                 and f[1] != fk]
+        for f in stale:
+            ent = self._remove_locked(f)
+            self.stats[ent[2]].invalidations += 1
+        self._gen[ident] = fk
+        return True
+
+    def note_generation(self, file_key) -> None:
+        """Adopt ``file_key`` as its file's current generation, dropping
+        every cached entry of previous generations (PlanCache calls this
+        the moment a footer read observes the move, so decoded results
+        invalidate in lockstep with plans — never on a later decode's
+        schedule).  The :meth:`_supersedes` ordering applies here too: a
+        STRAGGLING footer build that completes after the file already
+        moved on (its generation is older by mtime) adopts nothing — it
+        must not wipe the fresh generation's warm set."""
+        if not (isinstance(file_key, tuple) and len(file_key) >= 2):
+            return
+        with self._lock:
+            self._invalidate_stale_locked(("gen", file_key))
+
+    def contains_all(self, keys,
+                     count_misses_tier: "str | None" = None) -> bool:
+        """Membership probe for the prefetch feed's skip check.  Hits are
+        NOT counted here (the authoritative, counted probe happens at
+        prepare time); a failed probe counts one miss per key into
+        ``count_misses_tier`` when given — on the prefetch path this IS
+        the only probe a cold group gets, and an uncounted cold stream
+        would make the hit rate read ~100% no matter how hard the tier
+        churned (doctor's cache-thrash gate would never trip)."""
+        with self._lock:
+            ok = all(f in self._entries for f in keys)
+            if not ok and count_misses_tier is not None:
+                self.stats[count_misses_tier].misses += len(keys)
+            return ok
+
+    def get_or_build(self, full: tuple, build, tier: str = "host"):
+        """Get-or-decode with single-flight semantics: exactly one builder
+        per key runs (one counted miss); concurrent callers wait on the
+        build and adopt the published entry (counted as hits +
+        ``single_flight_waits``).  ``build()`` returns ``(value, nbytes)``;
+        a build that raises releases its waiters to retry (a failed decode
+        is never published — quarantine containment sees the same error it
+        would without the cache)."""
+        while True:
+            with self._lock:
+                ent = self._entries.get(full)
+                if ent is not None:
+                    self._lru[ent[2]].move_to_end(full)
+                    self.stats[ent[2]].hits += 1
+                    return ent[0]
+                if full in self._uncachable:
+                    # known too big for its tier: decode in parallel, no
+                    # single-flight serialization for a value that can
+                    # never be published anyway
+                    self.stats[tier].misses += 1
+                    mine, lock = None, None
+                else:
+                    lock = self._building.get(full)
+                    mine = lock is None
+                    if mine:
+                        lock = self._building[full] = threading.Lock()
+                        lock.acquire()
+                    else:
+                        self.single_flight_waits += 1
+            if mine is None:
+                return build()[0]
+            if mine:
+                try:
+                    with self._lock:
+                        self.stats[tier].misses += 1
+                    value, nbytes = build()
+                    if not self.put(full, value, nbytes, tier):
+                        # every rejection reason is permanent for THIS key
+                        # (tier cap, oversized value, stale generation):
+                        # release future callers from the single-flight
+                        # lock so they decode in parallel, not serially
+                        with self._lock:
+                            if len(self._uncachable) >= 1024:
+                                self._uncachable.clear()
+                            self._uncachable.add(full)
+                    return value
+                finally:
+                    with self._lock:
+                        self._building.pop(full, None)
+                    lock.release()
+            else:
+                with lock:
+                    pass  # builder published (→ hit) or failed (→ retry)
+
+    def lookup_units(self, keys, count_misses: bool = False):
+        """All-or-nothing probe of several keys (the full-hit fast paths:
+        a served group/request touches recency and counts one hit per
+        unit; a failed probe counts nothing unless ``count_misses`` — the
+        decode path that follows owns the miss accounting otherwise).
+        Returns ``[(value, nbytes), ...]`` in key order, or None."""
+        with self._lock:
+            out = []
+            for f in keys:
+                ent = self._entries.get(f)
+                if ent is None:
+                    if count_misses:
+                        t = self._tier_of_key(f)
+                        self.stats[t].misses += len(keys)
+                    return None
+                out.append(ent)
+            for f, ent in zip(keys, out):
+                self._lru[ent[2]].move_to_end(f)
+                self.stats[ent[2]].hits += 1
+            return [(e[0], e[1]) for e in out]
+
+    # -- reporting -------------------------------------------------------------
+
+    def counters(self) -> dict:
+        """The registry ``cache`` section: per-tier flows + gauges, plus
+        the single-flight wait count.  ``held_bytes``/``capacity_bytes``/
+        ``entries`` are gauges (obs merges max them); the rest are flows."""
+        with self._lock:
+            out: dict = {"single_flight_waits": self.single_flight_waits}
+            counts = {t: len(self._lru[t]) for t in TIERS}
+            knobs = {
+                # in dict-fallback mode the host tier's budget IS the
+                # plan cache's — doctor's advice must name the knob that
+                # actually governs the thrash
+                "host": ("TPQ_PLAN_CACHE_MB" if self.dict_fallback_active
+                         else "TPQ_RESULT_CACHE_MB"),
+                "device": "TPQ_RESULT_CACHE_HBM_MB",
+            }
+            for t in TIERS:
+                st = self.stats[t]
+                out[t] = {
+                    "hits": st.hits,
+                    "misses": st.misses,
+                    "evictions": st.evictions,
+                    "invalidations": st.invalidations,
+                    "rejected": st.rejected,
+                    "held_bytes": self._bytes[t],
+                    "capacity_bytes": self._caps[t],
+                    "entries": counts[t],
+                    "budget_knob": knobs[t],
+                    # per-file eviction attribution as the raw (bounded)
+                    # map: registry merges recurse into it and ADD counts
+                    # per file — a precomputed "top file" scalar pair
+                    # cannot merge coherently (string LWW + maxed count
+                    # would blame the wrong file).  Doctor ranks it.
+                    "evict_files": dict(self._evict_files[t]),
+                }
+            return out
+
+    # flight-source duck type
+    sample = counters
+
+    def progress(self) -> dict:
+        """Flat monotonic counters for the obs.Sampler track (a live curve
+        of hit/miss/eviction flows next to the decode lanes they spare)."""
+        with self._lock:
+            out = {"single_flight_waits": self.single_flight_waits}
+            for t in TIERS:
+                st = self.stats[t]
+                out[f"{t}_hits"] = st.hits
+                out[f"{t}_misses"] = st.misses
+                out[f"{t}_evictions"] = st.evictions
+            return out
+
+
+class BoundResultCache:
+    """A :class:`ResultCache` bound to one (file generation, decode
+    signature) — the adapter the readers duck-call.  Chunk units are
+    addressed ``(rg, column)``; values are shared READ-ONLY."""
+
+    __slots__ = ("cache", "key", "sig", "tier")
+
+    def __init__(self, cache: ResultCache, key, sig):
+        self.cache = cache
+        self.key = key
+        self.sig = sig
+        self.tier = "device" if sig and sig[0] == "dev" else "host"
+
+    def _full(self, rg: int, column: str) -> tuple:
+        return ResultCache.chunk_key(self.key, rg, column, self.sig)
+
+    def get(self, rg: int, column: str):
+        return self.cache.get(self._full(rg, column))
+
+    def put(self, rg: int, column: str, value, nbytes: int) -> bool:
+        return self.cache.put(self._full(rg, column), value, nbytes,
+                              self.tier)
+
+    def get_or_build(self, rg: int, column: str, build):
+        """``build()`` returns ``(value, nbytes)``; single-flight."""
+        return self.cache.get_or_build(self._full(rg, column), build,
+                                       self.tier)
+
+    def has_group(self, rg: int, columns,
+                  count_misses: bool = False) -> bool:
+        """All-columns membership check for one row group.  Hits are not
+        counted (the prepare-time probe owns hit accounting);
+        ``count_misses`` charges a failed probe's misses — set it on
+        probes that are the group's ONLY cold-path lookup."""
+        cols = list(columns)
+        return self.cache.contains_all(
+            [self._full(rg, c) for c in cols],
+            count_misses_tier=self.tier if count_misses else None)
+
+    def lookup_group(self, rg: int, columns) -> "dict | None":
+        """All-or-nothing probe of one row group's columns (the device
+        reader's group-granular hit path).  Counts hits on success and one
+        miss per column on failure (the group will decode that many
+        units); returns ``{column: value}`` or None."""
+        cols = list(columns)
+        got = self.cache.lookup_units([self._full(rg, c) for c in cols],
+                                      count_misses=True)
+        if got is None:
+            return None
+        return {c: v for c, (v, _n) in zip(cols, got)}

@@ -219,7 +219,9 @@ class ScanService:
                  cache: "PlanCache | None" = None, store=None,
                  hang_s=None, validate_crc=None,
                  brownout: "float | None" = None,
-                 breakers: "BreakerBoard | None" = None):
+                 breakers: "BreakerBoard | None" = None,
+                 result_cache_mb: "int | None" = None,
+                 result_cache_hbm_mb: "int | None" = None):
         from ..iostore import ByteStore
 
         if concurrency is None:
@@ -227,7 +229,13 @@ class ScanService:
         if queue_depth is None:
             queue_depth = env_int("TPQ_SERVE_QUEUE", 2 * concurrency, lo=1)
         self.concurrency = int(concurrency)
-        self.cache = cache if cache is not None else PlanCache()
+        # result_cache_mb/_hbm_mb size the decoded-result tier explicitly
+        # (the TPQ_RESULT_CACHE_* knobs otherwise decide): with it on, a
+        # hot repeated scan becomes a pure cache lookup + batch assembly
+        # (see serve/result_cache.py)
+        self.cache = (cache if cache is not None
+                      else PlanCache(result_cache_mb=result_cache_mb,
+                                     result_cache_hbm_mb=result_cache_hbm_mb))
         self.stats = ServeStats()
         self._hang_s = hang_s
         self._validate_crc = validate_crc
@@ -480,6 +488,23 @@ class ScanService:
                 meta, schema = self.cache.footer(path)
                 plan = self.cache.plan(key, request.columns, pred,
                                        meta=meta, schema=schema)
+                vcrc = (request.validate_crc
+                        if request.validate_crc is not None
+                        else self._validate_crc)
+                # the decoded-result tier (serve/result_cache.py), bound
+                # through the ONE gate PlanCache.bind_results encodes
+                rcache = self.cache.bind_results(
+                    key, plan, row_filter=pred, device=request.device,
+                    validate_crc=vcrc)
+                served = (self._serve_from_cache(rcache, plan, request,
+                                                 token)
+                          if rcache is not None else None)
+                if served is not None:
+                    # pure cache hit: no reader, no store, no device
+                    # dispatch — the file's breaker still notes the success
+                    out[str(path)] = served
+                    self.breakers.note(bkey, str(path), ok=True)
+                    continue
                 charge = min(plan.estimated_bytes(),
                              max(self._budget.max_bytes, 0)) \
                     if self._budget.max_bytes > 0 else 0
@@ -488,12 +513,10 @@ class ScanService:
                 try:
                     kw = dict(columns=request.columns, metadata=meta,
                               row_filter=pred, prefetch=request.prefetch,
-                              validate_crc=(request.validate_crc
-                                            if request.validate_crc
-                                            is not None
-                                            else self._validate_crc),
+                              validate_crc=vcrc,
                               store=self._store, plan=plan,
                               dict_cache=BoundDictCache(self.cache, key),
+                              result_cache=rcache,
                               cancel=token)
                     if request.device:
                         from ..device_reader import DeviceFileReader
@@ -518,6 +541,59 @@ class ScanService:
                 raise
             self.breakers.note(bkey, str(path), ok=True)
         return out
+
+    def _serve_from_cache(self, rcache, plan, request: ScanRequest,
+                          token) -> "dict | None":
+        """The result-cache hit path: when EVERY (surviving row group,
+        selected column) unit of the plan is cached under this request's
+        decode signature, assemble the response straight from the cache —
+        zero ``ByteStore`` reads, zero device dispatches, no reader at all.
+
+        Admission accounting (ISSUE 14 satellite): the hit path charges
+        the ACTUAL cached decoded size against the shared budget, not the
+        plan's full-decode estimate — hot traffic must not queue behind a
+        phantom charge for work it will never do.  Returns None on any
+        missing unit (the reader path decodes and populates)."""
+        ordinals = plan.selected_ordinals()
+        columns = plan.columns
+        if not ordinals or not columns:
+            return None
+        # response dict order must match the reader path's (footer chunk
+        # order — plan.columns is SORTED): a consumer must never see the
+        # same request's columns transposed by cache temperature
+        rgp = next((r for r in plan.row_groups if r.ordinal == ordinals[0]),
+                   None)
+        ordered = ([cp.column for cp in rgp.chunks] if rgp is not None
+                   else list(columns))
+        if set(ordered) != set(columns):
+            ordered = list(columns)
+        columns = ordered
+        units = [rcache._full(rg, c) for rg in ordinals for c in columns]
+        got = rcache.cache.lookup_units(units)
+        if got is None:
+            return None
+        total = sum(n for _v, n in got)
+        charge = (min(total, self._budget.max_bytes)
+                  if self._budget.max_bytes > 0 else 0)
+        if charge:
+            self._budget.acquire(charge, cancel=token)
+        try:
+            per_col: dict = {}
+            vals = iter(got)
+            for _rg in ordinals:
+                for c in columns:
+                    per_col.setdefault(c, []).append(next(vals)[0])
+            if request.device:
+                return {c: parts[0] if len(parts) == 1 else parts
+                        for c, parts in per_col.items()}
+            from ..reader import _concat_column_data
+
+            return {c: (parts[0] if len(parts) == 1
+                        else _concat_column_data(parts))
+                    for c, parts in per_col.items()}
+        finally:
+            if charge:
+                self._budget.release(charge)
 
     def _read_watched(self, r) -> dict:
         """``read_all`` under a per-request watchdog: a stalled store fetch
@@ -603,6 +679,7 @@ class ScanService:
             "brownout": self.brownout,
             "requests": inflight,
             "cache": self.cache.counters(),
+            "result_cache": self.cache.results.counters(),
             # open circuits by file, oldest first — the autopsy/doctor
             # `circuit-open` evidence rides every flight dump
             "circuit_open": self.breakers.open_files(),
@@ -625,6 +702,9 @@ class ScanService:
 
         reg = StatsRegistry()
         reg.add_serve(self.serve_stats())
+        # the tiered decoded-result cache's own section (per-tier hit/miss/
+        # eviction/invalidation flows + byte gauges + single-flight waits)
+        reg.add_cache(self.cache.results.counters())
         reg.histogram("serve.queue_wait").merge_from(self._hist_wait)
         reg.histogram("serve.exec").merge_from(self._hist_exec)
         reg.histogram("serve.request").merge_from(self._hist_total)
