@@ -614,6 +614,105 @@ def bench_writes(rows=2_000_000, reps=2):
     return out
 
 
+def bench_write_scale(smoke=False):
+    """ISSUE 15 acceptance: the write side of scale.
+
+    Two phases, banked to the ledger like every section:
+
+    - ``encode``: N-worker sharded encode (write.write_sharded, the merged
+      single-file layout — bit-identity with the single writer is the
+      tier-1 test's job, the bench banks throughput) vs the single-writer
+      baseline over the SAME batches; ``encode_speedup`` is the headline.
+    - ``compaction``: a fragmented many-small-files dataset compacted to
+      few large through the ship planner's codec replanning; banks
+      before/after file counts and the planner-modeled link-byte ratio.
+
+    Skip with BENCH_WRITE=0; ``--smoke`` runs it tiny.  The exit-3
+    thread-leak gate is unchanged: the encode pool joins inside
+    write_sharded, nothing daemonized outlives the section.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from tpu_parquet.format import FieldRepetitionType as FRT, Type
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.write import WriteStats, compact, write_sharded
+    from tpu_parquet.writer import FileWriter
+
+    rng = np.random.default_rng(11)
+    rows_per_rg = 20_000 if smoke else 500_000
+    n_rgs = 4 if smoke else 12
+    workers = int(os.environ.get("BENCH_WRITE_WORKERS",
+                                 str(min(os.cpu_count() or 1, 8))))
+    schema = build_schema([
+        data_column("k", Type.INT64, FRT.REQUIRED),
+        data_column("v", Type.DOUBLE, FRT.REQUIRED),
+    ])
+    batches = [{"k": rng.integers(0, 1 << 40, rows_per_rg).astype(np.int64),
+                "v": rng.random(rows_per_rg)} for _ in range(n_rgs)]
+    total_rows = rows_per_rg * n_rgs
+    tmp = tempfile.mkdtemp(prefix="tpq-bench-write-")
+    out = {}
+    try:
+        # single-writer baseline (same batches, same row-group cuts)
+        single = os.path.join(tmp, "single.parquet")
+        t0 = time.perf_counter()
+        with FileWriter(single, schema) as w:
+            for b in batches:
+                w.write_columns(b)
+                w.flush_row_group()
+        single_s = time.perf_counter() - t0
+
+        st = WriteStats()
+        merged = os.path.join(tmp, "merged.parquet")
+        t0 = time.perf_counter()
+        res = write_sharded(merged, schema, batches, workers=workers,
+                            stats=st)
+        sharded_s = time.perf_counter() - t0
+        same = (os.path.getsize(single) == os.path.getsize(merged))
+        out["encode"] = {
+            "rows": total_rows,
+            "row_groups": n_rgs,
+            "workers": st.workers,
+            "single_writer_s": round(single_s, 4),
+            "sharded_s": round(sharded_s, 4),
+            "encode_speedup": round(single_s / sharded_s, 3),
+            "sharded_rows_per_sec": round(total_rows / sharded_s, 1),
+            "bytes_written": res.bytes_written,
+            "size_matches_single": bool(same),
+            "stall_seconds": round(st.stall_seconds, 4),
+        }
+        log(f"write_scale encode: {workers} workers "
+            f"{total_rows / sharded_s / 1e6:.2f} M rows/s "
+            f"({single_s / sharded_s:.2f}x single writer)")
+
+        # compaction: fragment the same data into many small files first
+        frag = os.path.join(tmp, "frag")
+        os.makedirs(frag)
+        small = []
+        for i, b in enumerate(batches):
+            for j, lo in enumerate(range(0, rows_per_rg,
+                                         max(rows_per_rg // 4, 1))):
+                hi = min(lo + max(rows_per_rg // 4, 1), rows_per_rg)
+                p = os.path.join(frag, f"in-{i:03d}-{j}.parquet")
+                with FileWriter(p, schema) as w:
+                    w.write_columns({k: v[lo:hi] for k, v in b.items()})
+                small.append(p)
+        t0 = time.perf_counter()
+        rep = compact(small, out=frag, workers=workers)
+        compact_s = time.perf_counter() - t0
+        d = rep.as_dict()
+        d["compact_s"] = round(compact_s, 4)
+        out["compaction"] = d
+        log(f"write_scale compaction: {d['files_before']} -> "
+            f"{d['files_after']} files, link ratio "
+            f"{d['link_bytes_ratio']:.3f} in {compact_s:.2f}s")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def bench_pipeline(path, rows, reps=3):
     """Overlapped-chunk-pipeline bench (ISSUE 1 acceptance gate): host
     decode of the lineitem16 file at prefetch={0,4} — same file, same
@@ -1959,6 +2058,15 @@ def main(argv=None):
             results["writes"] = bench_writes()
         except Exception as e:  # noqa: BLE001
             log(f"write bench FAILED: {e!r}")
+
+    # Write-at-scale: N-worker sharded encode vs single writer + the
+    # compaction pass's file-count and planner link-byte ratio (ISSUE 15).
+    # Skip with BENCH_WRITE=0; --smoke runs it tiny.
+    if os.environ.get("BENCH_WRITE", "1") != "0" and not over_budget():
+        try:
+            results["write_scale"] = bench_write_scale(smoke=args.smoke)
+        except Exception as e:  # noqa: BLE001
+            log(f"write_scale bench FAILED: {e!r}")
 
     # Pallas vs XLA bit-unpack microbench (the L1 primitive).
     # Cheap (~5s); skip with BENCH_PALLAS=0.

@@ -286,12 +286,13 @@ def test_registry_tree_golden_keys():
     tree = _full_registry().as_dict()
     assert set(tree) == {"obs_version", "pipeline", "reader", "loader",
                          "io", "data_errors", "device", "serve", "cache",
-                         "alloc", "histograms"}
+                         "write", "alloc", "histograms"}
     assert tree["io"] is None  # no IO-backend stats were folded in
     assert tree["data_errors"] is None  # no quarantine engine folded in
     assert tree["device"] is None  # no device timing was folded in
     assert tree["serve"] is None  # no scan service folded in
     assert tree["cache"] is None  # no result cache folded in
+    assert tree["write"] is None  # no writer stats folded in
     assert tree["obs_version"] == OBS_VERSION
     assert tree["alloc"] == {"peak_bytes": 4096, "device_peak_bytes": 0}
     assert set(tree["histograms"]) == {"stage.io", "stage.stage"}

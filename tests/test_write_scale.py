@@ -1,0 +1,681 @@
+"""ISSUE 15: tpu_parquet.write — distributed sharded writer + compaction.
+
+The contracts under test, in rough order of importance:
+
+- BIT-FAITHFULNESS: the N-worker sharded write's merged single file is
+  byte-identical to the single-writer file over the same batches, and the
+  manifest form reads back identically through FileReader /
+  DeviceFileReader / scan_files / DataLoader at prefetch {0, 4} — with
+  CRCs present and validated by default (TPQ_WRITE_CRC mirrors
+  TPQ_VALIDATE);
+- footer-merge validation: truncated/lying/overlapping/mismatched shard
+  footers are rejected with typed ParquetError, never silently merged;
+- the manifest is a versioned atomic commit point: generation bumps are
+  monotonic, malformed documents are typed rejections;
+- compaction is crash-safe and cache-coherent: many small files become
+  few large ones with CRCs always written, the publish is atomic
+  (manifest flips last), a concurrent reader/serve sweep never sees a
+  torn or stale dataset, and a writer-driven rewrite bumps the
+  PlanCache/ResultCache generation with EXACT invalidation counts;
+- writer observability: the registry ``write`` section's golden keys,
+  its merge contract, and pq_tool doctor's write-lane attribution.
+"""
+
+import io
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_parquet.column import ByteArrayData, ColumnData
+from tpu_parquet.device_reader import scan_files
+from tpu_parquet.errors import ParquetError
+from tpu_parquet.footer import read_file_metadata
+from tpu_parquet.format import (CompressionCodec, FieldRepetitionType as FRT,
+                                PageType, Type)
+from tpu_parquet.obs import StatsRegistry, doctor_registry
+from tpu_parquet.reader import FileReader, _concat_column_data
+from tpu_parquet.schema.core import build_schema, data_column
+from tpu_parquet.serve import PlanCache, ScanRequest, ScanService
+from tpu_parquet.write import (MANIFEST_NAME, WriteStats, compact,
+                               CompactionService, expand_dataset,
+                               load_manifest, merge_files, merge_footers,
+                               write_manifest, write_sharded)
+from tpu_parquet.write.sharded import encode_row_group
+from tpu_parquet.writer import FileWriter, resolve_write_crc
+
+
+def _schema():
+    return build_schema([
+        data_column("a", Type.INT64, FRT.REQUIRED),
+        data_column("b", Type.DOUBLE, FRT.REQUIRED),
+        data_column("s", Type.BYTE_ARRAY, FRT.REQUIRED),
+    ])
+
+
+def _batches(n_rgs=6, rows=800, seed=0):
+    rng = np.random.default_rng(seed)
+    pool = [b"alpha", b"beta", b"gamma-gamma", b"", b"delta"]
+    out = []
+    for _ in range(n_rgs):
+        svals = [pool[i] for i in rng.integers(0, len(pool), rows)]
+        out.append({
+            "a": rng.integers(0, 1 << 40, rows).astype(np.int64),
+            "b": rng.random(rows),
+            "s": ColumnData(values=ByteArrayData(
+                offsets=np.cumsum([0] + [len(v) for v in svals]),
+                heap=np.frombuffer(b"".join(svals), np.uint8).copy())),
+        })
+    return out
+
+
+def _single_writer_file(path, schema, batches, **kw):
+    with FileWriter(path, schema, **kw) as w:
+        for b in batches:
+            w.write_columns(b)
+            w.flush_row_group()
+    return path
+
+
+def _read_all_concat(paths, prefetch=0):
+    cols: dict = {}
+    for p in paths:
+        with FileReader(p, prefetch=prefetch) as r:
+            for k, v in r.read_all().items():
+                cols.setdefault(k, []).append(v)
+    return {k: _concat_column_data(v) for k, v in cols.items()}
+
+
+def _assert_cols_equal(got, want):
+    assert set(got) == set(want)
+    for name in want:
+        g, w = got[name], want[name]
+        if isinstance(w.values, ByteArrayData):
+            np.testing.assert_array_equal(g.values.offsets, w.values.offsets)
+            np.testing.assert_array_equal(g.values.heap, w.values.heap)
+        else:
+            np.testing.assert_array_equal(g.values, w.values)
+
+
+# ---------------------------------------------------------------------------
+# bit-faithfulness: merged file == single-writer file, manifest reads equal
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("workers", [1, 3])
+def test_sharded_file_bit_identical_to_single_writer(tmp_path, workers):
+    schema, batches = _schema(), _batches()
+    single = _single_writer_file(str(tmp_path / "single.parquet"),
+                                 schema, batches)
+    merged = str(tmp_path / "merged.parquet")
+    res = write_sharded(merged, schema, batches, workers=workers)
+    assert res.layout == "file" and res.files == 1
+    assert res.rows == sum(len(b["b"]) for b in batches)
+    assert open(single, "rb").read() == open(merged, "rb").read()
+
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+def test_sharded_outputs_read_back_bit_identical(tmp_path, prefetch):
+    schema, batches = _schema(), _batches()
+    single = _single_writer_file(str(tmp_path / "single.parquet"),
+                                 schema, batches)
+    merged = str(tmp_path / "merged.parquet")
+    write_sharded(merged, schema, batches, workers=2)
+    d = tmp_path / "ds"
+    d.mkdir()
+    res = write_sharded(str(d), schema, batches, workers=2,
+                        target_file_bytes=20_000)
+    assert res.files > 1, "target_file_bytes must cut several members"
+    want = _read_all_concat([single], prefetch=prefetch)
+    _assert_cols_equal(_read_all_concat([merged], prefetch=prefetch), want)
+    paths, m = expand_dataset(str(d))
+    assert m is not None and m.generation == 1
+    assert all(os.path.isabs(p) for p in paths)  # resolved member paths
+    _assert_cols_equal(_read_all_concat(paths, prefetch=prefetch), want)
+
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+def test_manifest_scans_as_one_device_dataset(tmp_path, prefetch):
+    """scan_files accepts the manifest (path OR directory) and yields the
+    same groups, in order, as the single-writer file."""
+    schema, batches = _schema(), _batches(n_rgs=4)
+    single = _single_writer_file(str(tmp_path / "single.parquet"),
+                                 schema, batches)
+    d = tmp_path / "ds"
+    d.mkdir()
+    write_sharded(str(d), schema, batches, workers=2,
+                  target_file_bytes=20_000)
+
+    def groups(src):
+        out = []
+        for cols in scan_files(src, prefetch=prefetch):
+            out.append({k: np.asarray(v.to_host())
+                        if not isinstance(batches[0][k], ColumnData)
+                        else v.to_host() for k, v in cols.items()})
+        return out
+
+    got = groups(str(d))
+    want = groups([single])
+    assert len(got) == len(want) == 4
+    for g, w in zip(got, want):
+        assert set(g) == set(w)
+        for k in w:
+            if isinstance(w[k], ByteArrayData):
+                np.testing.assert_array_equal(
+                    np.asarray(g[k].offsets), np.asarray(w[k].offsets))
+                np.testing.assert_array_equal(
+                    np.asarray(g[k].heap), np.asarray(w[k].heap))
+            else:
+                np.testing.assert_array_equal(np.asarray(g[k]),
+                                              np.asarray(w[k]))
+
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+def test_dataloader_consumes_manifest_as_one_dataset(tmp_path, prefetch):
+    from tpu_parquet.data import DataLoader
+
+    schema = build_schema([
+        data_column("a", Type.INT64, FRT.REQUIRED),
+        data_column("b", Type.DOUBLE, FRT.REQUIRED),
+    ])
+    batches = [{k: v for k, v in b.items() if k != "s"}
+               for b in _batches(n_rgs=4, rows=500)]
+    single = _single_writer_file(str(tmp_path / "single.parquet"),
+                                 schema, batches)
+    d = tmp_path / "ds"
+    d.mkdir()
+    write_sharded(str(d), schema, batches, workers=2,
+                  target_file_bytes=10_000)
+
+    def stream(src):
+        dl = DataLoader(src, batch_size=128, shuffle=False,
+                        drop_remainder=True, prefetch=prefetch)
+        return [{k: np.asarray(v) for k, v in b.items()}
+                for b in dl]
+
+    got, want = stream(str(d)), stream(single)
+    assert len(got) == len(want) > 0
+    for g, w in zip(got, want):
+        for k in w:
+            np.testing.assert_array_equal(g[k], w[k])
+
+
+# ---------------------------------------------------------------------------
+# TPQ_WRITE_CRC: default-on CRCs, validated by the default reader tier
+# ---------------------------------------------------------------------------
+
+def _first_page_has_crc(path) -> bool:
+    from tpu_parquet.chunk_decode import validate_chunk_meta, walk_pages
+    from tpu_parquet.schema.core import Schema
+
+    with open(path, "rb") as f:
+        md = read_file_metadata(f)
+        schema = Schema.from_file_metadata(md)
+        chunk = md.row_groups[0].columns[0]
+        cmd, offset = validate_chunk_meta(chunk, schema.leaves[0])
+        f.seek(offset)
+        buf = f.read(cmd.total_compressed_size)
+    for ps in walk_pages(buf, cmd.num_values):
+        return ps.header.crc is not None
+    return False
+
+
+def test_write_crc_defaults_on_and_validates(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPQ_WRITE_CRC", raising=False)
+    schema, batches = _schema(), _batches(n_rgs=2)
+    merged = str(tmp_path / "m.parquet")
+    write_sharded(merged, schema, batches, workers=2)
+    assert _first_page_has_crc(merged), "default-on CRCs missing"
+    # and the default reader tier actually verifies them
+    from tpu_parquet.writer import corrupt_page
+
+    corrupt_page(merged, 0, 0, 0, mode="bitflip", seed=3)
+    with pytest.raises(ParquetError, match="(?i)crc"):
+        with FileReader(merged) as r:
+            r.read_all()
+
+
+def test_write_crc_env_knob_contract(tmp_path, monkeypatch):
+    # env off -> no CRCs written
+    monkeypatch.setenv("TPQ_WRITE_CRC", "0")
+    schema, batches = _schema(), _batches(n_rgs=1)
+    off = str(tmp_path / "off.parquet")
+    _single_writer_file(off, schema, batches)
+    assert not _first_page_has_crc(off)
+    # explicit kwarg wins over the env
+    on = str(tmp_path / "on.parquet")
+    _single_writer_file(on, schema, batches, write_crc=True)
+    assert _first_page_has_crc(on)
+    # malformed env degrades to default-on with a warning, never a raise
+    monkeypatch.setenv("TPQ_WRITE_CRC", "bananas")
+    assert resolve_write_crc(None) is True
+    # kwarg strings are strict
+    with pytest.raises(ValueError):
+        resolve_write_crc("bananas")
+    assert resolve_write_crc("off") is False and resolve_write_crc("on")
+
+
+# ---------------------------------------------------------------------------
+# footer merge: typed rejections
+# ---------------------------------------------------------------------------
+
+def test_merge_files_roundtrip_and_rejections(tmp_path):
+    schema, batches = _schema(), _batches(n_rgs=4)
+    parts = []
+    for i in range(2):
+        p = str(tmp_path / f"part{i}.parquet")
+        _single_writer_file(p, schema, batches[2 * i: 2 * i + 2])
+        parts.append(p)
+    single = _single_writer_file(str(tmp_path / "single.parquet"),
+                                 schema, batches)
+    out = str(tmp_path / "merged.parquet")
+    merged_meta = merge_files(out, parts)
+    assert merged_meta.num_rows == sum(len(b["b"]) for b in batches)
+    assert open(out, "rb").read() == open(single, "rb").read()
+
+    # schema mismatch is a typed rejection
+    other_schema = build_schema([data_column("z", Type.INT64, FRT.REQUIRED)])
+    alien = str(tmp_path / "alien.parquet")
+    with FileWriter(alien, other_schema) as w:
+        w.write_columns({"z": np.arange(5, dtype=np.int64)})
+    with pytest.raises(ParquetError, match="schema does not match"):
+        merge_files(str(tmp_path / "x.parquet"), [parts[0], alien])
+
+    # a lying footer (num_rows disagrees with its groups) is rejected
+    meta = read_file_metadata(parts[0])
+    meta.num_rows += 1
+    with pytest.raises(ParquetError, match="lying shard footer"):
+        merge_footers([(meta, os.path.getsize(parts[0]))])
+
+    # a truncated shard (footer spans past the data segment) is rejected
+    good = read_file_metadata(parts[0])
+    with pytest.raises(ParquetError, match="past the data segment"):
+        merge_footers([(good, 128)])
+
+    # failure never publishes: no merged temp left behind
+    leftovers = [f for f in os.listdir(tmp_path) if ".tmp-" in f]
+    assert not leftovers, leftovers
+
+
+# ---------------------------------------------------------------------------
+# manifest: versioned, atomic, monotonic
+# ---------------------------------------------------------------------------
+
+def test_manifest_round_trip_generation_and_rejections(tmp_path):
+    schema, batches = _schema(), _batches(n_rgs=2)
+    d = tmp_path / "ds"
+    d.mkdir()
+    write_sharded(str(d), schema, batches, workers=1,
+                  target_file_bytes=10_000)
+    m = load_manifest(str(d))
+    assert m.generation == 1 and m.total_rows == 1600
+    # a second publish bumps the generation
+    m2 = write_manifest(str(d), m.member_paths())
+    assert m2.generation == 2
+    # an explicit non-advancing generation is rejected
+    with pytest.raises(ParquetError, match="must advance"):
+        write_manifest(str(d), m.member_paths(), generation=1)
+    # malformed documents are typed rejections
+    mp = str(d / MANIFEST_NAME)
+    doc = json.load(open(mp))
+    for mutate, pat in [
+        (lambda x: x.update(magic="NOPE"), "magic"),
+        (lambda x: x.update(manifest_version=99), "manifest_version"),
+        (lambda x: x.update(generation=0), "generation"),
+        (lambda x: x.update(files=[]), "file list"),
+        (lambda x: x["files"][0].update(path="/abs/path.parquet"),
+         "escapes"),
+        (lambda x: x["files"][0].update(rows=-1), "non-negative"),
+        (lambda x: x.update(total_rows=7), "member sum"),
+    ]:
+        bad = json.loads(json.dumps(doc))
+        mutate(bad)
+        json.dump(bad, open(mp, "w"))
+        with pytest.raises(ParquetError, match=pat):
+            load_manifest(str(d))
+    # and no temp files linger from the atomic publishes
+    assert not [f for f in os.listdir(d) if ".tmp-" in f]
+
+
+# ---------------------------------------------------------------------------
+# compaction: many small -> few large, CRCs always, atomic + coherent
+# ---------------------------------------------------------------------------
+
+def _fragmented_dataset(tmp_path, n_files=8, rows=300, seed=0):
+    schema = _schema()
+    d = tmp_path / "frag"
+    d.mkdir()
+    rng_batches = _batches(n_rgs=n_files, rows=rows, seed=seed)
+    paths = []
+    for i, b in enumerate(rng_batches):
+        p = str(d / f"in-{i:03d}.parquet")
+        _single_writer_file(p, schema, [b])
+        paths.append(p)
+    write_manifest(str(d), paths)
+    return d, schema, paths
+
+
+def test_compaction_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPQ_WRITE_CRC", "0")  # compaction must override
+    d, schema, paths = _fragmented_dataset(tmp_path)
+    want = _read_all_concat(paths)
+    rep = compact(str(d), target_file_bytes=1 << 20, workers=2)
+    assert rep.files_before == 8 and rep.files_after < 8
+    assert rep.rows == 2400
+    assert rep.row_groups_after < rep.row_groups_before
+    assert rep.generation == 2
+    assert 0 < rep.link_bytes_ratio <= 1.1
+    m = load_manifest(str(d))
+    assert m.generation == 2
+    assert [os.path.basename(p) for p in m.member_paths()] == \
+        [os.path.basename(p) for p in rep.out_paths]
+    # content preserved bit-identically, CRCs written despite the env
+    _assert_cols_equal(_read_all_concat(m.member_paths()), want)
+    for p in m.member_paths():
+        assert _first_page_has_crc(p), "compaction must always write CRCs"
+    # inputs kept by default (readers holding generation 1 stay whole)
+    assert all(os.path.exists(p) for p in paths)
+    # remove_inputs unlinks superseded members after the flip
+    rep2 = compact(str(d), target_file_bytes=1 << 20, workers=1,
+                   remove_inputs=True)
+    assert rep2.generation == 3
+    _assert_cols_equal(_read_all_concat(load_manifest(str(d)).member_paths()),
+                       want)
+    assert all(not os.path.exists(p) for p in rep.out_paths)
+
+
+def test_rewrite_never_touches_previous_generation_members(tmp_path):
+    """Member filenames are generation-unique: re-writing a live manifest
+    dataset must never os.replace the previous generation's members
+    before the manifest flips — a reader holding the old manifest stays
+    whole."""
+    schema, batches = _schema(), _batches(n_rgs=3)
+    d = tmp_path / "live"
+    d.mkdir()
+    r1 = write_sharded(str(d), schema, batches, workers=2,
+                       target_file_bytes=10_000)
+    gen1_bytes = {p: open(p, "rb").read() for p in r1.paths}
+    r2 = write_sharded(str(d), schema, _batches(n_rgs=3, seed=9),
+                       workers=2, target_file_bytes=10_000)
+    assert r1.generation == 1 and r2.generation == 2
+    assert not (set(r1.paths) & set(r2.paths)), "member names collided"
+    for p, data in gen1_bytes.items():  # old generation untouched on disk
+        assert open(p, "rb").read() == data
+    assert load_manifest(str(d)).generation == 2
+
+
+def test_compaction_service_policy(tmp_path):
+    d, _schema_, _paths = _fragmented_dataset(tmp_path)
+    svc = CompactionService(min_file_bytes=1 << 20, max_small_files=4,
+                            target_file_bytes=1 << 20)
+    rep = svc.run_once(str(d))
+    assert rep is not None and rep.files_after < rep.files_before
+    # after compaction the dataset is no longer fragmented: no-op
+    assert svc.run_once(str(d)) is None
+
+
+def test_writer_driven_generation_bump_exact_invalidation(tmp_path):
+    """The satellite: a REAL writer rewrite (atomic publish onto a live
+    path) bumps the PlanCache/ResultCache generation with exact counts —
+    no synthetic mtime games — and zero stale bytes are served."""
+    schema, batches = _schema(), _batches(n_rgs=2, seed=1)
+    path = str(tmp_path / "live.parquet")
+    write_sharded(path, schema, batches, workers=2)
+    cache = PlanCache(result_cache_mb=64)
+    with ScanService(concurrency=1, cache=cache) as svc:
+        first = svc.scan(ScanRequest(path))[path]
+        svc.scan(ScanRequest(path))  # provably warm
+        plan_entries = cache.counters()["entries"]
+        res_entries = cache.results.counters()["host"]["entries"]
+        inv0_plan = cache.counters()["invalidations"]
+        inv0_res = cache.results.counters()["host"]["invalidations"]
+        assert plan_entries > 0 and res_entries > 0
+        # the writer-driven mutation: new content, atomic replace, and
+        # the publish notifies the cache (no reader ever re-opens first)
+        new_batches = _batches(n_rgs=2, seed=2)
+        write_sharded(path, schema, new_batches, workers=2,
+                      plan_cache=cache)
+        # eager + exact: EVERY entry of the old generation dropped NOW
+        assert (cache.counters()["invalidations"] - inv0_plan
+                == plan_entries)
+        assert (cache.results.counters()["host"]["invalidations"]
+                - inv0_res == res_entries)
+        after = svc.scan(ScanRequest(path))[path]
+    # zero stale bytes: the served columns are the NEW file's
+    with FileReader(path) as r:
+        fresh = r.read_all()
+    _assert_cols_equal(after, fresh)
+    assert not np.array_equal(np.asarray(first["a"].values),
+                              np.asarray(after["a"].values))
+
+
+def test_compaction_mid_sweep_never_torn_or_stale(tmp_path):
+    """A serve sweep running concurrently with compaction: every response
+    is bit-identical to the dataset's canonical content — never a torn
+    member, never a stale mixture (compaction preserves content, so ANY
+    generation must serve the same rows)."""
+    d, schema, paths = _fragmented_dataset(tmp_path, n_files=6)
+    want = _read_all_concat(paths)
+    cache = PlanCache(result_cache_mb=32)
+    errors: list = []
+    stop = threading.Event()
+
+    def sweep():
+        try:
+            with ScanService(concurrency=2, cache=cache) as svc:
+                while not stop.is_set():
+                    members = load_manifest(str(d)).member_paths()
+                    got: dict = {}
+                    for p in members:
+                        for k, v in svc.scan(ScanRequest(p))[p].items():
+                            got.setdefault(k, []).append(v)
+                    cat = {k: _concat_column_data(v)
+                           for k, v in got.items()}
+                    for k in want:
+                        assert np.array_equal(
+                            np.asarray(cat[k].values.heap
+                                       if isinstance(cat[k].values,
+                                                     ByteArrayData)
+                                       else cat[k].values),
+                            np.asarray(want[k].values.heap
+                                       if isinstance(want[k].values,
+                                                     ByteArrayData)
+                                       else want[k].values)), k
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=sweep)
+    t.start()
+    try:
+        rep = compact(str(d), target_file_bytes=1 << 20, workers=2,
+                      plan_cache=cache)
+        assert rep.files_after < rep.files_before
+        # one more compaction for extra churn while the sweep runs
+        compact(str(d), target_file_bytes=1 << 20, workers=1,
+                plan_cache=cache)
+    finally:
+        stop.set()
+        t.join(30)
+    assert not errors, errors[0]
+
+
+# ---------------------------------------------------------------------------
+# observability: the write section + doctor attribution
+# ---------------------------------------------------------------------------
+
+def test_write_stats_registry_golden_keys_and_merge():
+    st = WriteStats(workers=3)
+    st.add("encode", 0.2)
+    st.add("compress", 0.05)
+    st.add("flush", 0.01)
+    st.count_row_group(100, chunks=2)
+    st.count_file(4096)
+    st.touch_wall()
+    reg = StatsRegistry()
+    reg.add_write(st)
+    tree = reg.as_dict()
+    w = tree["write"]
+    assert set(w) == {
+        "workers", "rows", "row_groups", "chunks", "files", "bytes_written",
+        "encode_seconds", "compress_seconds", "flush_seconds",
+        "merge_seconds", "compact_seconds", "stall_seconds", "wall_seconds",
+        "busy_seconds", "rows_per_sec", "bytes_per_sec",
+    }
+    assert w["workers"] == 3 and w["rows"] == 100 and w["files"] == 1
+    assert "write.encode" in tree["histograms"]
+    json.dumps(tree)  # artifact-ready
+    # merge contract: flows add, workers max, derived rates recomputed
+    st2 = WriteStats(workers=2)
+    st2.add("encode", 0.1)
+    st2.count_row_group(50, chunks=1)
+    reg.add_write(st2)
+    t2 = reg.as_dict()["write"]
+    assert t2["rows"] == 150 and t2["workers"] == 3
+    assert t2["encode_seconds"] == pytest.approx(0.3)
+    # cross-process dict merge path
+    reg2 = StatsRegistry()
+    reg2.merge_dict(reg.as_dict())
+    assert reg2.as_dict()["write"]["rows"] == 150
+    # WriteStats.merge_from composes the same way
+    st.merge_from(st2)
+    assert st.rows == 150 and st.workers == 3
+
+
+def test_write_stats_unknown_stage_raises():
+    with pytest.raises(ValueError, match="unknown write stage"):
+        WriteStats().add("teleport", 1.0)
+
+
+def test_doctor_attributes_slow_write(tmp_path, capsys):
+    schema, batches = _schema(), _batches(n_rgs=3)
+    st = WriteStats()
+    write_sharded(str(tmp_path / "w.parquet"), schema, batches,
+                  workers=2, stats=st)
+    reg = StatsRegistry()
+    reg.add_write(st)
+    rep = doctor_registry(reg.as_dict())
+    assert rep is not None and "write" in rep
+    assert rep["write"]["verdict"].startswith("write-")
+    assert rep["write"]["dominant_lane"] in ("encode", "compress", "flush",
+                                             "merge", "compact", "stall")
+    # the CLI renders the write verdict line
+    from tpu_parquet.cli.pq_tool import cmd_doctor
+
+    p = str(tmp_path / "reg.json")
+    json.dump(reg.as_dict(), open(p, "w"))
+
+    class A:
+        file = p
+        config = None
+
+    out = io.StringIO()
+    assert cmd_doctor(A(), out=out) == 0
+    text = out.getvalue()
+    assert "write verdict: write-" in text and "write:" in text
+
+
+def test_filewriter_books_write_lanes(tmp_path):
+    st = WriteStats()
+    schema, batches = _schema(), _batches(n_rgs=1)
+    _single_writer_file(str(tmp_path / "x.parquet"), schema, batches,
+                        stats=st, codec=CompressionCodec.SNAPPY)
+    d = st.as_dict()
+    assert d["rows"] == 800 and d["row_groups"] == 1 and d["chunks"] == 3
+    assert d["encode_seconds"] > 0
+    assert d["compress_seconds"] > 0
+    assert d["flush_seconds"] > 0
+    # the lanes PARTITION the chunk wall: a single-threaded write's busy
+    # seconds can never exceed its open..close wall (booked once, not twice)
+    assert d["busy_seconds"] <= d["wall_seconds"] + 0.05
+
+
+# ---------------------------------------------------------------------------
+# the CLI: pq_tool merge / compact
+# ---------------------------------------------------------------------------
+
+def test_pq_tool_merge_and_compact(tmp_path):
+    from tpu_parquet.cli import pq_tool
+
+    def run_tool(args):
+        buf = io.StringIO()
+        parsed = pq_tool.build_parser().parse_args(args)
+        return parsed.func(parsed, out=buf), buf.getvalue()
+
+    schema, batches = _schema(), _batches(n_rgs=4)
+    parts = []
+    for i in range(2):
+        p = str(tmp_path / f"p{i}.parquet")
+        _single_writer_file(p, schema, batches[2 * i: 2 * i + 2])
+        parts.append(p)
+    out = str(tmp_path / "merged.parquet")
+    rc, text = run_tool(["merge", out, *parts])
+    assert rc == 0 and "merged 2 file(s)" in text
+    with FileReader(out) as r:
+        assert r.metadata.num_rows == 3200
+
+    d = tmp_path / "ds"
+    d.mkdir()
+    for i, p in enumerate(parts):
+        os.link(p, str(d / f"m{i}.parquet"))
+    rc, text = run_tool(["compact", str(d / "m0.parquet"),
+                         str(d / "m1.parquet"),
+                         "--out", str(d), "--target-size", "64MB"])
+    assert rc == 0
+    assert "compacted 2 file(s)" in text and "link bytes" in text
+    m = load_manifest(str(d))
+    assert m.total_rows == 3200
+
+
+# ---------------------------------------------------------------------------
+# budget/backpressure + worker-encode helpers
+# ---------------------------------------------------------------------------
+
+def test_sharded_write_respects_memory_budget(tmp_path):
+    schema, batches = _schema(), _batches(n_rgs=6)
+    st = WriteStats()
+    res = write_sharded(str(tmp_path / "b.parquet"), schema, batches,
+                        workers=3, max_memory=1 << 20, stats=st)
+    assert res.rows == 4800  # bounded, not broken
+    single = _single_writer_file(str(tmp_path / "s.parquet"), schema,
+                                 _batches(n_rgs=6))
+    assert (open(single, "rb").read()
+            == open(str(tmp_path / "b.parquet"), "rb").read())
+
+
+def test_encode_row_group_blob_is_a_valid_file():
+    schema, batches = _schema(), _batches(n_rgs=1)
+    blob, meta = encode_row_group(schema, batches[0])
+    assert meta.num_rows == 800 and len(meta.row_groups) == 1
+    with FileReader(io.BytesIO(blob)) as r:
+        got = r.read_all()
+    assert len(np.asarray(got["a"].values)) == 800
+
+
+def test_write_sharded_rejects_empty_and_bad_layout(tmp_path):
+    schema = _schema()
+    with pytest.raises(ParquetError, match="no row groups"):
+        write_sharded(str(tmp_path / "e.parquet"), schema, [])
+    with pytest.raises(ValueError, match="layout"):
+        write_sharded(str(tmp_path / "e.parquet"), schema, _batches(1),
+                      layout="zipfile")
+    with pytest.raises(ParquetError, match="directory"):
+        write_sharded(str(tmp_path / "nodir"), schema, _batches(1),
+                      layout="manifest")
+
+
+def test_worker_failure_leaves_no_temp_and_joins_pool(tmp_path):
+    schema = _schema()
+    good = _batches(n_rgs=2)
+
+    def gen():
+        yield good[0]
+        raise RuntimeError("producer died")
+
+    with pytest.raises(RuntimeError, match="producer died"):
+        write_sharded(str(tmp_path / "dead.parquet"), schema, gen(),
+                      workers=2)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp-" in f]
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("tpq-prefetch")]
+    assert not leaked, leaked

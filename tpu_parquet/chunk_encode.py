@@ -12,6 +12,7 @@ reference's fallback threshold (> 32767 distinct values → plain, chunk_writer.
 from __future__ import annotations
 
 import struct
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Optional
@@ -250,6 +251,7 @@ class ChunkEncoder:
         write_crc: bool = False,
         encoding: Optional[Encoding] = None,
         write_statistics: bool = True,
+        stats=None,
     ):
         self.leaf = leaf
         self.codec = codec
@@ -259,9 +261,25 @@ class ChunkEncoder:
         self.write_crc = write_crc
         self.fallback_encoding = encoding or Encoding.PLAIN
         self.write_statistics = write_statistics
+        # write-side lane attribution (write.WriteStats): write() books
+        # its codec passes as `compress`, the sink write loop as `flush`,
+        # and the remaining chunk wall as `encode` — the three lanes
+        # pq_tool doctor needs to name a slow write's bottleneck
+        self.stats = stats
+        self._compress_s = 0.0
         # (min, max) bytes for dict-encoded BYTE_ARRAY page stats; set per
         # write() from the dictionary (O(distinct)), see _page_statistics
         self._dict_stat_bounds = None
+
+    def _compress(self, raw) -> bytes:
+        """compress_block with the codec pass booked into the `compress`
+        write lane (one perf_counter pair per page when stats are on)."""
+        if self.stats is None:
+            return compress_block(raw, self.codec)
+        t0 = time.perf_counter()
+        out = compress_block(raw, self.codec)
+        self._compress_s += time.perf_counter() - t0
+        return out
 
     # -- page boundary selection ----------------------------------------------
 
@@ -307,6 +325,8 @@ class ChunkEncoder:
 
     def write(self, cd: ColumnData, sink, offset: int) -> ChunkWriteResult:
         """Serialize the chunk into sink (a writable), starting at file offset."""
+        t_start = time.perf_counter() if self.stats is not None else 0.0
+        self._compress_s = 0.0
         leaf = self.leaf
         ptype = leaf.physical_type
         # normalize the all-defined shorthand (def_levels=None with max_def>0)
@@ -355,7 +375,7 @@ class ChunkEncoder:
         if use_dict:
             dict_vals, indices = dict_pair
             raw = plain.encode(dict_vals, ptype, leaf.type_length)
-            comp = compress_block(raw, self.codec)
+            comp = self._compress(raw)
             ph = PageHeader(
                 type=int(PageType.DICTIONARY_PAGE),
                 uncompressed_page_size=len(raw),
@@ -427,8 +447,24 @@ class ChunkEncoder:
                     stat_values, ptype, null_count=n_slots - len(cd.values),
                 )
 
-        for part in parts:
-            sink.write(part)
+        if self.stats is not None:
+            t_flush = time.perf_counter()
+            for part in parts:
+                sink.write(part)
+            flush_s = time.perf_counter() - t_flush
+            # the chunk's three write lanes, partitioned exactly: codec
+            # passes (compress), the sink write loop (flush), and the
+            # remaining encode wall (dict build, page cutting, values,
+            # headers) — doctor's slow-write attribution basis
+            self.stats.add("compress", self._compress_s)
+            self.stats.add("flush", flush_s)
+            self.stats.add(
+                "encode",
+                max(time.perf_counter() - t_start
+                    - self._compress_s - flush_s, 0.0))
+        else:
+            for part in parts:
+                sink.write(part)
 
         md = ColumnMetaData(
             type=int(ptype),
@@ -507,7 +543,7 @@ class ChunkEncoder:
                     cd.def_levels[lo:hi].astype(np.uint64),
                     bitpack.bit_width(cd.max_def),
                 )
-            comp = compress_block(payload, self.codec)
+            comp = self._compress(payload)
             num_rows = (
                 int(np.count_nonzero(cd.rep_levels[lo:hi] == 0))
                 if cd.rep_levels is not None
@@ -553,7 +589,7 @@ class ChunkEncoder:
         else:
             raw = rep_bytes + def_bytes + (
                 payload if isinstance(payload, bytes) else bytes(payload))
-        comp = compress_block(raw, self.codec)
+        comp = self._compress(raw)
         header = PageHeader(
             type=int(PageType.DATA_PAGE),
             uncompressed_page_size=len(raw),

@@ -345,12 +345,13 @@ def cmd_doctor(args, out=sys.stdout) -> int:
                   f"seconds to attribute (nothing was decoded?)\n")
         return 1
     out.write(f"doctor: {args.file}\n")
-    lanes = rep["lanes"]
-    out.write("lanes: " + "  ".join(
-        f"{k}={lanes[k]:.3f}s"
-        for k in sorted(lanes, key=lambda k: -lanes[k])) + "\n")
-    out.write(f"verdict: {rep['verdict']} "
-              f"({100 * rep['dominant_share']:.0f}% of lane seconds)\n")
+    lanes = rep.get("lanes")
+    if lanes:
+        out.write("lanes: " + "  ".join(
+            f"{k}={lanes[k]:.3f}s"
+            for k in sorted(lanes, key=lambda k: -lanes[k])) + "\n")
+        out.write(f"verdict: {rep['verdict']} "
+                  f"({100 * rep['dominant_share']:.0f}% of lane seconds)\n")
     rm = rep.get("route_model")
     if rm:
         err = rm.get("error_ratio")
@@ -424,6 +425,16 @@ def cmd_doctor(args, out=sys.stdout) -> int:
                   f"{hg['wasted_bytes']} wasted bytes — the hedge delay "
                   f"sits below the real p90; raise TPQ_IO_HEDGE_MS or let "
                   f"auto re-learn\n")
+    wrt = rep.get("write")
+    if wrt:
+        wl = wrt["lanes"]
+        out.write("write: " + "  ".join(
+            f"{k}={wl[k]:.3f}s"
+            for k in sorted(wl, key=lambda k: -wl[k])) + "\n")
+        out.write(f"write verdict: {wrt['verdict']} "
+                  f"({100 * wrt['dominant_share']:.0f}% of write lane "
+                  f"seconds; {wrt['rows_per_sec']:.0f} rows/s, "
+                  f"{wrt['bytes_per_sec'] / 1e6:.1f} MB/s)\n")
     return 0
 
 
@@ -731,6 +742,48 @@ def cmd_split(args, out=sys.stdout) -> int:
     return 0
 
 
+def cmd_merge(args, out=sys.stdout) -> int:
+    """Footer-merge N parquet files into one: row groups relocated with
+    corrected offsets, data bytes copied untouched (CRCs ride along),
+    atomic publish.  The write-side inverse of ``split``."""
+    from ..write import WriteStats, merge_files
+
+    st = WriteStats()
+    meta = merge_files(args.output, args.inputs, stats=st)
+    out.write(f"merged {len(args.inputs)} file(s) -> {args.output}: "
+              f"{meta.num_rows} rows in {len(meta.row_groups)} row "
+              f"group(s), {st.bytes_written} bytes\n")
+    return 0
+
+
+def cmd_compact(args, out=sys.stdout) -> int:
+    """Compact a dataset (manifest dir or file list) into few large files:
+    codec re-planned through the ship planner, CRCs always written,
+    atomic manifest publish with a generation bump."""
+    from ..write import compact
+
+    rep = compact(
+        args.dataset if len(args.dataset) > 1 else args.dataset[0],
+        out=args.out,
+        target_file_bytes=parse_human_size(args.target_size),
+        workers=args.workers,
+        remove_inputs=args.remove_inputs,
+    )
+    d = rep.as_dict()
+    out.write(
+        f"compacted {d['files_before']} file(s) ({d['bytes_before']} B, "
+        f"{d['row_groups_before']} row groups) -> {d['files_after']} "
+        f"file(s) ({d['bytes_after']} B, {d['row_groups_after']} row "
+        f"groups), {d['rows']} rows\n")
+    out.write(
+        f"link bytes (ship-planner model): {d['link_bytes_before']} -> "
+        f"{d['link_bytes_after']} (ratio {d['link_bytes_ratio']:.3f})\n")
+    if rep.manifest_path:
+        out.write(f"published: {rep.manifest_path} "
+                  f"(generation {rep.generation})\n")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="pq-tool", description="Inspect and manipulate parquet files"
@@ -843,6 +896,28 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["uncompressed", "snappy", "gzip", "zstd"])
     sp.add_argument("file")
     sp.set_defaults(func=cmd_split)
+
+    mg = sub.add_parser(
+        "merge", help="footer-merge N parquet files into one (no re-encode)")
+    mg.add_argument("output")
+    mg.add_argument("inputs", nargs="+")
+    mg.set_defaults(func=cmd_merge)
+
+    cp = sub.add_parser(
+        "compact",
+        help="compact a dataset into few large files (manifest publish, "
+             "ship-planner codec replanning, CRCs always on)")
+    cp.add_argument("dataset", nargs="+",
+                    help="manifest dir/file, or a list of parquet files")
+    cp.add_argument("--out", default=None,
+                    help="output directory (default: the dataset's own)")
+    cp.add_argument("--target-size", default="128MB",
+                    help="target output file size, e.g. 512MB")
+    cp.add_argument("--workers", type=int, default=None,
+                    help="encode workers (default TPQ_WRITE_WORKERS)")
+    cp.add_argument("--remove-inputs", action="store_true",
+                    help="unlink superseded members after the manifest flip")
+    cp.set_defaults(func=cmd_compact)
     return p
 
 

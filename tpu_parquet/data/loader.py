@@ -201,9 +201,12 @@ class DataLoader:
         self._watchdog = None
         self._budget = None  # the live epoch budget (_blocks sets it)
         register_flight_registry(self, "obs_registry")
-        if isinstance(files, (str, os.PathLike)):
-            files = [files]
-        self._paths = [os.fspath(p) for p in files]
+        # a manifest path (or a directory holding tpq_manifest.json, the
+        # sharded writer's multi-file layout) expands to its member list —
+        # one dataset handle however many files the writer cut
+        from ..write.manifest import expand_dataset
+
+        self._paths, _manifest = expand_dataset(files)
         if not self._paths:
             raise ValueError("DataLoader needs at least one file")
         if batch_size <= 0:

@@ -5032,6 +5032,12 @@ def scan_files(paths, columns=None, validate_crc=None,
 
     from .obs import Watchdog, resolve_hang_s, resolve_tracer
     from .quarantine import Quarantine
+    from .write.manifest import expand_dataset
+
+    # a manifest path (or a directory holding tpq_manifest.json — the
+    # sharded writer's multi-file layout) expands to its member list, so
+    # a written-then-compacted dataset scans as ONE dataset
+    paths, _manifest = expand_dataset(paths)
 
     # one tracer spans the whole scan (per-file tracers would shred the
     # timeline Perfetto is supposed to show); with a path, the trace + the

@@ -1172,6 +1172,154 @@ def crafted_result_cache_blobs() -> "list[bytes]":
     ]
 
 
+def _mini_shard_blob(seed: int = 0, rows: int = 64,
+                     kv: "dict | None" = None) -> bytes:
+    """One valid single-row-group shard file (the footer_merge seed)."""
+    from .format import FieldRepetitionType as FRT, Type
+    from .schema.core import build_schema, data_column
+    from .write.sharded import encode_row_group
+
+    rng = np.random.default_rng(seed)
+    schema = build_schema([
+        data_column("a", Type.INT64, FRT.REQUIRED),
+        data_column("b", Type.DOUBLE, FRT.REQUIRED),
+    ])
+    blob, _meta = encode_row_group(
+        schema,
+        {"a": rng.integers(0, 1000, rows).astype(np.int64),
+         "b": rng.random(rows)},
+        write_crc=True, kv_metadata=kv)
+    return blob
+
+
+def _frame_merge_parts(parts: "list[tuple[bytes, int]]") -> bytes:
+    """Frame (footer_thrift, declared_file_size) pairs as one fuzz blob."""
+    out = [bytes([len(parts)])]
+    for thrift_bytes, size in parts:
+        out.append(len(thrift_bytes).to_bytes(4, "little"))
+        out.append(thrift_bytes)
+        out.append(int(size).to_bytes(8, "little"))
+    return b"".join(out)
+
+
+def _shard_footer_thrift(blob: bytes) -> bytes:
+    flen = int.from_bytes(blob[-8:-4], "little")
+    return blob[-8 - flen : -8]
+
+
+def fuzz_footer_merge(data: bytes) -> None:
+    """Fuzz target #20: the write-side footer merge (write/merge.py).
+
+    Input framing: ``[count u8][per part: u32 thrift_len, footer thrift
+    bytes, u64 declared file size]``.  Each footer deserializes (or the
+    blob is rejected); :func:`~tpu_parquet.write.merge_footers` over the
+    parts must either raise ParquetError (truncated/lying/overlapping/
+    mismatched shard footers — the typed rejections) or produce a merged
+    footer holding the merge invariants: row counts and row-group counts
+    sum, shard order is preserved with globally renumbered ordinals, and
+    the relocated spans tile the output data segment contiguously from
+    the head magic with every chunk offset inside its span."""
+    from .format import FileMetaData
+    from .scanplan import row_group_byte_span
+    from .schema.core import Schema
+    from .thrift import ThriftError, deserialize
+    from .write.merge import merge_footers
+
+    if len(data) < 1:
+        return  # empty merge blob: rejected framing
+    count = data[0]
+    if not 1 <= count <= 4:
+        return  # part count out of range
+    pos = 1
+    parts = []
+    for _ in range(count):
+        if pos + 4 > len(data):
+            return  # truncated part header
+        tlen = int.from_bytes(data[pos : pos + 4], "little")
+        pos += 4
+        if tlen > len(data) - pos or tlen > (1 << 20):
+            return  # part thrift length lies
+        try:
+            meta = deserialize(FileMetaData, data[pos : pos + tlen])
+        except ThriftError:
+            return  # bad part footer thrift: rejected
+        pos += tlen
+        if pos + 8 > len(data):
+            return  # truncated part size
+        size = int.from_bytes(data[pos : pos + 8], "little")
+        pos += 8
+        if size > (1 << 40):
+            return  # part size lies
+        parts.append((meta, size))
+    try:
+        merged, spans = merge_footers(parts)
+    except ParquetError:
+        return
+    # -- merge invariants (reject was the only other legal outcome) --------
+    in_rgs = sum(len(m.row_groups or []) for m, _s in parts)
+    in_rows = sum(int(rg.num_rows or 0) for m, _s in parts
+                  for rg in (m.row_groups or []))
+    assert len(merged.row_groups) == in_rgs, "row-group count not preserved"
+    assert len(spans) == in_rgs, "span per row group"
+    assert int(merged.num_rows) == in_rows, "row count not preserved"
+    assert [rg.ordinal for rg in merged.row_groups] == list(range(in_rgs)), \
+        "ordinals not renumbered sequentially"
+    schema = Schema.from_file_metadata(merged)
+    leaves = {l.path: l for l in schema.leaves}
+    pos_out = 4  # spans tile the data segment contiguously from the magic
+    order = []
+    for rg, (idx, start, end) in zip(merged.row_groups, spans):
+        lo, hi = row_group_byte_span(rg, leaves)
+        assert lo == pos_out, f"relocated span starts at {lo}, not {pos_out}"
+        assert hi - lo == end - start, "relocated span length changed"
+        pos_out = pos_out + (end - start)
+        order.append(idx)
+    assert order == sorted(order), "shard order not preserved"
+
+
+def crafted_footer_merge_blobs() -> "list[bytes]":
+    """Hand-crafted ``footer_merge`` inputs (and corpus blobs): two valid
+    shards, then the typed-rejection shapes — truncated footer thrift, a
+    declared size that amputates the data segment (lying/truncated
+    shard), a footer whose num_rows disagrees with its groups, a schema
+    mismatch between shards, and self-overlapping row groups."""
+    import copy as _copy
+
+    from .thrift import serialize as _ser
+
+    b1 = _mini_shard_blob(seed=1)
+    b2 = _mini_shard_blob(seed=2, rows=32)
+    t1, t2 = _shard_footer_thrift(b1), _shard_footer_thrift(b2)
+    good = _frame_merge_parts([(t1, len(b1)), (t2, len(b2))])
+    # truncated thrift: merge must reject, not crash
+    truncated = _frame_merge_parts([(t1[: len(t1) // 2], len(b1))])
+    # lying size: the declared file is smaller than the chunk spans need
+    amputated = _frame_merge_parts([(t1, 64), (t2, len(b2))])
+    # lying num_rows: footer total disagrees with the row groups' sum
+    from .format import FileMetaData
+    from .thrift import deserialize as _deser
+
+    lying = _deser(FileMetaData, t1)
+    lying.num_rows = int(lying.num_rows or 0) + 7
+    lying_rows = _frame_merge_parts([(_ser(lying), len(b1))])
+    # schema mismatch: shard 2 claims a different column name
+    other = _deser(FileMetaData, t2)
+    for se in other.schema or []:
+        if se.name == "b":
+            se.name = "zz"
+    mismatch = _frame_merge_parts([(t1, len(b1)), (_ser(other), len(b2))])
+    # overlapping row groups: one group duplicated at the same offsets
+    dup = _deser(FileMetaData, t1)
+    dup.row_groups = [dup.row_groups[0], _copy.deepcopy(dup.row_groups[0])]
+    dup.num_rows = 2 * int(dup.row_groups[0].num_rows or 0)
+    overlap = _frame_merge_parts([(_ser(dup), len(b1))])
+    # single valid shard with kv metadata (the kv-union path)
+    b3 = _mini_shard_blob(seed=3, kv={"origin": "fuzz"})
+    single = _frame_merge_parts([(_shard_footer_thrift(b3), len(b3))])
+    return [good, truncated, amputated, lying_rows, mismatch, overlap,
+            single]
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -1192,6 +1340,7 @@ TARGETS = {
     "chaos_schedule": fuzz_chaos_schedule,
     "fused_plan": fuzz_fused_plan,
     "result_cache": fuzz_result_cache,
+    "footer_merge": fuzz_footer_merge,
 }
 
 
@@ -1397,6 +1546,8 @@ def _seed_inputs(target: str) -> list[bytes]:
         return crafted_fused_plan_blobs()
     if target == "result_cache":
         return crafted_result_cache_blobs()
+    if target == "footer_merge":
+        return crafted_footer_merge_blobs()
     if target == "loader_state":
         from .data import checkpoint as ck
 

@@ -1138,6 +1138,9 @@ class Watchdog:
 _MERGE_MAXED = frozenset((
     "peak_in_flight_bytes", "window_peak_rows", "prefetch", "budget_bytes",
     "planner_link_mbps",
+    # write section config: pool size composes by max, exactly as the
+    # read side's prefetch does
+    "workers",
     # serve section gauges: the cache footprint and the admission peak are
     # point-in-time state of ONE shared object, not flows to sum (the
     # names are serve-specific — a generic "bytes" here would max the
@@ -1198,6 +1201,12 @@ def _recompute_derived(tree: dict) -> None:
         wall = loader.get("wall_seconds", 0.0)
         loader["rows_per_sec"] = _ratio(loader.get("rows", 0), wall, 1)
         loader["batches_per_sec"] = _ratio(loader.get("batches", 0), wall, 3)
+    write = tree.get("write")
+    if write:
+        wall = write.get("wall_seconds", 0.0)
+        write["rows_per_sec"] = _ratio(write.get("rows", 0), wall, 1)
+        write["bytes_per_sec"] = _ratio(
+            write.get("bytes_written", 0), wall, 1)
 
 
 class StatsRegistry:
@@ -1219,6 +1228,7 @@ class StatsRegistry:
         self._device: "dict | None" = None
         self._serve: "dict | None" = None
         self._cache: "dict | None" = None
+        self._write: "dict | None" = None
         self._alloc_peak = 0
         self._alloc_device_peak = 0
         self._hists: dict[str, LatencyHistogram] = {}
@@ -1324,6 +1334,24 @@ class StatsRegistry:
                 self._cache = {}
             _merge_num_tree(self._cache, d)
 
+    def add_write(self, write_stats) -> None:
+        """Fold a :class:`~tpu_parquet.write.WriteStats` in (the ``write``
+        section: encode/compress/flush/merge/compact lane seconds plus
+        row/file/byte flows — all flows except ``workers``, which maxes
+        like the read side's ``prefetch``).  Its per-stage histograms
+        become registry histograms ``write.<stage>``.  Raw dicts accepted
+        for tests and cross-process merges."""
+        d = (write_stats if isinstance(write_stats, dict)
+             else write_stats.as_dict())
+        d = dict(d)
+        hists = d.pop("stage_histograms", {})
+        with self._lock:
+            if self._write is None:
+                self._write = {}
+            _merge_num_tree(self._write, d)
+        for stage, hd in hists.items():
+            self.histogram(f"write.{stage}").merge_dict(hd)
+
     def note_alloc_peak(self, tracker) -> None:
         """Record an :class:`~tpu_parquet.alloc.AllocTracker`'s high-water
         marks (host ``peak`` + device-bytes ``device_peak``; raw ints
@@ -1345,6 +1373,7 @@ class StatsRegistry:
             device = dict(other._device) if other._device else None
             serve = dict(other._serve) if other._serve else None
             cache = dict(other._cache) if other._cache else None
+            write = dict(other._write) if other._write else None
             peak = other._alloc_peak
             dev_peak = other._alloc_device_peak
             hists = dict(other._hists)
@@ -1353,7 +1382,7 @@ class StatsRegistry:
                               ("_loader", loader), ("_io", io),
                               ("_data_errors", data_errors),
                               ("_device", device), ("_serve", serve),
-                              ("_cache", cache)):
+                              ("_cache", cache), ("_write", write)):
                 if src is None:
                     continue
                 dst = getattr(self, name)
@@ -1374,7 +1403,7 @@ class StatsRegistry:
                           ("loader", "_loader"), ("io", "_io"),
                           ("data_errors", "_data_errors"),
                           ("device", "_device"), ("serve", "_serve"),
-                          ("cache", "_cache")):
+                          ("cache", "_cache"), ("write", "_write")):
             src = tree.get(key)
             if src is None:
                 continue
@@ -1479,6 +1508,7 @@ class StatsRegistry:
                 "device": dict(self._device) if self._device else None,
                 "serve": dict(self._serve) if self._serve else None,
                 "cache": dict(self._cache) if self._cache else None,
+                "write": dict(self._write) if self._write else None,
                 "alloc": {"peak_bytes": self._alloc_peak,
                           "device_peak_bytes": self._alloc_device_peak},
                 "histograms": {n: h.as_dict()
@@ -1737,15 +1767,23 @@ def doctor_registry(tree: dict) -> "dict | None":
         "admission": g(serve, "queue_wait_seconds"),
     }
     total = sum(lanes.values())
-    if total <= 0:
+    wr = tree.get("write")
+    wr = wr if isinstance(wr, dict) else {}
+    wr_lanes = {s: g(wr, f"{s}_seconds")
+                for s in ("encode", "compress", "flush", "merge", "compact")}
+    wr_lanes["stall"] = g(wr, "stall_seconds")
+    wr_total = sum(wr_lanes.values())
+    if total <= 0 and wr_total <= 0:
         return None
-    dominant = max(lanes, key=lambda k: (lanes[k], k))
-    out = {
-        "lanes": {k: round(v, 6) for k, v in lanes.items()},
-        "dominant_lane": dominant,
-        "verdict": DOCTOR_VERDICTS[dominant],
-        "dominant_share": round(lanes[dominant] / total, 4),
-    }
+    out: dict = {}
+    if total > 0:
+        dominant = max(lanes, key=lambda k: (lanes[k], k))
+        out = {
+            "lanes": {k: round(v, 6) for k, v in lanes.items()},
+            "dominant_lane": dominant,
+            "verdict": DOCTOR_VERDICTS[dominant],
+            "dominant_share": round(lanes[dominant] / total, 4),
+        }
     if dev_routes:
         # name the dominant device route (and kernel family) with its
         # predicted-vs-measured error — the fused-kernel work (ROADMAP
@@ -1895,6 +1933,20 @@ def doctor_registry(tree: dict) -> "dict | None":
             from .ship import recalibrate_link_mbps
 
             out["recalibrate_link_mbps"] = recalibrate_link_mbps(link_bps)
+    if wr_total > 0:
+        # the write-side attribution: same rule shape as the read lanes —
+        # the dominant lane names the bottleneck (encode = CPU encoding,
+        # compress = the codec, flush = the sink, stall = the memory
+        # budget), so a slow write is attributable the way a slow read is
+        wd = max(wr_lanes, key=lambda k: (wr_lanes[k], k))
+        out["write"] = {
+            "lanes": {k: round(v, 6) for k, v in wr_lanes.items()},
+            "dominant_lane": wd,
+            "verdict": f"write-{wd}-bound",
+            "dominant_share": round(wr_lanes[wd] / wr_total, 4),
+            "rows_per_sec": wr.get("rows_per_sec") or 0.0,
+            "bytes_per_sec": wr.get("bytes_per_sec") or 0.0,
+        }
     return out
 
 

@@ -49,8 +49,8 @@ from .ship import ROUTES
 
 __all__ = [
     "SCANPLAN_VERSION", "ChunkPlan", "RowGroupPlan", "ScanPlan",
-    "build_scan_plan", "row_group_chunks", "walk_header_pages",
-    "plan_page_pruning", "predicate_fingerprint",
+    "build_scan_plan", "row_group_chunks", "row_group_byte_span",
+    "walk_header_pages", "plan_page_pruning", "predicate_fingerprint",
 ]
 
 SCANPLAN_VERSION = 1
@@ -88,6 +88,23 @@ def row_group_chunks(rg, leaves):
             continue  # unselected: never read its bytes
         md, offset = validate_chunk_meta(chunk, leaf)
         yield path, leaf, chunk, md, offset
+
+
+def row_group_byte_span(rg, leaves) -> "tuple[int, int]":
+    """One row group's contiguous data byte span ``(start, end)`` over ALL
+    its chunks — the relocation unit of the write-side footer merge
+    (:mod:`tpu_parquet.write.merge`).  Rides the same
+    :func:`validate_chunk_meta` walk as every read path, so a lying shard
+    footer is rejected with the same typed errors a reader would raise."""
+    start = end = None
+    for _path, _leaf, _chunk, md, offset in row_group_chunks(rg, leaves):
+        lo = int(offset)
+        hi = lo + int(md.total_compressed_size or 0)
+        start = lo if start is None else min(start, lo)
+        end = hi if end is None else max(end, hi)
+    if start is None:
+        raise ParquetError("row group has no selected column chunks")
+    return start, end
 
 
 # ---------------------------------------------------------------------------

@@ -175,6 +175,22 @@ class PlanCache:
                     self._building.pop(full, None)
             return value
 
+    def _drop_stale_locked(self, ident: tuple, keep) -> int:
+        """Drop every cached entry whose file key shares ``ident`` but is
+        not ``keep`` (the current generation; None drops ALL of the
+        identity's entries).  One copy of the invalidation bookkeeping —
+        shared by the read path (:meth:`_put` observing a moved footer)
+        and the write path (:meth:`note_mutation`).  Caller holds the
+        lock; returns the number of entries dropped."""
+        stale = [f for f in self._entries
+                 if isinstance(f[1], tuple)
+                 and f[1][:2] == ident and f[1] != keep]
+        for f in stale:
+            _v, n = self._entries.pop(f)
+            self._bytes -= n
+            self.stats.invalidations += 1
+        return len(stale)
+
     def _put(self, kind: str, key: tuple, value, nbytes: int) -> None:
         with self._lock:
             full = (kind, *key)
@@ -197,13 +213,7 @@ class PlanCache:
                 prev = self._gen.get(ident)
                 if prev is not None and prev != fk:
                     moved = True
-                    stale = [f for f in self._entries
-                             if isinstance(f[1], tuple)
-                             and f[1][:2] == ident and f[1] != fk]
-                    for f in stale:
-                        _v, n = self._entries.pop(f)
-                        self._bytes -= n
-                        self.stats.invalidations += 1
+                    self._drop_stale_locked(ident, fk)
                 self._gen[ident] = fk
             # ONE byte budget: when the result tier is unsized, the
             # dictionary store rides THIS cache's budget — its resident
@@ -305,6 +315,41 @@ class PlanCache:
 
         self.results.put(ResultCache.dict_key(key, rg, column, kind),
                          value, nbytes, "host")
+
+    # -- writer integration ----------------------------------------------------
+
+    def note_mutation(self, source, store: "ByteStore | None" = None) -> int:
+        """Eagerly invalidate a file the write side just REPLACED or
+        removed (the sharded writer's atomic publish and the compaction
+        service call this the moment their ``os.replace`` lands).
+
+        Without it, stale plans/results die only when the next footer
+        open happens to observe the new generation; with it, the
+        invalidation is synchronous with the mutation — the counters a
+        mutation-mid-sweep test can assert exactly.  Computes the path's
+        NEW generation key and drops every entry of previous generations
+        across footers/plans/dictionaries, then notifies the decoded-
+        result tier (:meth:`ResultCache.note_generation`).  A file that
+        no longer exists (compaction removed it) drops by identity; its
+        decoded results are unreachable afterwards (the key can never be
+        rebuilt) and age out of the LRU.  Returns the number of
+        plan-cache entries dropped."""
+        fk = self.file_key(source, store)
+        with self._lock:
+            if fk is None:
+                if not isinstance(source, (str, os.PathLike)):
+                    return 0
+                ident = ("file", os.path.abspath(os.fspath(source)))
+                dropped = self._drop_stale_locked(ident, None)
+                self._gen.pop(ident, None)
+                return dropped
+            ident = fk[:2]
+            dropped = 0
+            if self._gen.get(ident) != fk:
+                dropped = self._drop_stale_locked(ident, fk)
+                self._gen[ident] = fk
+        self.results.note_generation(fk)
+        return dropped
 
     # -- reader integration ----------------------------------------------------
 

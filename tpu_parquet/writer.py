@@ -39,6 +39,41 @@ from . import __version__
 DEFAULT_ROW_GROUP_SIZE = 128 << 20  # 128 MiB, file_writer.go default
 DEFAULT_CREATED_BY = f"tpu-parquet version {__version__}"
 
+_CRC_ON = ("1", "on", "true", "crc", "yes")
+_CRC_OFF = ("0", "off", "false", "no")
+
+
+def resolve_write_crc(write_crc=None) -> bool:
+    """Resolve a writer's ``write_crc`` option to a bool.
+
+    ``None`` (the default) resolves through ``TPQ_WRITE_CRC``, whose
+    default is ON — mirroring the reader's default-on ``TPQ_VALIDATE``
+    contract: validation is default-on, so freshly written files must
+    carry the CRCs the cheap integrity tier verifies, or the tier
+    silently covers nothing.  Explicit ``False``/``"off"`` opts out;
+    kwarg strings are strict, a malformed env degrades to the default
+    with one warning (the same discipline as ``resolve_validate``).
+    """
+    if write_crc is None:
+        from .obs import warn_env_once
+
+        raw = os.environ.get("TPQ_WRITE_CRC", "1").strip().lower()
+        if raw in _CRC_ON:
+            return True
+        if raw in _CRC_OFF:
+            return False
+        warn_env_once("TPQ_WRITE_CRC", raw, "1 (CRCs written)")
+        return True
+    if isinstance(write_crc, bool):
+        return write_crc
+    v = str(write_crc).strip().lower()
+    if v in _CRC_ON:
+        return True
+    if v in _CRC_OFF:
+        return False
+    raise ValueError(
+        f"write_crc must be a bool, 'on', or 'off'; got {write_crc!r}")
+
 
 class FileWriter:
     """Low-level parquet writer.
@@ -59,11 +94,12 @@ class FileWriter:
         page_size: int = DEFAULT_PAGE_SIZE,
         data_page_version: int = 1,
         use_dictionary: bool = True,
-        write_crc: bool = False,
+        write_crc: "Optional[bool]" = None,
         write_statistics: bool = True,
         created_by: str = DEFAULT_CREATED_BY,
         kv_metadata: Optional[dict] = None,
         column_encodings: Optional[dict] = None,
+        stats=None,
     ):
         if isinstance(sink, (str, os.PathLike)):
             self._f: BinaryIO = open(sink, "wb")
@@ -77,8 +113,15 @@ class FileWriter:
         self.page_size = page_size
         self.data_page_version = data_page_version
         self.use_dictionary = use_dictionary
-        self.write_crc = write_crc
+        # None resolves via TPQ_WRITE_CRC (default ON): the reader's
+        # integrity tier validates CRCs by default, so the writer writes
+        # them by default — the two knobs mirror each other
+        self.write_crc = resolve_write_crc(write_crc)
         self.write_statistics = write_statistics
+        # optional write-side observability (write.WriteStats): encode/
+        # compress/flush lane seconds + row counters for the registry
+        # `write` section — pq_tool doctor's slow-write attribution
+        self.stats = stats
         self.created_by = created_by
         self.kv_metadata = dict(kv_metadata or {})
         self.column_encodings = {
@@ -86,6 +129,8 @@ class FileWriter:
             for k, v in (column_encodings or {}).items()
         }
 
+        if self.stats is not None:
+            self.stats.touch_wall()  # the writer's wall spans open..close
         self._shredder = Shredder(schema)
         self._row_groups: list[RowGroup] = []
         self._pending_cols: Optional[dict[str, ColumnData]] = None
@@ -248,6 +293,7 @@ class FileWriter:
                 write_crc=self.write_crc,
                 encoding=self.column_encodings.get(leaf.path),
                 write_statistics=self.write_statistics,
+                stats=self.stats,
             )
             res = enc.write(cd, self._f, self._pos)
             self._pos += res.total_compressed
@@ -279,6 +325,9 @@ class FileWriter:
         self._total_rows += num_rows
         self._pending_cols = None
         self._pending_rows = 0
+        if self.stats is not None:
+            self.stats.count_row_group(num_rows, chunks=len(chunks))
+            self.stats.touch_wall()
 
     def close(self) -> None:
         if self._closed:
@@ -300,7 +349,13 @@ class FileWriter:
                 for _ in self.schema.leaves
             ],
         )
-        self._write(serialize_footer(meta))
+        footer = serialize_footer(meta)
+        if self.stats is not None:
+            with self.stats.timed("flush", nbytes=len(footer)):
+                self._write(footer)
+            self.stats.touch_wall()
+        else:
+            self._write(footer)
         if self._owns_file:
             self._f.close()
         self._closed = True
