@@ -1465,6 +1465,104 @@ def bench_serve_faults(path, rows, smoke=False):
     return out
 
 
+def bench_serve_tenants(path, rows, smoke=False):
+    """Noisy-neighbor QoS A/B (ISSUE 17): a victim tenant's request
+    latency isolated, then under a noisy tenant's flood with the global
+    FIFO queue, then under weighted deficit-round-robin fair-share.
+
+    One worker (concurrency=1) + a fixed per-range injected latency +
+    result cache OFF make each request's cost deterministic, so the
+    queueing discipline is the ONLY variable: under FIFO the victim's
+    burst waits behind the whole flood; under fair-share (victim weight 3
+    vs noisy 1) it overtakes after at most a quantum.  Banks victim
+    p50/p95/p99 per phase and the fifo/fair degradation ratios, plus the
+    per-tenant serve accounting that proves both tenants ran.  Streaming
+    sessions ride the same tpq-serve workers, so this phase's clean-close
+    assertion (and the exit-3 gate's ``tpq-serve`` prefix) covers them.
+    Skip with BENCH_SERVE_TENANTS=0; ``--smoke`` runs a tiny phase.
+    """
+    import threading
+
+    from tpu_parquet.iostore import (FaultInjectingStore, FaultSpec,
+                                     IOConfig, LocalStore)
+    from tpu_parquet.reader import FileReader
+    from tpu_parquet.serve import ScanRequest, ScanService
+
+    lat = 0.004 if smoke else 0.02
+    noisy_n = 6 if smoke else 20
+    victim_n = 3 if smoke else 6
+    rounds = 1 if smoke else 2
+    with FileReader(path) as r0:
+        col = ".".join(r0.schema.selected_leaves()[0].path)
+
+    def mk_svc(fair):
+        svc = ScanService(
+            concurrency=1, queue_depth=4 * (noisy_n + victim_n),
+            fair=fair, result_cache_mb=0,
+            store=lambda f: FaultInjectingStore(
+                LocalStore(f), FaultSpec(latency_s=lat),
+                config=IOConfig(backoff_ms=1.0)))
+        svc.register_tenant("victim", weight=3)
+        svc.register_tenant("noisy", weight=1)
+        return svc
+
+    def quantile(walls, q):
+        s = sorted(walls)
+        return s[min(int(q * len(s)), len(s) - 1)]
+
+    def victim_burst(svc):
+        walls = []
+        for _ in range(victim_n):
+            t0 = time.perf_counter()
+            svc.scan(ScanRequest(path, columns=[col], tenant="victim"),
+                     timeout=600)
+            walls.append(time.perf_counter() - t0)
+        return walls
+
+    out = {"rows": rows, "latency_s": lat, "noisy_requests": noisy_n,
+           "victim_requests": victim_n * rounds, "victim_weight": 3}
+    for phase, fair in (("isolated", True), ("fifo", False), ("fair", True)):
+        svc = mk_svc(fair)
+        walls, noisy_tickets = [], []
+        for _ in range(rounds):
+            if phase != "isolated":
+                noisy_tickets += [
+                    svc.submit(ScanRequest(path, columns=[col],
+                                           tenant="noisy"))
+                    for _ in range(noisy_n)]
+            walls += victim_burst(svc)
+        for t in noisy_tickets:
+            t.result(600)
+        stats = svc.serve_stats()
+        svc.close()
+        out[phase] = {
+            "p50_ms": round(quantile(walls, 0.5) * 1e3, 3),
+            "p95_ms": round(quantile(walls, 0.95) * 1e3, 3),
+            "p99_ms": round(quantile(walls, 0.99) * 1e3, 3),
+            "victim_submitted": stats["tenants"]["victim"]["submitted"],
+            "noisy_submitted": stats["tenants"].get(
+                "noisy", {}).get("submitted", 0),
+        }
+        log(f"  serve_tenants {phase}: victim p99 "
+            f"{out[phase]['p99_ms']:.1f}ms (p50 {out[phase]['p50_ms']:.1f}"
+            f"ms)")
+    base = out["isolated"]["p99_ms"] or 1e-9
+    out["fifo_ratio"] = round(out["fifo"]["p99_ms"] / base, 3)
+    out["fair_ratio"] = round(out["fair"]["p99_ms"] / base, 3)
+    log(f"serve_tenants: victim p99 degradation under flood — FIFO "
+        f"{out['fifo_ratio']:.1f}x vs fair-share {out['fair_ratio']:.1f}x "
+        f"of isolated (lower is better)")
+    # structural bar: with one worker and a deterministic per-request
+    # cost, fair-share MUST beat FIFO for the victim — equality means the
+    # scheduler isn't actually discriminating by tenant
+    assert out["fair"]["p99_ms"] < out["fifo"]["p99_ms"], out
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("tpq-serve")]
+    out["leaked_serve_threads"] = len(leaked)
+    assert not leaked, f"serve workers leaked: {leaked}"
+    return out
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache (one implementation: the library's —
     device_reader._enable_compile_cache defers to an app-configured dir /
@@ -2036,6 +2134,20 @@ def main(argv=None):
         except Exception as e:  # noqa: BLE001
             log(f"serve_faults bench FAILED: {e!r}")
 
+    # Multi-tenant fair-share QoS (ISSUE 17): victim-tenant p99 isolated
+    # vs under a noisy flood, FIFO vs weighted DRR — the fairness win in
+    # one ratio.  Streaming sessions ride tpq-serve workers, so the
+    # exit-3 leak gate below covers them via the existing prefix.  Skip
+    # with BENCH_SERVE_TENANTS=0; smoke runs a tiny phase.
+    if (os.environ.get("BENCH_SERVE_TENANTS", "1") != "0"
+            and not over_budget()):
+        try:
+            ppath, prows = _config_file("4")
+            results["serve_tenants"] = bench_serve_tenants(
+                ppath, prows, smoke=args.smoke)
+        except Exception as e:  # noqa: BLE001
+            log(f"serve_tenants bench FAILED: {e!r}")
+
     # Fused-vs-unfused device decode A/B on the dominant kernel families
     # (ISSUE 13): forced-route scans banking device_seconds + dispatch/
     # pass counts per side.  Skip with BENCH_FUSED=0; smoke runs it tiny
@@ -2111,8 +2223,11 @@ def main(argv=None):
     emit_results(record, artifact_path)
     # obs daemon hygiene: every sampler/watchdog any reader started must be
     # stopped by now (readers close in their benches) — a leak here is a
-    # thread-lifecycle regression the smoke gate must catch.  After emit:
-    # the driver always gets its JSON line first.
+    # thread-lifecycle regression the smoke gate must catch.  The
+    # tpq-serve prefix also covers streaming scan sessions: they execute
+    # ON the service's worker threads, so a session close() leaving its
+    # producer wedged shows up here as a leaked worker.  After emit: the
+    # driver always gets its JSON line first.
     import threading
 
     leaked = [t.name for t in threading.enumerate()

@@ -116,6 +116,15 @@ def test_make_record_fingerprint(monkeypatch):
     rec2 = ledger.make_record(_record(c=_cfg()), ts=123.5)
     assert rec2["env"]["TPQ_RESULT_CACHE_MB"] == "128"
     assert rec2["env"]["TPQ_RESULT_CACHE_HBM_MB"] == "32"
+    # the QoS/streaming knobs ride too (ISSUE 17): a fair-share run and a
+    # FIFO run — or different tenant weights — are different experiments
+    monkeypatch.setenv("TPQ_SERVE_FAIR", "0")
+    monkeypatch.setenv("TPQ_SERVE_TENANTS", "gold=3,bronze=1")
+    monkeypatch.setenv("TPQ_STREAM_BUFFER_BATCHES", "4")
+    rec3 = ledger.make_record(_record(c=_cfg()), ts=124.0)
+    assert rec3["env"]["TPQ_SERVE_FAIR"] == "0"
+    assert rec3["env"]["TPQ_SERVE_TENANTS"] == "gold=3,bronze=1"
+    assert rec3["env"]["TPQ_STREAM_BUFFER_BATCHES"] == "4"
     assert "python" in rec["env"]
     # inside this repo the short revision resolves
     rev = rec["git_rev"]

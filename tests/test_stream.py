@@ -1,0 +1,295 @@
+"""Streaming scan sessions (ISSUE 17): fixed-shape batches over the serve
+tier, resumable cursors, and the zero-IO warm path.
+
+The contracts under test, in rough order of importance:
+
+- a streamed scan's concatenated (mask-filtered) batches are BIT-IDENTICAL
+  to the one-shot response, at prefetch {0, 4}, host and device (device
+  streams project fixed-width columns; object-dtype columns refuse typed);
+- a cursor saved mid-stream resumes into a NEW session whose remaining
+  batches match the uninterrupted reference exactly — the TPQL checkpoint
+  discipline (data/checkpoint.py) applied to the serve tier;
+- hostile cursor blobs (truncated, bad magic, off-rail positions, lying
+  fingerprints) are refused with CheckpointError, never adopted;
+- a warm stream (result cache holds every chunk) performs ZERO store reads
+  and ZERO file opens — structural counters, not timings;
+- close()/cancel()/deadline reach a CONSUMER BLOCKED IN next() as a typed
+  terminal error, and the service leaks no tpq-serve threads.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_serve import _write_file  # noqa: E402
+
+from tpu_parquet.column import ByteArrayData  # noqa: E402
+from tpu_parquet.errors import (CancelledError, CheckpointError,  # noqa: E402
+                                DeadlineExceededError, ParquetError)
+from tpu_parquet.iostore import LocalStore  # noqa: E402
+from tpu_parquet.serve import (ScanRequest, ScanService,  # noqa: E402
+                               StreamingScan, check_cursor_compatible,
+                               pack_cursor, request_digest, unpack_cursor)
+
+
+@pytest.fixture(scope="module")
+def files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("stream")
+    return [_write_file(str(d / f"f{i}.parquet"), seed=10 + i, groups=3,
+                        rows=400) for i in range(2)]
+
+
+def _oneshot_columns(svc, paths, columns=None):
+    """Per-column concatenation of the one-shot responses, in path order —
+    the reference a streamed session must reproduce byte for byte."""
+    out = {}
+    res = svc.scan(ScanRequest(paths, columns=columns), timeout=60)
+    for p in paths:
+        for name, cd in res[p].items():
+            parts = cd if isinstance(cd, list) else [cd]
+            for part in parts:
+                vals = part.values
+                if isinstance(vals, ByteArrayData):
+                    out.setdefault(name, []).extend(vals.to_list())
+                else:
+                    out.setdefault(name, []).extend(np.asarray(vals))
+    return {n: np.asarray(v, dtype=object if isinstance(v[0], bytes)
+                          else None) for n, v in out.items()}
+
+
+def _drain(session):
+    """Mask-filtered per-column concatenation of a stream's batches."""
+    cols = {}
+    n_batches = 0
+    for batch in session:
+        mask = np.asarray(batch["mask"])
+        for name, arr in batch.items():
+            if name == "mask":
+                continue
+            cols.setdefault(name, []).append(np.asarray(arr)[mask])
+        n_batches += 1
+    return {n: np.concatenate(v) for n, v in cols.items()}, n_batches
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: streamed == one-shot, host and device
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+def test_stream_matches_oneshot_host(files, prefetch):
+    with ScanService(concurrency=2) as svc:
+        want = _oneshot_columns(svc, files)
+        session = svc.scan(ScanRequest(files, stream=True, batch_rows=128,
+                                       prefetch=prefetch), timeout=60)
+        assert isinstance(session, StreamingScan)
+        got, n_batches = _drain(session)
+        # every batch is exactly batch_rows wide; only the mask ragged-edges
+        assert n_batches == 20  # ceil(1200/128) per file, 2 files
+        for name in ("a", "s"):
+            assert np.array_equal(got[name], want[name]), name
+        assert session.rows_emitted == 2400
+
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+def test_stream_matches_oneshot_device(files, prefetch):
+    # device streams ship each batch through jnp.asarray: object-dtype
+    # (BYTE_ARRAY) columns cannot ride, so the projection is fixed-width
+    with ScanService(concurrency=2) as svc:
+        want = _oneshot_columns(svc, files, columns=["a"])
+        session = svc.scan(ScanRequest(files, columns=["a"], stream=True,
+                                       batch_rows=256, device=True,
+                                       prefetch=prefetch), timeout=60)
+        cols = {}
+        for batch in session:
+            arr = np.asarray(batch["a"])  # device -> host for comparison
+            assert arr.shape == (256,)  # fixed-shape: no recompiles downstream
+            assert type(batch["a"]).__name__ != "ndarray"  # actually shipped
+            cols.setdefault("a", []).append(arr[np.asarray(batch["mask"])])
+        got = np.concatenate(cols["a"])
+        assert got.dtype == np.int64  # x64 shipping: no silent downcast
+        assert np.array_equal(got, want["a"])
+
+
+def test_device_stream_refuses_object_columns(files):
+    with ScanService(concurrency=1) as svc:
+        session = svc.scan(ScanRequest([files[0]], stream=True,
+                                       batch_rows=100, device=True),
+                           timeout=60)
+        with pytest.raises(ParquetError, match="device-shippable"):
+            for _ in session:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# cursor: save mid-stream, resume, identical suffix; hostile blobs refused
+# ---------------------------------------------------------------------------
+
+def test_cursor_resume_bit_identical(files):
+    with ScanService(concurrency=2) as svc:
+        ref = svc.scan(ScanRequest(files, stream=True, batch_rows=128),
+                       timeout=60)
+        ref_batches = [{n: np.asarray(v) for n, v in b.items()} for b in ref]
+        s1 = svc.scan(ScanRequest(files, stream=True, batch_rows=128),
+                      timeout=60)
+        taken = [next(s1) for _ in range(5)]
+        blob = s1.cursor()
+        s1.close()
+        assert isinstance(blob, bytes) and blob[:4] == b"TPQS"
+        s2 = svc.scan(ScanRequest(files, stream=True, batch_rows=128,
+                                  cursor=blob), timeout=60)
+        rest = list(s2)
+        assert len(taken) + len(rest) == len(ref_batches)
+        for got, want in zip(taken + rest, ref_batches):
+            for name in want:
+                assert np.array_equal(np.asarray(got[name]), want[name]), name
+        # a terminal session's cursor is adoptable and yields nothing more
+        done = svc.scan(ScanRequest(files, stream=True, batch_rows=128,
+                                    cursor=s2.cursor()), timeout=60)
+        assert list(done) == []
+
+
+def test_cursor_rejects_hostile_blobs(files):
+    with ScanService(concurrency=1) as svc:
+        s = svc.scan(ScanRequest(files, stream=True, batch_rows=128),
+                     timeout=60)
+        next(s)
+        blob = s.cursor()
+        s.close()
+        state = unpack_cursor(blob)
+        # structural refusals: truncation, magic, version, off-rail position
+        for bad in (blob[:10], b"NOPE" + blob[4:],
+                    blob[:4] + (99).to_bytes(2, "big") + blob[6:]):
+            with pytest.raises(CheckpointError):
+                unpack_cursor(bad)
+        lying = dict(state, rows_done=state["rows_done"] + 7)  # off-boundary
+        with pytest.raises(CheckpointError):
+            pack_cursor(lying)
+        # fingerprint refusals, end to end through submit(): a different
+        # batch geometry and a different request shape both refuse typed
+        with pytest.raises(CheckpointError, match="batch_rows"):
+            svc.scan(ScanRequest(files, stream=True, batch_rows=64,
+                                 cursor=blob), timeout=60)
+        with pytest.raises(CheckpointError, match="request_digest"):
+            svc.scan(ScanRequest(files, columns=["a"], stream=True,
+                                 batch_rows=128, cursor=blob), timeout=60)
+        # the digest pins projection/filter/paths; same-shape re-submit passes
+        check_cursor_compatible(state, {
+            "batch_rows": 128, "device": False, "n_paths": len(files),
+            "request_digest": request_digest(
+                ScanRequest(files, stream=True, batch_rows=128))})
+
+
+# ---------------------------------------------------------------------------
+# warm path: a fully-cached stream is zero store IO, structurally
+# ---------------------------------------------------------------------------
+
+def test_warm_stream_zero_store_reads(files):
+    opens, reads = [], []
+
+    def factory(path):
+        store = LocalStore(path)
+        opens.append(path)
+        orig = store.read_range
+
+        def counting_read(offset, size, **kw):
+            reads.append((offset, size))
+            return orig(offset, size, **kw)
+
+        store.read_range = counting_read
+        return store
+
+    with ScanService(concurrency=1, store=factory,
+                     result_cache_mb=64) as svc:
+        cold, _ = _drain(svc.scan(ScanRequest([files[0]], stream=True,
+                                              batch_rows=100), timeout=60))
+        assert opens and reads  # the cold pass did real IO
+        o0, r0 = len(opens), len(reads)
+        warm_session = svc.scan(ScanRequest([files[0]], stream=True,
+                                            batch_rows=100), timeout=60)
+        warm, n_batches = _drain(warm_session)
+        # structural zero: no new opens, no new ranges — every batch came
+        # out of the decoded-result cache
+        assert len(opens) == o0 and len(reads) == r0
+        assert warm_session.warm_batches == n_batches
+        assert warm_session.cold_groups == 0
+        for name in cold:
+            assert np.array_equal(warm[name], cold[name]), name
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: close/cancel/deadline reach a blocked consumer, typed
+# ---------------------------------------------------------------------------
+
+def test_close_drains_blocked_consumer(files):
+    before = {t.name for t in threading.enumerate()
+              if t.name.startswith("tpq-serve")}
+    svc = ScanService(concurrency=1)
+    session = svc.scan(ScanRequest(files, stream=True, batch_rows=128),
+                       timeout=60)
+    got, errs = [], []
+
+    def consume():
+        try:
+            for batch in session:
+                got.append(batch)
+                time.sleep(0.2)  # slower than the producer: buffer fills
+        except CancelledError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.3)
+    svc.close()  # must unblock the consumer with a terminal verdict
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert errs, "blocked next() never saw the close"
+    assert "closed" in str(errs[0])
+    time.sleep(0.05)
+    after = {t.name for t in threading.enumerate()
+             if t.name.startswith("tpq-serve")}
+    assert after <= before  # no leaked workers, sessions included
+
+
+def test_session_cancel_and_deadline(files):
+    with ScanService(concurrency=1) as svc:
+        s = svc.scan(ScanRequest(files, stream=True, batch_rows=128),
+                     timeout=60)
+        next(s)
+        s.cancel()
+        with pytest.raises(CancelledError):
+            for _ in s:
+                pass
+        # stats: the cancelled session is not a silent success.  The
+        # consumer sees the terminal verdict BEFORE the worker books the
+        # failure, so give the accounting a beat to reconcile.
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            st = svc.serve_stats()
+            if st["submitted"] == st["completed"] + st["failed"]:
+                break
+            time.sleep(0.01)
+        assert st["submitted"] == st["completed"] + st["failed"]
+    with ScanService(concurrency=1) as svc:
+        # an expired deadline may fire at submit pickup (before the
+        # session is even handed back) or mid-iteration — typed either way
+        with pytest.raises(DeadlineExceededError):
+            s = svc.scan(ScanRequest(files, stream=True, batch_rows=64,
+                                     deadline_s=0.001), timeout=60)
+            while True:
+                next(s)
+
+
+def test_stream_registry_accounting(files):
+    with ScanService(concurrency=1) as svc:
+        session = svc.scan(ScanRequest([files[0]], stream=True,
+                                       batch_rows=200), timeout=60)
+        n = len(list(session))
+        sv = svc.obs_registry().as_dict()["serve"]
+    assert sv["stream_sessions"] == 1
+    assert sv["stream_batches"] == n == 6
+    assert sv["submitted"] == sv["completed"] == 1

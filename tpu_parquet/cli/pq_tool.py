@@ -418,6 +418,19 @@ def cmd_doctor(args, out=sys.stdout) -> int:
                   f"({co['opened']} trip(s), {co['fast_fails']} fast-fail(s)"
                   f") — the named file keeps failing; inspect or replace "
                   f"it, healthy traffic is unaffected\n")
+    ov = rep.get("overload")
+    if ov:
+        sheds = ov.get("sheds") or {}
+        hint = ov.get("retry_after_hint_s")
+        out.write(f"overload: {ov['rejected']} rejected, "
+                  f"{sheds.get('low', 0)}+{sheds.get('normal', 0)} shed"
+                  + (f"; offender '{ov['offending_tenant']}' "
+                     f"(demand {ov['offender_demand']})"
+                     if ov.get("offending_tenant") else "")
+                  + (f"; victims {', '.join(ov['victims'])}"
+                     if ov.get("victims") else "")
+                  + (f"; retry-after {hint:g}s" if hint else "")
+                  + f" — {ov['advice']}\n")
     hg = rep.get("hedge")
     if hg:
         out.write(f"hedge-ineffective: {hg['won']}/{hg['issued']} hedges "
@@ -545,6 +558,13 @@ def cmd_serve_stats(args, out=sys.stdout) -> int:
         out.write(f"lifecycle: {dl} deadline-exceeded, {cn} cancelled, "
                   f"shed {sheds.get('low', 0)} low / "
                   f"{sheds.get('normal', 0)} normal priority (brownout)\n")
+    if sv.get("retry_after_hint_s"):
+        out.write(f"overload: last retry-after hint "
+                  f"{float(sv['retry_after_hint_s']):.3f}s (callers should "
+                  f"back off at least this long)\n")
+    if sv.get("stream_sessions"):
+        out.write(f"streaming: {sv.get('stream_sessions', 0)} session(s), "
+                  f"{sv.get('stream_batches', 0)} batch(es) emitted\n")
     circ = sv.get("circuit") or {}
     if any(v for k, v in circ.items() if k != "open_files"):
         files = circ.get("open_files") or []
@@ -596,6 +616,33 @@ def cmd_serve_stats(args, out=sys.stdout) -> int:
                   f"single-flight wait(s) (concurrent first-touches "
                   f"served by one decode)\n")
     hists = tree.get("histograms") or {}
+    tenants = {n: t for n, t in (sv.get("tenants") or {}).items()
+               if isinstance(t, dict)}
+    # one-tenant registries are the untenanted default — the table only
+    # earns its lines when QoS is actually partitioning the service
+    if len(tenants) > 1 or any(t.get("rejected") or t.get("sheds", {}).get(
+            "low") or t.get("sheds", {}).get("normal")
+            for t in tenants.values()):
+        out.write("tenants:\n")
+        out.write(f"  {'name':<16}{'weight':>7}{'submit':>8}{'done':>7}"
+                  f"{'reject':>8}{'shed':>6}{'cacheB':>10}{'p99':>12}\n")
+        for name in sorted(tenants):
+            t = tenants[name]
+            tsheds = t.get("sheds") or {}
+            shed = int(tsheds.get("low", 0)) + int(tsheds.get("normal", 0))
+            hd = hists.get(f"serve.tenant.{name}")
+            if isinstance(hd, dict):
+                q99 = LatencyHistogram.from_dict(hd).quantile(0.99) * 1e3
+                p99 = f"{q99:>10.2f}ms"
+            else:
+                p99 = f"{'-':>12}"
+            slo_ms = t.get("slo_p99_ms")
+            out.write(f"  {name:<16}{t.get('weight', 1):>7}"
+                      f"{t.get('submitted', 0):>8}{t.get('completed', 0):>7}"
+                      f"{t.get('rejected', 0):>8}{shed:>6}"
+                      f"{t.get('cache_held_bytes', 0):>10}{p99}"
+                      + (f"  (slo {float(slo_ms):g}ms)" if slo_ms else "")
+                      + "\n")
     slo = [(name.split(".", 1)[1], LatencyHistogram.from_dict(hd))
            for name, hd in sorted(hists.items())
            if name.startswith("serve.")]

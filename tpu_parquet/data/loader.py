@@ -48,11 +48,51 @@ from ..schema.core import Schema
 from . import checkpoint as _ck
 from .sampler import block_permutation, plan_epoch
 
-__all__ = ["DataLoader", "LoaderStats"]
+__all__ = ["DataLoader", "LoaderStats", "pad_and_mask", "ship_to_device"]
 
 # the batch contract needs a static row shape: fixed-width physical types
 # only (ragged byte arrays and repeated columns have none)
 _FIXED_TYPES = (Type.INT32, Type.INT64, Type.FLOAT, Type.DOUBLE, Type.BOOLEAN)
+
+
+def pad_and_mask(cols: dict, n: int, batch_size: int,
+                 mask_key: "str | None" = "mask") -> dict:
+    """THE fixed-shape batch contract, shared by :class:`DataLoader` and
+    the serve tier's streaming sessions: ``n`` valid rows zero-padded to
+    ``batch_size`` plus a boolean row-validity column under ``mask_key``
+    (None skips the mask — the loader's ``drop_remainder`` shape).
+    Object-dtype columns (streamed byte arrays) pad with ``b""`` — a
+    zero there would change the column's value type."""
+    batch = {}
+    for c, a in cols.items():
+        if n < batch_size:
+            if a.dtype == object:
+                pad = np.empty((batch_size - n,), dtype=object)
+                pad[:] = b""
+            else:
+                pad = np.zeros((batch_size - n,) + a.shape[1:],
+                               dtype=a.dtype)
+            a = np.concatenate([a, pad])
+        batch[c] = a
+    if mask_key is not None:
+        m = np.zeros(batch_size, dtype=bool)
+        m[:n] = True
+        batch[mask_key] = m
+    return batch
+
+
+def ship_to_device(batch: dict) -> dict:
+    """Stage one host batch onto the accelerator, preserving 64-bit lanes.
+
+    64-bit staging is scoped to the call (never the global flag):
+    int64/float64 batches keep their width on device while co-resident
+    training code keeps its own dtype semantics."""
+    import jax.numpy as jnp
+
+    from ..jax_kernels import enable_x64
+
+    with enable_x64():
+        return {c: jnp.asarray(v) for c, v in batch.items()}
 
 
 class LoaderStats:
@@ -724,27 +764,11 @@ class DataLoader:
     def _emit(self, cols: dict, n: int):
         """Assemble one yielded batch: pad+mask the ragged tail, optionally
         ship to device."""
-        bs = self._batch_size
-        batch = {}
-        for c, a in cols.items():
-            if n < bs:
-                pad = np.zeros((bs - n,) + a.shape[1:], dtype=a.dtype)
-                a = np.concatenate([a, pad])
-            batch[c] = a
-        if self._mask_key is not None and not self._drop_remainder:
-            m = np.zeros(bs, dtype=bool)
-            m[:n] = True
-            batch[self._mask_key] = m
+        mask_key = (self._mask_key
+                    if not self._drop_remainder else None)
+        batch = pad_and_mask(cols, n, self._batch_size, mask_key=mask_key)
         if self._to_device:
-            import jax.numpy as jnp
-
-            from ..jax_kernels import enable_x64
-
-            # scope 64-bit lanes to the staging call (never flip the global
-            # flag): int64/float64 batches keep their width on device while
-            # co-resident training code keeps its own dtype semantics
-            with enable_x64():
-                batch = {c: jnp.asarray(v) for c, v in batch.items()}
+            batch = ship_to_device(batch)
         return batch
 
     def _batches(self, epoch: int, start_row: int):

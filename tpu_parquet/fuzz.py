@@ -1080,9 +1080,19 @@ def fuzz_result_cache(data: bytes) -> None:
         with rc._lock:
             by_tier = {"host": 0, "device": 0}
             by_count = {"host": 0, "device": 0}
-            for (_v, n, t) in rc._entries.values():
+            by_tenant: dict = {}
+            for (_v, n, t, ten) in rc._entries.values():
                 by_tier[t] += n
                 by_count[t] += 1
+                if ten is not None:
+                    by_tenant[ten] = by_tenant.get(ten, 0) + n
+            # the per-tenant byte ledger (QoS cache shares) reconciles
+            # with the entries — drift here silently breaks share caps
+            ledger = {}
+            for t in ("host", "device"):
+                for ten, n in rc._tenant_bytes[t].items():
+                    ledger[ten] = ledger.get(ten, 0) + n
+            assert by_tenant == ledger, "tenant byte ledger drift"
             for t, total in by_tier.items():
                 assert total == rc._bytes[t], "byte ledger drift"
                 # the per-tier recency index tracks the value map exactly
@@ -1320,6 +1330,70 @@ def crafted_footer_merge_blobs() -> "list[bytes]":
             single]
 
 
+def fuzz_stream_cursor(data: bytes) -> None:
+    """Streaming-scan cursor surface (serve/stream.py): ANY bytes must
+    either unpack to a validated cursor state or raise a tpu_parquet.errors
+    type — truncated, bit-flipped, and version-bumped blobs must never
+    crash or silently seek a resumed stream.  Accepted cursors must
+    round-trip the pack/unpack pair exactly, self-match the compatibility
+    fingerprint, and REFUSE a perturbed request digest (the rail that
+    keeps a cursor from resuming a different stream)."""
+    from .errors import CheckpointError
+    from .serve import stream as sc
+
+    try:
+        st = sc.unpack_cursor(data)
+    except ParquetError:
+        return
+    st2 = sc.unpack_cursor(sc.pack_cursor(st))
+    if st2 != st:
+        raise AssertionError(f"cursor round-trip diverges: {st} != {st2}")
+    fp = {k: st[k] for k in sc._FINGERPRINT}
+    sc.check_cursor_compatible(st, fp)  # self-match must pass
+    lying = dict(fp)
+    d = str(st["request_digest"])
+    lying["request_digest"] = ("0" if d[:1] != "0" else "1") + d[1:]
+    try:
+        sc.check_cursor_compatible(st, lying)
+    except CheckpointError:
+        return
+    raise AssertionError("cursor accepted a mismatched request digest")
+
+
+def crafted_stream_cursor_blobs() -> "list[bytes]":
+    """Hand-crafted ``stream_cursor`` inputs (and corpus blobs): two valid
+    cursors (fresh and mid-stream), then the typed-rejection shapes —
+    truncation, bad magic, a bumped version, a ``rows_done`` off the
+    batch-boundary rail, ``path_index`` past ``n_paths``, a
+    bool-typed int field, and a malformed digest."""
+    import json as _json
+
+    from .serve import stream as sc
+
+    def blob(**over):
+        st = {"version": sc.CURSOR_VERSION, "batch_rows": 128, "n_paths": 2,
+              "path_index": 0, "rows_done": 0, "batches_emitted": 0,
+              "device": False, "request_digest": "deadbeefcafe0123"}
+        st.update(over)
+        payload = _json.dumps(st, sort_keys=True,
+                              separators=(",", ":")).encode()
+        return (sc.CURSOR_MAGIC
+                + int(st.get("version", 1)).to_bytes(2, "big") + payload)
+
+    good = sc.pack_cursor(sc.unpack_cursor(blob()))
+    mid = blob(path_index=1, rows_done=384, batches_emitted=3)
+    return [
+        good, mid,
+        good[: len(good) // 2],              # truncated payload
+        b"TPQX" + good[4:],                  # bad magic
+        blob(version=sc.CURSOR_VERSION + 1),  # unknown version
+        blob(rows_done=100),                 # off the batch-boundary rail
+        blob(path_index=3),                  # past n_paths
+        blob(rows_done=True),                # bool masquerading as int
+        blob(request_digest="nope"),         # digest too short
+    ]
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -1341,6 +1415,7 @@ TARGETS = {
     "fused_plan": fuzz_fused_plan,
     "result_cache": fuzz_result_cache,
     "footer_merge": fuzz_footer_merge,
+    "stream_cursor": fuzz_stream_cursor,
 }
 
 
@@ -1548,6 +1623,8 @@ def _seed_inputs(target: str) -> list[bytes]:
         return crafted_result_cache_blobs()
     if target == "footer_merge":
         return crafted_footer_merge_blobs()
+    if target == "stream_cursor":
+        return crafted_stream_cursor_blobs()
     if target == "loader_state":
         from .data import checkpoint as ck
 

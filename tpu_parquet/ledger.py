@@ -60,6 +60,7 @@ _ENV_KEYS = (
     "TPQ_XPROF", "TPQ_SERVE_CONCURRENCY", "TPQ_SERVE_QUEUE",
     "TPQ_PLAN_CACHE_MB", "TPQ_RESULT_CACHE_MB", "TPQ_RESULT_CACHE_HBM_MB",
     "TPQ_SERVE_BROWNOUT", "TPQ_IO_HEDGE_MS",
+    "TPQ_SERVE_FAIR", "TPQ_SERVE_TENANTS", "TPQ_STREAM_BUFFER_BATCHES",
     "TPQ_WRITE_CRC", "TPQ_WRITE_WORKERS",
     "TPQ_IO_HEDGE_MAX", "TPQ_CIRCUIT_FAILS", "TPQ_CIRCUIT_WINDOW_S",
     "TPQ_CIRCUIT_COOLDOWN_S", "BENCH_SCALE", "BENCH_DEVICE_REPS",

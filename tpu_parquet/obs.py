@@ -1149,6 +1149,10 @@ _MERGE_MAXED = frozenset((
     # circuit-breaker gauge: circuits open RIGHT NOW on one board — two
     # snapshots of the same board must not sum
     "open_now",
+    # tenant QoS gauges: configuration (weight, SLO target) and resident
+    # state (cache bytes, the last computed retry hint) of one registry —
+    # flows like submitted/rejected still sum; these must not
+    "weight", "slo_p99_ms", "retry_after_hint_s", "cache_held_bytes",
 ))
 # ratios/rates derived from the flows: summing them is meaningless (four
 # files' overlap_efficiency is not their sum) — the merge drops them and
@@ -1691,6 +1695,12 @@ HEDGE_VERDICT_MIN_WIN_RATE = 0.2
 # work it immediately throws away
 CACHE_THRASH_MIN_EVICTIONS = 8
 CACHE_THRASH_MAX_HIT_RATE = 0.5
+# overload advisory threshold: fewer rejects+sheds than this is routine
+# backpressure noise, not a verdict.  At or above it doctor names the
+# tenant with the largest demand (submitted + rejected) as the offender
+# and lists the tenants that ate rejections alongside it — the operator's
+# next step is that tenant's weight/budget, not a global knob
+OVERLOAD_MIN_REJECTS = 4
 
 
 def doctor_registry(tree: dict) -> "dict | None":
@@ -1773,8 +1783,16 @@ def doctor_registry(tree: dict) -> "dict | None":
                 for s in ("encode", "compress", "flush", "merge", "compact")}
     wr_lanes["stall"] = g(wr, "stall_seconds")
     wr_total = sum(wr_lanes.values())
+    _sheds = serve.get("sheds")
+    _sheds = _sheds if isinstance(_sheds, dict) else {}
+    overload_pressure = (g(serve, "rejected") + g(_sheds, "low")
+                         + g(_sheds, "normal"))
     if total <= 0 and wr_total <= 0:
-        return None
+        # no decode/write lane ran — but a service rejecting work IS
+        # evidence: an overload where nothing got far enough to decode is
+        # exactly when the operator reaches for doctor
+        if overload_pressure < OVERLOAD_MIN_REJECTS:
+            return None
     out: dict = {}
     if total > 0:
         dominant = max(lanes, key=lambda k: (lanes[k], k))
@@ -1886,6 +1904,38 @@ def doctor_registry(tree: dict) -> "dict | None":
             "files": [str(f) for f in (circ.get("open_files") or [])],
             "fast_fails": int(g(circ, "fast_fails")),
             "opened": int(g(circ, "opened") + g(circ, "reopened")),
+        }
+    sheds = _sheds
+    if overload_pressure >= OVERLOAD_MIN_REJECTS:
+        # the service is turning work away: name WHO is driving the
+        # pressure.  Demand (submitted + rejected) ranks the offender —
+        # rejected requests never reach `submitted`, so admitted flow
+        # alone would hide exactly the tenant being throttled hardest
+        tens = {n: t for n, t in (serve.get("tenants") or {}).items()
+                if isinstance(t, dict)}
+        demand = {n: g(t, "submitted") + g(t, "rejected")
+                  for n, t in tens.items()}
+        offender = (max(demand, key=lambda n: (demand[n], n))
+                    if demand else None)
+        victims = sorted(n for n, t in tens.items()
+                         if n != offender and g(t, "rejected") > 0)
+        hint = g(serve, "retry_after_hint_s")
+        out["overload"] = {
+            "verdict": "overload",
+            "rejected": int(g(serve, "rejected")),
+            "sheds": {"low": int(g(sheds, "low")),
+                      "normal": int(g(sheds, "normal"))},
+            "offending_tenant": offender,
+            "offender_demand": int(demand.get(offender, 0)) if offender
+            else 0,
+            "victims": victims,
+            "retry_after_hint_s": round(hint, 3) if hint else None,
+            "advice": (
+                f"tenant '{offender}' drives the overload: lower its "
+                "fair-share weight or give it a dedicated budget slice "
+                "(TPQ_SERVE_TENANTS), or raise queue_depth/max_memory"
+                if offender else
+                "raise queue_depth/max_memory or shed earlier"),
         }
     io_sec = tree.get("io")
     io_sec = io_sec if isinstance(io_sec, dict) else {}

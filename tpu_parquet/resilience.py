@@ -67,7 +67,7 @@ class CancelToken:
     token's ``check()`` is two attribute reads.
     """
 
-    __slots__ = ("deadline", "deadline_s", "_exc", "_lock")
+    __slots__ = ("deadline", "deadline_s", "_exc", "_lock", "_callbacks")
 
     def __init__(self, deadline: "float | None" = None,
                  deadline_s: "float | None" = None):
@@ -77,6 +77,7 @@ class CancelToken:
         self.deadline_s = deadline_s
         self._exc: "BaseException | None" = None
         self._lock = threading.Lock()
+        self._callbacks: "list | None" = None
 
     @classmethod
     def with_timeout(cls, seconds: "float | None") -> "CancelToken":
@@ -91,9 +92,39 @@ class CancelToken:
         First cause wins — a cancel landing after a deadline expiry (or a
         second cancel) never rewrites the verdict."""
         with self._lock:
-            if self._exc is None:
-                self._exc = exc if exc is not None else CancelledError(
-                    "request cancelled by caller")
+            if self._exc is not None:
+                return
+            self._exc = exc if exc is not None else CancelledError(
+                "request cancelled by caller")
+            cbs, self._callbacks = self._callbacks, None
+            verdict = self._exc
+        self._fire(cbs, verdict)
+
+    def on_cancel(self, callback) -> None:
+        """Register ``callback(exc)`` to fire once when the token flips
+        (cancel OR a deadline verdict latching in ``check()``); fires
+        immediately if it already has.  Callbacks run outside the token
+        lock and must not raise — a raising observer would steal the
+        verdict from the request that owns it, so exceptions are
+        swallowed.  Streaming sessions use this to deliver their terminal
+        verdict to a blocked consumer promptly instead of at the next
+        producer boundary."""
+        with self._lock:
+            exc = self._exc
+            if exc is None:
+                if self._callbacks is None:
+                    self._callbacks = []
+                self._callbacks.append(callback)
+                return
+        self._fire([callback], exc)
+
+    @staticmethod
+    def _fire(cbs, exc) -> None:
+        for cb in cbs or ():
+            try:
+                cb(exc)
+            except Exception:  # noqa: BLE001 — observers never own verdicts
+                pass
 
     @property
     def cancelled(self) -> bool:
@@ -119,6 +150,7 @@ class CancelToken:
         if exc is not None:
             raise exc
         if self.deadline is not None and time.monotonic() >= self.deadline:
+            cbs = None
             with self._lock:
                 if self._exc is None:
                     budget = (f" of {self.deadline_s:g}s"
@@ -126,7 +158,9 @@ class CancelToken:
                     self._exc = DeadlineExceededError(
                         f"request deadline{budget} exceeded",
                         deadline_s=self.deadline_s)
+                    cbs, self._callbacks = self._callbacks, None
                 exc = self._exc
+            self._fire(cbs, exc)
             raise exc
 
 

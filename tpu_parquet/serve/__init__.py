@@ -23,10 +23,17 @@ from .result_cache import (BoundResultCache, ResultCache, ResultTierStats,
                            decode_signature)
 from .service import (PRIORITY_HIGH, PRIORITY_LOW, PRIORITY_NORMAL,
                       ScanRequest, ScanService, ScanTicket, ServeStats)
+from .stream import (StreamingScan, check_cursor_compatible, pack_cursor,
+                     request_digest, unpack_cursor)
+from .tenancy import (DEFAULT_TENANT, FairScheduler, Tenant, TenantRegistry,
+                      parse_tenant_spec)
 
 __all__ = [
-    "BoundDictCache", "BoundResultCache", "CacheStats", "PlanCache",
+    "BoundDictCache", "BoundResultCache", "CacheStats", "DEFAULT_TENANT",
+    "FairScheduler", "PlanCache",
     "PRIORITY_HIGH", "PRIORITY_LOW", "PRIORITY_NORMAL",
     "ResultCache", "ResultTierStats", "ScanRequest", "ScanService",
-    "ScanTicket", "ServeStats", "decode_signature",
+    "ScanTicket", "ServeStats", "StreamingScan", "Tenant", "TenantRegistry",
+    "check_cursor_compatible", "decode_signature", "pack_cursor",
+    "parse_tenant_spec", "request_digest", "unpack_cursor",
 ]

@@ -354,18 +354,19 @@ class PlanCache:
     # -- reader integration ----------------------------------------------------
 
     def bind_results(self, key, plan, row_filter=None, device: bool = False,
-                     validate_crc=None):
+                     validate_crc=None, tenant: "str | None" = None):
         """The ONE bind gate for the decoded-result tier (shared by
         :meth:`reader_kwargs` and ``ScanService``): a filtered DEVICE
         scan whose predicate has no stable fingerprint gets no result
         cache — two unfingerprintable predicates must never share
-        page-pruned device output.  Returns a
+        page-pruned device output.  ``tenant`` attributes inserts to that
+        tenant's cache byte share (ISSUE 17).  Returns a
         :class:`~tpu_parquet.serve.BoundResultCache` or None."""
         if device and row_filter is not None and plan.filter_fp is None:
             return None
         return self.results.bind(key, device=device,
                                  validate_crc=validate_crc,
-                                 filter_fp=plan.filter_fp)
+                                 filter_fp=plan.filter_fp, tenant=tenant)
 
     def reader_kwargs(self, source, columns=None, row_filter=None,
                       store: "ByteStore | None" = None, device: bool = False,

@@ -177,10 +177,17 @@ class ResultCache:
         self.stats = {t: ResultTierStats() for t in TIERS}
         self.single_flight_waits = 0
         self._lock = threading.Lock()
-        # full key -> (value, nbytes, tier); recency lives in the
+        # full key -> (value, nbytes, tier, tenant); recency lives in the
         # per-tier index below — ONE combined order would make every
         # eviction an O(total entries) scan for a same-tier victim
         self._entries: "dict[tuple, tuple]" = {}
+        # multi-tenant byte shares (ISSUE 17): tenant name -> fraction of
+        # each tier's capacity that tenant's entries may hold.  A tenant
+        # over its share evicts ITS OWN LRU entries first — one hot
+        # tenant's working set cannot flush a neighbor's.  Tenants
+        # without a share compete freely under the global bound.
+        self._tenant_share: "dict[str, float]" = {}
+        self._tenant_bytes = {t: {} for t in TIERS}
         # per-tier LRU index: full key -> None, insertion order = recency
         self._lru = {t: OrderedDict() for t in TIERS}
         self._bytes = {t: 0 for t in TIERS}
@@ -218,17 +225,39 @@ class ResultCache:
             return self._bytes["host"]
 
     def bind(self, file_key, device: bool = False, validate_crc=None,
-             filter_fp=None) -> "BoundResultCache | None":
+             filter_fp=None,
+             tenant: "str | None" = None) -> "BoundResultCache | None":
         """The per-(file, decode-shape) adapter the readers duck-call, or
         None when this cache cannot serve chunk results for it (chunk tier
-        off, un-keyable source, or the shape's tier has no budget)."""
+        off, un-keyable source, or the shape's tier has no budget).
+        ``tenant`` attributes the adapter's inserts to that tenant's byte
+        share; lookups are share-blind (a warm entry serves anyone — the
+        share bounds what a tenant may HOLD, not what it may read)."""
         if not self.chunks_enabled or file_key is None:
             return None
         tier = "device" if device else "host"
         if not self._chunk_tier_ok[tier] or self._caps[tier] <= 0:
             return None
         sig = decode_signature(device, validate_crc, filter_fp)
-        return BoundResultCache(self, file_key, sig)
+        return BoundResultCache(self, file_key, sig, tenant=tenant)
+
+    def set_tenant_share(self, tenant: str, fraction: "float | None") -> None:
+        """Cap ``tenant``'s resident bytes at ``fraction`` of each tier's
+        capacity (None removes the cap).  Enforced at insert time — an
+        already-resident overage ages out through the tenant-first
+        eviction on the tenant's next inserts."""
+        with self._lock:
+            if fraction is None:
+                self._tenant_share.pop(tenant, None)
+            else:
+                self._tenant_share[tenant] = min(max(float(fraction), 0.0),
+                                                 1.0)
+
+    def tenant_bytes(self, tenant: str) -> int:
+        """Resident bytes attributed to ``tenant`` across both tiers (the
+        ``serve.tenants.<name>.cache_held_bytes`` gauge)."""
+        with self._lock:
+            return sum(self._tenant_bytes[t].get(tenant, 0) for t in TIERS)
 
     # -- core LRU --------------------------------------------------------------
 
@@ -238,9 +267,16 @@ class ResultCache:
         ent = self._entries.pop(full, None)
         if ent is None:
             return None
-        _v, n, tier = ent
+        _v, n, tier, tenant = ent
         self._lru[tier].pop(full, None)
         self._bytes[tier] -= n
+        if tenant is not None:
+            tb = self._tenant_bytes[tier]
+            left = tb.get(tenant, 0) - n
+            if left > 0:
+                tb[tenant] = left
+            else:
+                tb.pop(tenant, None)
         if tier == "device":
             self.tracker.release_device(n)
         return ent
@@ -277,15 +313,21 @@ class ResultCache:
         return ("device" if isinstance(sig, tuple) and sig
                 and sig[0] == "dev" else "host")
 
-    def put(self, full: tuple, value, nbytes: int, tier: str = "host") -> bool:
+    def put(self, full: tuple, value, nbytes: int, tier: str = "host",
+            tenant: "str | None" = None) -> bool:
         """Insert (shared read-only).  Returns False when the entry was
-        rejected: tier disabled, or bigger than the whole tier — the bound
-        is a hard invariant, never exceeded even transiently, so an
-        oversized value is simply not cached."""
+        rejected: tier disabled, bigger than the whole tier, or bigger
+        than the inserting tenant's byte share — the bounds are hard
+        invariants, never exceeded even transiently, so an oversized
+        value is simply not cached."""
         nbytes = max(int(nbytes), 1)
         with self._lock:
             cap = self._caps[tier]
-            if cap <= 0 or nbytes > cap:
+            share = (self._tenant_share.get(tenant)
+                     if tenant is not None else None)
+            tcap = int(cap * share) if share is not None else None
+            if cap <= 0 or nbytes > cap or (tcap is not None
+                                            and nbytes > tcap):
                 self.stats[tier].rejected += 1
                 return False
             if not self._invalidate_stale_locked(full):
@@ -296,19 +338,35 @@ class ResultCache:
                 self.stats[tier].rejected += 1
                 return False
             self._remove_locked(full)
-            # make room FIRST, within this tier only: device-memory
-            # pressure evicts device entries (never host ones), and the
-            # byte bound holds at every instant.  O(1) per victim: each
-            # tier keeps its own recency index.
             lru = self._lru[tier]
+            # a share-capped tenant over its slice evicts its OWN oldest
+            # entries first — its churn stays inside its share and a
+            # neighbor's warm set survives the flood
+            if tcap is not None:
+                tb = self._tenant_bytes[tier]
+                while tb.get(tenant, 0) + nbytes > tcap:
+                    victim = next((f for f in lru
+                                   if self._entries[f][3] == tenant), None)
+                    if victim is None:
+                        break
+                    self._remove_locked(victim)
+                    self.stats[tier].evictions += 1
+                    self._note_evict_locked(tier, victim)
+            # make room within this tier only: device-memory pressure
+            # evicts device entries (never host ones), and the byte bound
+            # holds at every instant.  O(1) per victim: each tier keeps
+            # its own recency index.
             while self._bytes[tier] + nbytes > cap and lru:
                 victim = next(iter(lru))
                 self._remove_locked(victim)
                 self.stats[tier].evictions += 1
                 self._note_evict_locked(tier, victim)
-            self._entries[full] = (value, nbytes, tier)
+            self._entries[full] = (value, nbytes, tier, tenant)
             lru[full] = None
             self._bytes[tier] += nbytes
+            if tenant is not None:
+                tb = self._tenant_bytes[tier]
+                tb[tenant] = tb.get(tenant, 0) + nbytes
             if tier == "device":
                 self.tracker.register_device(nbytes)
             return True
@@ -390,7 +448,8 @@ class ResultCache:
                 self.stats[count_misses_tier].misses += len(keys)
             return ok
 
-    def get_or_build(self, full: tuple, build, tier: str = "host"):
+    def get_or_build(self, full: tuple, build, tier: str = "host",
+                     tenant: "str | None" = None):
         """Get-or-decode with single-flight semantics: exactly one builder
         per key runs (one counted miss); concurrent callers wait on the
         build and adopt the published entry (counted as hits +
@@ -426,7 +485,8 @@ class ResultCache:
                     with self._lock:
                         self.stats[tier].misses += 1
                     value, nbytes = build()
-                    if not self.put(full, value, nbytes, tier):
+                    if not self.put(full, value, nbytes, tier,
+                                    tenant=tenant):
                         # every rejection reason is permanent for THIS key
                         # (tier cap, oversized value, stale generation):
                         # release future callers from the single-flight
@@ -524,13 +584,15 @@ class BoundResultCache:
     signature) — the adapter the readers duck-call.  Chunk units are
     addressed ``(rg, column)``; values are shared READ-ONLY."""
 
-    __slots__ = ("cache", "key", "sig", "tier")
+    __slots__ = ("cache", "key", "sig", "tier", "tenant")
 
-    def __init__(self, cache: ResultCache, key, sig):
+    def __init__(self, cache: ResultCache, key, sig,
+                 tenant: "str | None" = None):
         self.cache = cache
         self.key = key
         self.sig = sig
         self.tier = "device" if sig and sig[0] == "dev" else "host"
+        self.tenant = tenant
 
     def _full(self, rg: int, column: str) -> tuple:
         return ResultCache.chunk_key(self.key, rg, column, self.sig)
@@ -540,12 +602,12 @@ class BoundResultCache:
 
     def put(self, rg: int, column: str, value, nbytes: int) -> bool:
         return self.cache.put(self._full(rg, column), value, nbytes,
-                              self.tier)
+                              self.tier, tenant=self.tenant)
 
     def get_or_build(self, rg: int, column: str, build):
         """``build()`` returns ``(value, nbytes)``; single-flight."""
         return self.cache.get_or_build(self._full(rg, column), build,
-                                       self.tier)
+                                       self.tier, tenant=self.tenant)
 
     def has_group(self, rg: int, columns,
                   count_misses: bool = False) -> bool:

@@ -38,6 +38,7 @@ import queue
 import threading
 import time
 import weakref
+from collections.abc import Mapping
 
 from ..alloc import InFlightBudget
 from ..errors import (CancelledError, DeadlineExceededError, HangError,
@@ -47,6 +48,10 @@ from ..obs import (LatencyHistogram, env_float, env_int,
                    register_flight_source, resolve_hang_s)
 from ..resilience import BreakerBoard, CancelToken
 from .cache import BoundDictCache, PlanCache
+from .stream import (StreamingScan, check_cursor_compatible, request_digest,
+                     unpack_cursor)
+from .tenancy import (DEFAULT_TENANT, FairScheduler, TenantRegistry,
+                      fair_enabled)
 
 __all__ = ["ScanRequest", "ScanService", "ScanTicket", "ServeStats"]
 
@@ -101,15 +106,29 @@ class ScanRequest:
     feeds brownout shedding: under ``TPQ_SERVE_BROWNOUT`` pressure the
     low band is shed first with a drain-rate ``retry_after_s`` hint while
     high-priority traffic still admits.
+
+    ``tenant`` names the requester for fair-share admission, budget
+    slicing, and per-tenant SLO accounting (unset = the default tenant).
+    ``stream=True`` returns a :class:`~tpu_parquet.serve.StreamingScan`
+    session from ``scan()``/``result()`` instead of a materialized
+    response: iterate it for fixed-shape ``batch_rows``-row padded+masked
+    batches.  ``cursor`` resumes a streaming session from a prior
+    session's :meth:`~tpu_parquet.serve.StreamingScan.cursor` blob
+    (validated at submit time; a mismatched request shape raises
+    :class:`~tpu_parquet.errors.CheckpointError`).
     """
 
     __slots__ = ("paths", "columns", "filter", "prefetch", "device",
-                 "validate_crc", "deadline_s", "priority")
+                 "validate_crc", "deadline_s", "priority", "tenant",
+                 "stream", "batch_rows", "cursor")
 
     def __init__(self, paths, columns=None, filter=None,  # noqa: A002
                  prefetch: int = 0, device: bool = False,
                  validate_crc=None, deadline_s: "float | None" = None,
-                 priority: int = PRIORITY_NORMAL):
+                 priority: int = PRIORITY_NORMAL,
+                 tenant: "str | None" = None, stream: bool = False,
+                 batch_rows: int = 1024,
+                 cursor: "bytes | None" = None):
         import os
 
         self.paths = ([paths] if isinstance(paths, (str, bytes, os.PathLike))
@@ -121,6 +140,10 @@ class ScanRequest:
         self.validate_crc = validate_crc
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.priority = min(max(int(priority), PRIORITY_LOW), PRIORITY_HIGH)
+        self.tenant = DEFAULT_TENANT if not tenant else str(tenant)
+        self.stream = bool(stream)
+        self.batch_rows = int(batch_rows)
+        self.cursor = cursor
 
 
 class ScanTicket:
@@ -192,6 +215,12 @@ class ServeStats:
         # brownout sheds by priority band (subsets of `rejected`)
         self.shed_low = 0
         self.shed_normal = 0
+        # streaming sessions admitted (subset of `submitted`) + batches
+        # delivered; retry_after_hint_s is a GAUGE — the back-off hint the
+        # most recent shed/reject carried (obs merges max it)
+        self.stream_sessions = 0
+        self.stream_batches = 0
+        self.retry_after_hint_s = 0.0
 
     def as_dict(self) -> dict:
         with self.lock:
@@ -207,6 +236,9 @@ class ServeStats:
                 "deadline_exceeded": self.deadline_exceeded,
                 "cancelled": self.cancelled,
                 "sheds": {"low": self.shed_low, "normal": self.shed_normal},
+                "stream_sessions": self.stream_sessions,
+                "stream_batches": self.stream_batches,
+                "retry_after_hint_s": self.retry_after_hint_s,
             }
 
 
@@ -221,7 +253,9 @@ class ScanService:
                  brownout: "float | None" = None,
                  breakers: "BreakerBoard | None" = None,
                  result_cache_mb: "int | None" = None,
-                 result_cache_hbm_mb: "int | None" = None):
+                 result_cache_hbm_mb: "int | None" = None,
+                 tenants: "TenantRegistry | Mapping | str | None" = None,
+                 fair: "bool | None" = None):
         from ..iostore import ByteStore
 
         if concurrency is None:
@@ -284,11 +318,35 @@ class ScanService:
             self._store = _capturing_factory
         else:
             self._store = store  # resolve_store raises its typed error
-        # admission: bounded queue (fast-reject) + shared memory budget
-        # (backpressure between ADMITTED requests, charged from the plan
-        # IR's byte estimate before any byte is read)
-        self._q: "queue.Queue" = queue.Queue(maxsize=int(queue_depth))
+        # admission: bounded multi-tenant scheduler (fast-reject; deficit
+        # round-robin across per-tenant queues unless TPQ_SERVE_FAIR=0
+        # degrades it to global FIFO) + shared memory budget (backpressure
+        # between ADMITTED requests, charged from the plan IR's byte
+        # estimate before any byte is read).  Each tenant also carries its
+        # own weight-proportional budget SLICE (tenancy.py) charged before
+        # the global budget — one tenant's giant scans queue behind that
+        # tenant's slice, not the fleet's.
+        self._q = FairScheduler(int(queue_depth), fair=fair_enabled(fair))
         self._budget = InFlightBudget(int(max_memory))
+        if tenants is None:
+            tenants = TenantRegistry(max_memory=int(max_memory))
+        elif isinstance(tenants, str):
+            tenants = TenantRegistry(max_memory=int(max_memory), spec=tenants)
+        elif isinstance(tenants, Mapping):
+            reg = TenantRegistry(max_memory=int(max_memory), spec="")
+            for name, weight in tenants.items():
+                reg.register(str(name), weight=int(weight))
+            tenants = reg
+        elif not isinstance(tenants, TenantRegistry):
+            raise TypeError(
+                "tenants= must be a TenantRegistry, a {name: weight} "
+                f"mapping, or a spec string, not {type(tenants).__name__}")
+        self.tenants = tenants
+        if tenants is not None and int(max_memory) > 0:
+            self.tenants.set_max_memory(int(max_memory))
+        # live streaming sessions by ticket id — close() aborts them so a
+        # blocked next() caller gets its terminal verdict, not a hang
+        self._streams: dict = {}
         self._hist_wait = LatencyHistogram()
         self._hist_exec = LatencyHistogram()
         self._hist_total = LatencyHistogram()
@@ -334,7 +392,18 @@ class ScanService:
         backlog = self._q.qsize() + len(self._inflight)
         return round(max(backlog * avg / max(self.concurrency, 1), 0.05), 3)
 
-    def _maybe_shed(self, request: ScanRequest) -> None:
+    def register_tenant(self, name: str, weight: int = 1,
+                        slo_p99_ms: "float | None" = None,
+                        cache_fraction: "float | None" = None):
+        """Configure a tenant's QoS: fair-share ``weight``, optional SLO
+        target (the ``serve.tenants`` subtree and doctor read it), and an
+        optional fraction of the result cache its inserts may hold."""
+        t = self.tenants.register(name, weight=weight, slo_p99_ms=slo_p99_ms,
+                                  cache_fraction=cache_fraction)
+        self.cache.results.set_tenant_share(name, cache_fraction)
+        return t
+
+    def _maybe_shed(self, request: ScanRequest, tenant) -> None:
         """Brownout gate: shed low-priority work at ``brownout``
         occupancy and normal-priority work halfway from there to full —
         graceful degradation instead of a cliff, with the shed caller
@@ -347,48 +416,97 @@ class ScanService:
             threshold = self.brownout + (1.0 - self.brownout) / 2
         if occ < threshold:
             return
+        hint = self._retry_after_s()
         with self.stats.lock:
             self.stats.rejected += 1
             if request.priority <= PRIORITY_LOW:
                 self.stats.shed_low += 1
             else:
                 self.stats.shed_normal += 1
+            self.stats.retry_after_hint_s = hint
             inflight = len(self._inflight)
+        with tenant.lock:
+            tenant.rejected += 1
+            if request.priority <= PRIORITY_LOW:
+                tenant.shed_low += 1
+            else:
+                tenant.shed_normal += 1
         band = "low" if request.priority <= PRIORITY_LOW else "normal"
         raise OverloadError(
             f"scan service browning out ({occ:.0%} occupancy >= "
-            f"{threshold:.0%}): shedding {band}-priority work",
+            f"{threshold:.0%}): shedding {band}-priority work of tenant "
+            f"{tenant.name!r}",
             queue_depth=self._q.qsize(), in_flight=inflight,
-            retry_after_s=self._retry_after_s(),
-            shed_priority=request.priority)
+            retry_after_s=hint, shed_priority=request.priority)
 
     def submit(self, request: ScanRequest) -> ScanTicket:
         """Admit one request; raises :class:`OverloadError` IMMEDIATELY
         when the queue is full (load shedding, never a blocked caller) or
         when brownout sheds this priority band (``retry_after_s`` set).
         The returned ticket's ``cancel()`` and the request's
-        ``deadline_s`` both flow into every downstream read."""
+        ``deadline_s`` both flow into every downstream read.
+
+        A ``stream=True`` request's ticket resolves to a
+        :class:`~tpu_parquet.serve.StreamingScan` session the moment a
+        worker picks it up; a resume ``cursor`` is validated HERE,
+        synchronously, so a mismatched blob fails the caller typed and
+        immediately rather than mid-stream."""
         ticket = ScanTicket(next(_req_ids),
                             CancelToken.with_timeout(request.deadline_s))
-        self._maybe_shed(request)
+        tenant = self.tenants.get(request.tenant)
+        self._maybe_shed(request, tenant)
+        session = None
+        if request.stream:
+            state = None
+            if request.cursor is not None:
+                state = unpack_cursor(request.cursor)
+                check_cursor_compatible(state, {
+                    "batch_rows": int(request.batch_rows),
+                    "device": bool(request.device),
+                    "n_paths": len(request.paths),
+                    "request_digest": request_digest(request),
+                })
+            session = StreamingScan(self, request, ticket, tenant,
+                                    resume_state=state)
+            with self._inflight_lock:
+                self._streams[ticket.id] = session
         try:
             with self._submit_lock:
                 if self._closed:
                     raise RuntimeError("ScanService is closed")
-                self._q.put_nowait((ticket, request, time.perf_counter()))
+                self._q.put_nowait(
+                    tenant.name, tenant.weight,
+                    (ticket, request, time.perf_counter(), session))
         except queue.Full:
+            if session is not None:
+                with self._inflight_lock:
+                    self._streams.pop(ticket.id, None)
+            hint = self._retry_after_s()
             with self.stats.lock:
                 self.stats.rejected += 1
+                self.stats.retry_after_hint_s = hint
                 inflight = len(self._inflight)
+            with tenant.lock:
+                tenant.rejected += 1
             raise OverloadError(
                 f"scan service overloaded: queue full "
-                f"({self._q.maxsize} queued, {inflight} in flight)",
+                f"({self._q.maxsize} queued, {inflight} in flight; "
+                f"tenant {tenant.name!r})",
                 queue_depth=self._q.maxsize, in_flight=inflight,
-                retry_after_s=self._retry_after_s()) from None
+                retry_after_s=hint) from None
+        except BaseException:
+            if session is not None:  # closed-service raise: no stale entry
+                with self._inflight_lock:
+                    self._streams.pop(ticket.id, None)
+            raise
         with self.stats.lock:
             self.stats.submitted += 1
+            if session is not None:
+                self.stats.stream_sessions += 1
             self.stats.queue_depth_peak = max(self.stats.queue_depth_peak,
                                               self._q.qsize())
+        with tenant.lock:
+            tenant.submitted += 1
         return ticket
 
     def scan(self, request: ScanRequest, timeout: "float | None" = None):
@@ -402,7 +520,8 @@ class ScanService:
             item = self._q.get()
             if item is None:
                 return
-            ticket, request, t_submit = item
+            ticket, request, t_submit, session = item
+            tenant = self.tenants.get(request.tenant)
             t_start = time.perf_counter()
             wait = t_start - t_submit
             ticket.queue_wait_s = wait
@@ -410,11 +529,22 @@ class ScanService:
             first = request.paths[0] if request.paths else None
             with self._inflight_lock:
                 self._inflight[ticket.id] = (str(first), t_start)
+            rows = 0
             try:
                 # a request that expired (or was cancelled) while queued
                 # fails HERE, typed, before any byte is charged or read
                 ticket.token.check()
-                result, exc = self._execute(request, ticket.token), None
+                if session is not None:
+                    # the session IS the response: the caller's result()
+                    # unblocks with it now, batches flow as they decode.
+                    # A streaming session occupies this worker slot until
+                    # it drains, errors, or is cancelled.
+                    ticket._finish(result=session)
+                    rows = session._produce()
+                    result, exc = session, None
+                else:
+                    result, exc = self._execute(request, ticket.token), None
+                    rows = _count_rows(result)
             except BaseException as e:  # noqa: BLE001 — delivered to caller
                 result, exc = None, e
             # ALL bookkeeping lands before _finish sets the ticket's event:
@@ -424,8 +554,11 @@ class ScanService:
             ticket.exec_s = t_end - t_start
             self._hist_exec.record(ticket.exec_s)
             self._hist_total.record(t_end - t_submit)
+            if tenant is not None:
+                tenant.hist.record(t_end - t_submit)
             with self._inflight_lock:
                 self._inflight.pop(ticket.id, None)
+                self._streams.pop(ticket.id, None)
             with self.stats.lock:
                 self.stats.queue_wait_seconds += wait
                 self.stats.exec_seconds += ticket.exec_s
@@ -437,11 +570,22 @@ class ScanService:
                         self.stats.cancelled += 1
                 else:
                     self.stats.completed += 1
-                    self.stats.rows += _count_rows(result)
-            if exc is not None:
-                ticket._finish(exc=exc)
-            else:
-                ticket._finish(result=result)
+                    self.stats.rows += rows
+            with tenant.lock:
+                tenant.queue_wait_seconds += wait
+                tenant.exec_seconds += ticket.exec_s
+                if exc is not None:
+                    tenant.failed += 1
+                else:
+                    tenant.completed += 1
+                    tenant.rows += rows
+            # a streaming ticket already resolved to its session; its
+            # producer's failure was delivered through the session buffer
+            if not ticket.done():
+                if exc is not None:
+                    ticket._finish(exc=exc)
+                else:
+                    ticket._finish(result=result)
 
     def _fold_io(self, store) -> None:
         """Bank a closing store's IOStats into the service aggregate (the
@@ -453,6 +597,37 @@ class ScanService:
         self._served_stores.discard(store)
         with self._io_agg_lock:
             _merge_num_tree(self._io_agg, d)
+
+    def _charge_stream(self, tenant, nbytes: int, token) -> tuple:
+        """Charge ``nbytes`` against the tenant's budget SLICE first, then
+        the global budget (each clamped to its own cap, mirroring the
+        one-shot path's oversized-item rule).  Tenant-first ordering is
+        the fairness property: a tenant over its slice blocks HERE, on
+        its own budget, without ever holding global bytes a neighbor
+        needs.  Returns the (tenant, global) charges for release."""
+        tc = gc = 0
+        tb = tenant.budget if tenant is not None else None
+        if tb is not None and tb.max_bytes > 0:
+            tc = min(int(nbytes), tb.max_bytes)
+            if tc:
+                tb.acquire(tc, cancel=token)
+        if self._budget.max_bytes > 0:
+            gc = min(int(nbytes), self._budget.max_bytes)
+            if gc:
+                try:
+                    self._budget.acquire(gc, cancel=token)
+                except BaseException:
+                    if tc:
+                        tb.release(tc)
+                    raise
+        return (tc, gc)
+
+    def _release_stream(self, tenant, charges: tuple) -> None:
+        tc, gc = charges
+        if gc:
+            self._budget.release(gc)
+        if tc and tenant is not None:
+            tenant.budget.release(tc)
 
     def _resolve_filter(self, request: ScanRequest):
         flt = request.filter
@@ -477,6 +652,7 @@ class ScanService:
         from ..reader import FileReader
 
         pred = self._resolve_filter(request)
+        tenant = self.tenants.get(request.tenant)
         out: dict = {}
         for path in request.paths:
             if token is not None:
@@ -495,9 +671,9 @@ class ScanService:
                 # through the ONE gate PlanCache.bind_results encodes
                 rcache = self.cache.bind_results(
                     key, plan, row_filter=pred, device=request.device,
-                    validate_crc=vcrc)
+                    validate_crc=vcrc, tenant=tenant.name)
                 served = (self._serve_from_cache(rcache, plan, request,
-                                                 token)
+                                                 token, tenant)
                           if rcache is not None else None)
                 if served is not None:
                     # pure cache hit: no reader, no store, no device
@@ -505,11 +681,8 @@ class ScanService:
                     out[str(path)] = served
                     self.breakers.note(bkey, str(path), ok=True)
                     continue
-                charge = min(plan.estimated_bytes(),
-                             max(self._budget.max_bytes, 0)) \
-                    if self._budget.max_bytes > 0 else 0
-                if charge:
-                    self._budget.acquire(charge, cancel=token)
+                charges = self._charge_stream(tenant,
+                                              plan.estimated_bytes(), token)
                 try:
                     kw = dict(columns=request.columns, metadata=meta,
                               row_filter=pred, prefetch=request.prefetch,
@@ -534,8 +707,7 @@ class ScanService:
                         with FileReader(path, **kw) as r:
                             out[str(path)] = self._read_watched(r)
                 finally:
-                    if charge:
-                        self._budget.release(charge)
+                    self._release_stream(tenant, charges)
             except _CLASSIFIED_FAILURES:
                 self.breakers.note(bkey, str(path), ok=False)
                 raise
@@ -543,7 +715,7 @@ class ScanService:
         return out
 
     def _serve_from_cache(self, rcache, plan, request: ScanRequest,
-                          token) -> "dict | None":
+                          token, tenant=None) -> "dict | None":
         """The result-cache hit path: when EVERY (surviving row group,
         selected column) unit of the plan is cached under this request's
         decode signature, assemble the response straight from the cache —
@@ -573,10 +745,7 @@ class ScanService:
         if got is None:
             return None
         total = sum(n for _v, n in got)
-        charge = (min(total, self._budget.max_bytes)
-                  if self._budget.max_bytes > 0 else 0)
-        if charge:
-            self._budget.acquire(charge, cancel=token)
+        charges = self._charge_stream(tenant, total, token)
         try:
             per_col: dict = {}
             vals = iter(got)
@@ -592,8 +761,7 @@ class ScanService:
                         else _concat_column_data(parts))
                     for c, parts in per_col.items()}
         finally:
-            if charge:
-                self._budget.release(charge)
+            self._release_stream(tenant, charges)
 
     def _read_watched(self, r) -> dict:
         """``read_all`` under a per-request watchdog: a stalled store fetch
@@ -631,27 +799,36 @@ class ScanService:
 
     def close(self) -> None:
         """Drain-free shutdown: queued-but-unstarted requests fail with
-        OverloadError; executing requests finish."""
+        OverloadError; executing one-shot requests finish; LIVE streaming
+        sessions are aborted — their producers stop at the next batch
+        boundary, buffered batches release their budget bytes, and a
+        consumer blocked in ``next()`` raises the terminal
+        :class:`~tpu_parquet.errors.CancelledError` promptly (no worker
+        thread leaks behind an abandoned session)."""
         with self._submit_lock:
             if self._closed:
                 return
             self._closed = True
-        drained = []
-        try:
-            while True:
-                drained.append(self._q.get_nowait())
-        except queue.Empty:
-            pass
-        for item in drained:
-            if item is not None:
-                with self.stats.lock:
-                    # accounted as rejections so the serve section always
-                    # reconciles: submitted == completed + failed + rejected
-                    self.stats.rejected += 1
-                item[0]._finish(exc=OverloadError(
-                    "scan service closed before this request started"))
+        for item in self._q.drain():
+            ticket, _req, _t, session = item
+            with self.stats.lock:
+                # accounted as rejections so the serve section always
+                # reconciles: submitted == completed + failed + rejected
+                self.stats.rejected += 1
+            exc = OverloadError(
+                "scan service closed before this request started")
+            if session is not None:
+                with self._inflight_lock:
+                    self._streams.pop(ticket.id, None)
+                session._abort(exc)
+            ticket._finish(exc=exc)
+        with self._inflight_lock:
+            live = list(self._streams.values())
+        for session in live:
+            session._abort(CancelledError(
+                "scan service closed; streaming session terminated"))
         for _ in self._workers:
-            self._q.put(None)
+            self._q.put_sentinel()
         for t in self._workers:
             t.join(timeout=60)
 
@@ -677,6 +854,9 @@ class ScanService:
             "oldest_request_s": oldest,
             "occupancy": round(self._occupancy(), 4),
             "brownout": self.brownout,
+            "fair": self._q.fair,
+            "tenant_queues": self._q.tenant_depths(),
+            "streams": len(self._streams),
             "requests": inflight,
             "cache": self.cache.counters(),
             "result_cache": self.cache.results.counters(),
@@ -687,9 +867,16 @@ class ScanService:
 
     def serve_stats(self) -> dict:
         """The registry ``serve`` section: counters + cache counters +
-        circuit-breaker transitions."""
+        circuit-breaker transitions + the per-tenant ``tenants`` subtree
+        (weights, lifecycle flows, shed counters, budget slices, and each
+        tenant's resident result-cache bytes)."""
+        tenants = {}
+        for name, t in self.tenants.tenants().items():
+            d = t.as_dict()
+            d["cache_held_bytes"] = self.cache.results.tenant_bytes(name)
+            tenants[name] = d
         return {**self.stats.as_dict(), "cache": self.cache.counters(),
-                "circuit": self.breakers.counters()}
+                "circuit": self.breakers.counters(), "tenants": tenants}
 
     def obs_registry(self):
         """Unified metrics tree: the ``serve`` section, the request
@@ -708,6 +895,11 @@ class ScanService:
         reg.histogram("serve.queue_wait").merge_from(self._hist_wait)
         reg.histogram("serve.exec").merge_from(self._hist_exec)
         reg.histogram("serve.request").merge_from(self._hist_total)
+        # per-tenant end-to-end latency (the fairness SLO surface the
+        # noisy-neighbor bench and `pq_tool serve-stats` read)
+        for name, t in self.tenants.tenants().items():
+            if t.hist.count:
+                reg.histogram(f"serve.tenant.{name}").merge_from(t.hist)
         with self._io_agg_lock:
             if self._io_agg:
                 reg.add_io(dict(self._io_agg))
