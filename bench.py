@@ -1563,6 +1563,107 @@ def bench_serve_tenants(path, rows, smoke=False):
     return out
 
 
+def bench_io_scale(path, rows, smoke=False):
+    """IO-concurrency scaling A/B (ISSUE 18): the async fetch engine vs a
+    blocking-read thread pool, sweeping the in-flight target under a fixed
+    per-range injected latency.
+
+    Each leg fetches k ranges through a 50ms-latency store.  The threaded
+    leg uses a pool capped at 32 workers — the realistic decode-worker
+    ceiling the old path had (in the pipeline, ``prefetch=`` bounds it);
+    the engine leg multiplexes all k as futures on ONE loop thread with
+    ``max_inflight=k``.  At k=8 the legs tie; by k=256 the pool is queue-
+    bound at its thread cap while the engine overlaps everything — the
+    banked ratio is the headline.  Results must be byte-identical between
+    legs and no engine/pool thread may survive the phase.  Skip with
+    BENCH_IOSCALE=0; ``--smoke`` runs a tiny sweep.
+    """
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tpu_parquet.iostore import (FaultInjectingStore, FaultSpec,
+                                     IOConfig, LocalStore)
+    from tpu_parquet.iostore_async import FetchEngine
+
+    lat = 0.01 if smoke else 0.05
+    sweep = (4, 16) if smoke else (8, 64, 256)
+    pool_cap = 32
+    rsize = 4096
+    fsize = os.path.getsize(path)
+
+    def ranges_for(k):
+        step = max((fsize - rsize) // max(k, 1), 1)
+        return [((i * step) % max(fsize - rsize, 1), rsize)
+                for i in range(k)]
+
+    def mk_store(f):
+        return FaultInjectingStore(
+            LocalStore(f), FaultSpec(latency_s=lat),
+            config=IOConfig(backoff_ms=1.0))
+
+    def quantile(walls, q):
+        s = sorted(walls)
+        return s[min(int(q * len(s)), len(s) - 1)]
+
+    out = {"rows": rows, "latency_s": lat, "pool_threads": pool_cap,
+           "range_bytes": rsize}
+    for k in sweep:
+        want = ranges_for(k)
+        fobj = open(path, "rb")
+        st_t = mk_store(fobj)
+        walls_t = []
+
+        def read_one(r, _st=st_t, _w=walls_t):
+            t0 = time.perf_counter()
+            buf = _st.read_range(*r)
+            _w.append(time.perf_counter() - t0)
+            return bytes(buf)
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=min(k, pool_cap)) as ex:
+            got_t = list(ex.map(read_one, want))
+        wall_t = time.perf_counter() - t0
+
+        st_e = mk_store(fobj)
+        eng = FetchEngine(max_inflight=k)
+        walls_e, done_at = [], {}
+        try:
+            t0 = time.perf_counter()
+            futs = [eng.submit(st_e, o, s) for o, s in want]
+            for f in futs:
+                f.add_done_callback(
+                    lambda _f, _t0=t0: done_at.setdefault(
+                        id(_f), time.perf_counter() - _t0))
+            got_e = [bytes(f.result(timeout=600)) for f in futs]
+            wall_e = time.perf_counter() - t0
+            walls_e = [done_at[id(f)] for f in futs]
+            peak = eng.stats.inflight_peak
+        finally:
+            eng.close()
+            fobj.close()
+        assert got_t == got_e, \
+            f"engine leg diverged from threaded leg at k={k}"
+        ratio = wall_t / wall_e if wall_e else 0.0
+        out[f"k{k}"] = {
+            "threaded_s": round(wall_t, 4), "engine_s": round(wall_e, 4),
+            "ratio": round(ratio, 3),
+            "threaded_p99_ms": round(quantile(walls_t, 0.99) * 1e3, 2),
+            "engine_p99_ms": round(quantile(walls_e, 0.99) * 1e3, 2),
+            "engine_inflight_peak": peak,
+        }
+        log(f"  io_scale k={k}: threaded {wall_t:.3f}s vs engine "
+            f"{wall_e:.3f}s ({ratio:.1f}x), engine peak {peak} in flight")
+        if not smoke and k > pool_cap:
+            # structural bar: past the pool's thread cap the engine MUST
+            # win — parity there means it isn't actually multiplexing
+            assert ratio >= (4.0 if k >= 8 * pool_cap else 1.2), out
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("tpq-fetch")]
+    out["leaked_engine_threads"] = len(leaked)
+    assert not leaked, f"fetch-engine threads leaked: {leaked}"
+    return out
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache (one implementation: the library's —
     device_reader._enable_compile_cache defers to an app-configured dir /
@@ -2148,6 +2249,18 @@ def main(argv=None):
         except Exception as e:  # noqa: BLE001
             log(f"serve_tenants bench FAILED: {e!r}")
 
+    # IO-concurrency scaling (ISSUE 18): async fetch engine vs blocking-
+    # read thread pool under 50ms injected latency, sweeping in-flight
+    # {8, 64, 256} — byte-identity and the no-leaked-threads bar are
+    # asserted inside.  Skip with BENCH_IOSCALE=0; smoke runs a tiny sweep.
+    if os.environ.get("BENCH_IOSCALE", "1") != "0" and not over_budget():
+        try:
+            ppath, prows = _config_file("4")
+            results["io_scale"] = bench_io_scale(
+                ppath, prows, smoke=args.smoke)
+        except Exception as e:  # noqa: BLE001
+            log(f"io_scale bench FAILED: {e!r}")
+
     # Fused-vs-unfused device decode A/B on the dominant kernel families
     # (ISSUE 13): forced-route scans banking device_seconds + dispatch/
     # pass counts per side.  Skip with BENCH_FUSED=0; smoke runs it tiny
@@ -2230,10 +2343,16 @@ def main(argv=None):
     # driver always gets its JSON line first.
     import threading
 
+    # the shared fetch engine is process-lived by design (scans reuse its
+    # loop thread); benches are done with it here, so shut it down and hold
+    # it to the same zero-leak bar as every other daemon
+    from tpu_parquet.iostore_async import shutdown_default_engine
+
+    shutdown_default_engine()
     leaked = [t.name for t in threading.enumerate()
               if t.name.startswith(("tpq-sampler", "tpq-watchdog",
                                     "tpq-devtimer", "tpq-hedge",
-                                    "tpq-serve"))]
+                                    "tpq-serve", "tpq-fetch"))]
     if leaked:
         log(f"FAIL: obs daemon threads leaked after completion: {leaked}")
         sys.exit(3)

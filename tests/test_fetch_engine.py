@@ -1,0 +1,474 @@
+"""Async fetch engine tests (ISSUE 18): threaded-vs-engine bit-identity
+across the fault matrix, the 256-range stall-storm concurrency claim,
+close()/cancel hygiene (no leaked threads, every waiter woken), the hedge
+race on the async path, per-tenant default deadlines, and the
+``io-concurrency-bound`` doctor verdict.
+
+The acceptance contract: the whole fault matrix holds bit-identically on
+the engine path at every prefetch depth; in-flight IO is bounded only by
+``TPQ_IO_INFLIGHT`` (one loop thread, hundreds of in-flight ranges); and
+the engine cleans up after itself — a closed engine leaves no ``tpq-fetch``
+thread and an unfinished fetch's future always settles.
+"""
+
+import contextlib
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_parquet.errors import (CancelledError, DeadlineExceededError,
+                                RetryExhaustedError, TransientIOError)
+from tpu_parquet.iostore import (FaultInjectingStore, FaultSpec,
+                                 GenericRangeStore, IOConfig, LocalStore,
+                                 RetryBudget, ScanToken)
+from tpu_parquet.iostore_async import (FetchEngine, default_engine_if_running,
+                                       engine_enabled, engine_for_store,
+                                       get_default_engine,
+                                       shutdown_default_engine)
+from tpu_parquet.reader import FileReader
+from tpu_parquet.resilience import CancelToken
+from tpu_parquet.writer import FileWriter
+
+
+def _write_file(path, groups=3, rows=400, seed=0):
+    from tpu_parquet.format import (CompressionCodec,
+                                    FieldRepetitionType as FRT, Type)
+    from tpu_parquet.schema.core import build_schema, data_column
+
+    schema = build_schema([data_column("a", Type.INT64, FRT.REQUIRED),
+                           data_column("b", Type.INT64, FRT.REQUIRED)])
+    rng = np.random.default_rng(seed)
+    with FileWriter(path, schema, codec=CompressionCodec.SNAPPY) as w:
+        for _ in range(groups):
+            w.write_columns({"a": rng.integers(0, 1 << 30, rows),
+                             "b": rng.integers(0, 1 << 30, rows)})
+            w.flush_row_group()
+    return path
+
+
+@pytest.fixture(scope="module")
+def pq_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("fetch_engine") / "faulty.parquet")
+    _write_file(path)
+    with FileReader(path) as r:
+        base = r.read_pylist()
+    return path, base
+
+
+def _cfg(**kw):
+    kw.setdefault("retries", 4)
+    kw.setdefault("backoff_ms", 1.0)
+    return IOConfig(**kw)
+
+
+def _fault_factory(spec, config=None, stores=None, seed=0):
+    def make(f):
+        st = FaultInjectingStore(LocalStore(f), spec,
+                                 config=config or _cfg(), seed=seed)
+        if stores is not None:
+            stores.append(st)
+        return st
+
+    return make
+
+
+def _engine_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith("tpq-fetch")]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_default_engine():
+    # the default engine is process-global and its stats are cumulative;
+    # start each test from a dead engine so threaded-mode registries stay
+    # engine-free and leak asserts see only threads the test itself made
+    shutdown_default_engine()
+    yield
+    shutdown_default_engine()
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: fault matrix x {threaded, async} x prefetch depth
+# ---------------------------------------------------------------------------
+
+RECOVERABLE = {
+    "latency_spike": FaultSpec(latency_s=0.005),
+    "transient_errors": FaultSpec(fail_first=2),
+    "torn_read": FaultSpec(torn_first=1),
+    "torn_then_error": FaultSpec(torn_first=1, fail_first=2),
+}
+
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+@pytest.mark.parametrize("fault", sorted(RECOVERABLE))
+def test_fault_matrix_threaded_vs_async_bit_identical(
+        pq_file, fault, prefetch, monkeypatch):
+    """The same faulted file decodes to the same rows on both IO paths,
+    with the same recovery counters — the engine reimplements the retry
+    loop, it does not reinterpret it."""
+    path, base = pq_file
+    trees = {}
+    for mode, env in (("threaded", "0"), ("async", "1")):
+        monkeypatch.setenv("TPQ_IO_ASYNC", env)
+        stores = []
+        with FileReader(path, prefetch=prefetch,
+                        store=_fault_factory(RECOVERABLE[fault],
+                                             stores=stores)) as r:
+            assert r.read_pylist() == base, f"{mode} path diverged"
+            trees[mode] = r.obs_registry().as_dict()["io"]
+        assert (engine_for_store(stores[0]) is not None) == (mode == "async")
+    for mode, d in trees.items():
+        assert d["exhausted"] == 0, mode
+        if "transient" in fault or "error" in fault:
+            assert d["retries"] > 0 and d["transient_errors"] > 0, mode
+        if fault.startswith("torn"):
+            assert d["short_reads"] > 0, mode
+    # the engine path reports itself: with a prefetch window the engine
+    # feed carries the ranges and the io section grows an engine subtree
+    # with a reconciling ledger (prefetch=0 keeps the serial sync path,
+    # and the threaded mode never has one)
+    assert "engine" not in trees["threaded"]
+    if prefetch > 0:
+        eng = trees["async"]["engine"]
+        assert eng["submitted"] > 0
+        assert eng["completed"] + eng["failed"] == eng["submitted"]
+        assert eng["inflight"] == 0
+
+
+@pytest.mark.parametrize("prefetch", [0, 4])
+def test_exhaustion_identical_on_async_path(pq_file, prefetch, monkeypatch):
+    """Terminal verdicts match too: same error type, same attempt log
+    shape, byte-identical attempt messages either way."""
+    path, _base = pq_file
+
+    def run(env):
+        monkeypatch.setenv("TPQ_IO_ASYNC", env)
+        with pytest.raises(RetryExhaustedError) as ei:
+            with FileReader(path, prefetch=prefetch,
+                            store=_fault_factory(
+                                FaultSpec(fail_first=99),
+                                config=_cfg(retries=2))) as r:
+                r.read_all()
+        return ei.value
+
+    threaded, eng = run("0"), run("1")
+    assert len(threaded.attempts) == len(eng.attempts) == 3
+    assert ([a["error"] for a in threaded.attempts]
+            == [a["error"] for a in eng.attempts])
+    assert (threaded.offset, threaded.size) == (eng.offset, eng.size)
+
+
+def test_kill_switch_and_inflight_zero_disable_routing(monkeypatch):
+    monkeypatch.setenv("TPQ_IO_ASYNC", "0")
+    assert not engine_enabled()
+    monkeypatch.setenv("TPQ_IO_ASYNC", "1")
+    assert engine_enabled()
+    monkeypatch.setenv("TPQ_IO_INFLIGHT", "0")
+    assert not engine_enabled()
+    monkeypatch.delenv("TPQ_IO_INFLIGHT")
+    # LocalStore keeps its zero-overhead pread path: never routed
+    with open(__file__, "rb") as f:
+        assert engine_for_store(LocalStore(f)) is None
+
+
+# ---------------------------------------------------------------------------
+# the concurrency claim: hundreds in flight, one thread
+# ---------------------------------------------------------------------------
+
+def test_stall_storm_256_ranges_one_thread(tmp_path):
+    """256 ranges through a 50ms-latency store complete in ~one latency
+    (not 256 x 50ms), with the in-flight peak at the cap and exactly one
+    engine thread doing it."""
+    blob = np.random.default_rng(7).integers(
+        0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    path = tmp_path / "blob.bin"
+    path.write_bytes(blob)
+    with open(path, "rb") as f:
+        st = FaultInjectingStore(LocalStore(f), FaultSpec(latency_s=0.05),
+                                 config=_cfg())
+        eng = FetchEngine(max_inflight=256, name="tpq-fetch-test")
+        try:
+            ranges = [((i * 3571) % ((1 << 20) - 4096), 4096)
+                      for i in range(256)]
+            t0 = time.perf_counter()
+            futs = [eng.submit(st, o, s) for o, s in ranges]
+            got = [bytes(fu.result(timeout=60)) for fu in futs]
+            wall = time.perf_counter() - t0
+        finally:
+            eng.close()
+            st.close()
+    assert got == [blob[o:o + s] for o, s in ranges]
+    # serial would be 12.8s; generous 4s bound still proves overlap
+    assert wall < 4.0, f"storm took {wall:.2f}s — ranges did not overlap"
+    assert eng.stats.inflight_peak == 256
+    assert eng.stats.completed == 256 and eng.stats.failed == 0
+    assert not _engine_threads()
+
+
+def test_inflight_capped_below_submission_depth(tmp_path):
+    """A cap of 4 with 32 submissions: the gauge never passes 4, every
+    range still completes, queue-wait is accounted."""
+    path = tmp_path / "blob.bin"
+    path.write_bytes(bytes(range(256)) * 64)
+    with open(path, "rb") as f:
+        st = FaultInjectingStore(LocalStore(f), FaultSpec(latency_s=0.01),
+                                 config=_cfg())
+        eng = FetchEngine(max_inflight=4, name="tpq-fetch-test")
+        try:
+            futs = [eng.submit(st, 64 * i, 64) for i in range(32)]
+            for fu in futs:
+                fu.result(timeout=60)
+        finally:
+            eng.close()
+            st.close()
+    assert eng.stats.inflight_peak <= 4
+    assert eng.stats.completed == 32
+    assert eng.stats.queue_wait_seconds > 0  # 28 ranges waited for a slot
+
+
+# ---------------------------------------------------------------------------
+# lifecycle hygiene: close() and cancel wake every waiter, leak nothing
+# ---------------------------------------------------------------------------
+
+def test_close_settles_inflight_futures_and_leaks_nothing(tmp_path):
+    path = tmp_path / "blob.bin"
+    path.write_bytes(b"\xAB" * 4096)
+    with open(path, "rb") as f:
+        st = FaultInjectingStore(LocalStore(f), FaultSpec(latency_s=30.0),
+                                 config=_cfg(retries=0))
+        eng = FetchEngine(max_inflight=2, name="tpq-fetch-test")
+        futs = [eng.submit(st, 0, 64) for _ in range(4)]
+        time.sleep(0.05)  # let the first two enter their stall
+        t0 = time.perf_counter()
+        eng.close(timeout=10)
+        assert time.perf_counter() - t0 < 5.0
+        for fu in futs:
+            with contextlib.suppress(BaseException):
+                fu.result(timeout=5)
+            assert fu.done(), "close() left a waiter parked forever"
+        st.close()
+    assert not _engine_threads()
+    st_ = eng.stats
+    assert st_.completed + st_.failed == st_.submitted
+    assert st_.inflight == 0
+
+
+def test_cancel_wakes_inflight_fetches_promptly(tmp_path):
+    """CancelToken.cancel() from another thread lands the typed verdict in
+    well under the injected stall — the engine's cancel event interrupts
+    the await, it does not wait the fault out."""
+    path = tmp_path / "blob.bin"
+    path.write_bytes(b"\xCD" * 4096)
+    with open(path, "rb") as f:
+        st = FaultInjectingStore(LocalStore(f), FaultSpec(latency_s=30.0),
+                                 config=_cfg(retries=0))
+        tok = CancelToken()
+        scan = ScanToken(budget=RetryBudget(0), cancel=tok)
+        eng = FetchEngine(max_inflight=8, name="tpq-fetch-test")
+        try:
+            futs = [eng.submit(st, 0, 64, scan=scan) for _ in range(6)]
+            time.sleep(0.05)
+            t0 = time.perf_counter()
+            tok.cancel()
+            for fu in futs:
+                with pytest.raises(CancelledError):
+                    fu.result(timeout=10)
+            assert time.perf_counter() - t0 < 5.0
+        finally:
+            eng.close()
+            st.close()
+    assert not _engine_threads()
+    assert eng.stats.failed == 6 and eng.stats.inflight == 0
+
+
+def test_default_engine_replaced_after_shutdown(monkeypatch):
+    monkeypatch.setenv("TPQ_IO_ASYNC", "1")
+    eng = get_default_engine()
+    assert get_default_engine() is eng
+    shutdown_default_engine()
+    assert default_engine_if_running() is None
+    assert not _engine_threads()
+    eng2 = get_default_engine()
+    assert eng2 is not eng and not eng2.closed
+    shutdown_default_engine()
+
+
+# ---------------------------------------------------------------------------
+# hedging on the async path
+# ---------------------------------------------------------------------------
+
+def test_hedge_win_preserved_on_async_path():
+    """A store whose FIRST attempt per range stalls and whose duplicate
+    returns fast: with hedging on, the engine's race wins long before the
+    stall resolves, and the hedge counters say so."""
+    import asyncio
+
+    calls = {"n": 0}
+    lock = threading.Lock()
+
+    class SlowFirst(GenericRangeStore):
+        def size(self):
+            return 1 << 20
+
+        async def _fetch_once_async(self, offset, size, timeout):
+            with lock:
+                calls["n"] += 1
+                first = calls["n"] == 1
+            if first:
+                await asyncio.sleep(0.5)
+            return b"\x5A" * size
+
+    st = SlowFirst(config=_cfg(retries=0, hedge_ms=20.0, deadline_s=10.0))
+    eng = FetchEngine(max_inflight=8, name="tpq-fetch-test")
+    try:
+        t0 = time.perf_counter()
+        buf = eng.submit(st, 0, 512).result(timeout=10)
+        wall = time.perf_counter() - t0
+    finally:
+        eng.close()
+    assert bytes(buf) == b"\x5A" * 512
+    assert wall < 0.4, f"hedge never raced: {wall:.3f}s"
+    d = st.stats.as_dict()
+    assert d["hedges_issued"] >= 1 and d["hedges_won"] >= 1
+    assert st._hedges_outstanding == 0  # loser reaped
+    assert not _engine_threads()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant default deadlines (serve tier)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("fetch_serve") / "s.parquet")
+    _write_file(path, groups=3, rows=300)
+    return path
+
+
+def test_tenant_default_deadline_inherited(serve_file):
+    from tpu_parquet.serve import ScanRequest, ScanService
+
+    svc = ScanService(
+        concurrency=2, queue_depth=8,
+        store=lambda f: FaultInjectingStore(
+            LocalStore(f), FaultSpec(latency_s=0.06),
+            config=IOConfig(backoff_ms=0)))
+    try:
+        t = svc.register_tenant("batch", weight=2, deadline_s=0.05)
+        assert t.deadline_s == 0.05
+        # no explicit deadline: the tenant default binds and expires
+        with pytest.raises(DeadlineExceededError):
+            svc.scan(ScanRequest(serve_file, tenant="batch"), timeout=30)
+        # an explicit request deadline always outranks the default
+        out = svc.scan(ScanRequest(serve_file, tenant="batch",
+                                   deadline_s=60.0), timeout=60)
+        assert len(out[serve_file]["a"].values) == 900
+        # and the stats surface shows the configured default
+        sv = svc.serve_stats()
+        assert sv["tenants"]["batch"]["deadline_s"] == 0.05
+        assert "deadline_s" not in sv["tenants"]["default"]
+    finally:
+        svc.close()
+
+
+def test_tenant_deadline_from_spec_string(serve_file):
+    from tpu_parquet.serve import ScanService
+    from tpu_parquet.serve.tenancy import TenantRegistry
+
+    reg = TenantRegistry(max_memory=1 << 20, spec="gold=4:2.5,bronze=1")
+    assert reg.get("gold").deadline_s == 2.5
+    assert reg.get("gold").weight == 4
+    assert reg.get("bronze").deadline_s is None
+    with ScanService(concurrency=1, tenants="slo=2:1.5") as svc:
+        assert svc.tenants.get("slo").deadline_s == 1.5
+
+
+# ---------------------------------------------------------------------------
+# the io-concurrency-bound doctor verdict
+# ---------------------------------------------------------------------------
+
+def _io_tree(*, peak, cap, qw, fs, prefetch=4, io_s=10.0, decomp_s=1.0):
+    return {
+        "pipeline": {"io_seconds": io_s, "decompress_seconds": decomp_s,
+                     "recompress_seconds": 0.0, "stage_seconds": 0.5,
+                     "stall_seconds": 0.0, "prefetch": prefetch},
+        "reader": {},
+        "io": {"engine": {"submitted": 300, "completed": 300, "failed": 0,
+                          "inflight": 0, "inflight_peak": peak,
+                          "inflight_cap": cap, "queue_wait_seconds": qw,
+                          "fetch_seconds": fs}},
+    }
+
+
+def test_doctor_io_concurrency_pinned_at_cap_names_inflight_knob():
+    from tpu_parquet.obs import doctor_registry
+
+    rep = doctor_registry(_io_tree(peak=256, cap=256, qw=50.0, fs=12.0))
+    ioc = rep["io_concurrency"]
+    assert ioc["verdict"] == "io-concurrency-bound"
+    assert ioc["knob"] == "TPQ_IO_INFLIGHT"
+    assert "TPQ_IO_INFLIGHT" in ioc["advice"]
+    assert ioc["inflight_peak"] == 256 and ioc["inflight_cap"] == 256
+
+
+def test_doctor_io_concurrency_pinned_at_window_names_prefetch():
+    from tpu_parquet.obs import doctor_registry
+
+    rep = doctor_registry(_io_tree(peak=5, cap=256, qw=0.0, fs=12.0,
+                                   prefetch=4))
+    ioc = rep["io_concurrency"]
+    assert ioc["knob"] == "prefetch="
+    assert "prefetch" in ioc["advice"]
+
+
+def test_doctor_io_concurrency_stays_quiet_without_evidence():
+    from tpu_parquet.obs import doctor_registry
+
+    # decompress dominates: no concurrency story
+    rep = doctor_registry(_io_tree(peak=256, cap=256, qw=50.0, fs=12.0,
+                                   io_s=1.0, decomp_s=20.0))
+    assert "io_concurrency" not in rep
+    # slots pinned but fetches were the slow part, not slot queueing
+    rep = doctor_registry(_io_tree(peak=256, cap=256, qw=1.0, fs=12.0))
+    assert "io_concurrency" not in rep
+    # mid-depth peak: neither at the cap nor at the window — ambiguous
+    rep = doctor_registry(_io_tree(peak=64, cap=256, qw=50.0, fs=12.0))
+    assert "io_concurrency" not in rep
+
+
+def test_doctor_io_concurrency_renders(tmp_path):
+    import io as _io
+    import json
+
+    from tpu_parquet.cli import pq_tool
+
+    rec = {"obs_version": 1, **_io_tree(peak=256, cap=256, qw=50.0, fs=12.0)}
+    path = str(tmp_path / "run.json")
+    with open(path, "w") as f:
+        json.dump(rec, f)
+    buf = _io.StringIO()
+    rc = pq_tool.cmd_doctor(
+        type("A", (), {"file": path, "config": None})(), out=buf)
+    assert rc == 0
+    out = buf.getvalue()
+    assert "io-concurrency-bound" in out
+    assert "raise TPQ_IO_INFLIGHT" in out
+
+
+# ---------------------------------------------------------------------------
+# engine observability rides the reader's registry
+# ---------------------------------------------------------------------------
+
+def test_engine_section_in_reader_registry(pq_file, monkeypatch):
+    path, base = pq_file
+    monkeypatch.setenv("TPQ_IO_ASYNC", "1")
+    with FileReader(path, prefetch=4,
+                    store=_fault_factory(FaultSpec(latency_s=0.001))) as r:
+        assert r.read_pylist() == base
+        tree = r.obs_registry().as_dict()
+    eng = tree["io"]["engine"]
+    assert eng["submitted"] > 0 and eng["inflight_cap"] >= 1
+    assert "io.queue_wait" in tree["histograms"]

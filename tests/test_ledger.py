@@ -125,6 +125,13 @@ def test_make_record_fingerprint(monkeypatch):
     assert rec3["env"]["TPQ_SERVE_FAIR"] == "0"
     assert rec3["env"]["TPQ_SERVE_TENANTS"] == "gold=3,bronze=1"
     assert rec3["env"]["TPQ_STREAM_BUFFER_BATCHES"] == "4"
+    # the async-IO knobs ride too (ISSUE 18): an engine run at a different
+    # in-flight cap — or the threaded fallback — is a different experiment
+    monkeypatch.setenv("TPQ_IO_INFLIGHT", "64")
+    monkeypatch.setenv("TPQ_IO_ASYNC", "0")
+    rec4 = ledger.make_record(_record(c=_cfg()), ts=124.5)
+    assert rec4["env"]["TPQ_IO_INFLIGHT"] == "64"
+    assert rec4["env"]["TPQ_IO_ASYNC"] == "0"
     assert "python" in rec["env"]
     # inside this repo the short revision resolves
     rev = rec["git_rev"]

@@ -784,7 +784,11 @@ def test_tenant_env_spec(monkeypatch):
     # lenient by contract: a bare name defaults to weight 1, a malformed
     # weight clamps to 1 — a bad env var must not take the serve tier down
     assert parse_tenant_spec("a=3, b=1,junk,c=x,=9,") == {
-        "a": 3, "b": 1, "junk": 1, "c": 1}
+        "a": (3, None), "b": (1, None), "junk": (1, None), "c": (1, None)}
+    # optional :deadline_s rides the weight field; malformed or
+    # non-positive deadlines degrade to None, never raise
+    assert parse_tenant_spec("gold=4:2.5,slow=1:x,neg=2:-1") == {
+        "gold": (4, 2.5), "slow": (1, None), "neg": (2, None)}
     monkeypatch.setenv("TPQ_SERVE_TENANTS", "gold=4,bronze=1")
     reg = TenantRegistry(max_memory=6 << 20)
     assert reg.get("gold").weight == 4

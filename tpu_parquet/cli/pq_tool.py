@@ -438,6 +438,12 @@ def cmd_doctor(args, out=sys.stdout) -> int:
                   f"{hg['wasted_bytes']} wasted bytes — the hedge delay "
                   f"sits below the real p90; raise TPQ_IO_HEDGE_MS or let "
                   f"auto re-learn\n")
+    ioc = rep.get("io_concurrency")
+    if ioc:
+        out.write(f"io-concurrency-bound: in-flight peak "
+                  f"{ioc['inflight_peak']}/{ioc['inflight_cap']} cap, "
+                  f"slot queue-wait {ioc['queue_wait_seconds']:.3f}s vs "
+                  f"fetch {ioc['fetch_seconds']:.3f}s — {ioc['advice']}\n")
     wrt = rep.get("write")
     if wrt:
         wl = wrt["lanes"]
@@ -637,11 +643,13 @@ def cmd_serve_stats(args, out=sys.stdout) -> int:
             else:
                 p99 = f"{'-':>12}"
             slo_ms = t.get("slo_p99_ms")
+            ddl = t.get("deadline_s")
             out.write(f"  {name:<16}{t.get('weight', 1):>7}"
                       f"{t.get('submitted', 0):>8}{t.get('completed', 0):>7}"
                       f"{t.get('rejected', 0):>8}{shed:>6}"
                       f"{t.get('cache_held_bytes', 0):>10}{p99}"
                       + (f"  (slo {float(slo_ms):g}ms)" if slo_ms else "")
+                      + (f"  (deadline {float(ddl):g}s)" if ddl else "")
                       + "\n")
     slo = [(name.split(".", 1)[1], LatencyHistogram.from_dict(hd))
            for name, hd in sorted(hists.items())

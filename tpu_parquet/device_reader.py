@@ -3932,6 +3932,16 @@ class DeviceFileReader:
                 # network stall fires the dog and the flight dump names
                 # the in-flight range (pq_tool autopsy: network-stall)
                 self._watchdog.watch("iostore", self._store.stats.progress)
+            if getattr(self._store, "supports_async", False):
+                # async-routed stores get an engine heartbeat lane too:
+                # submissions/completions freeze when every in-flight
+                # fetch is stuck on the loop (the dog still only fires
+                # when ALL lanes freeze)
+                from .iostore_async import engine_for_store
+
+                eng = engine_for_store(self._store)
+                if eng is not None:
+                    self._watchdog.watch("fetch_engine", eng.stats.progress)
             # raise-policy exit from a stalled fetch: poisoning the store
             # wakes the worker pinned inside the transport, so the HangError
             # (not a belated transport error) reaches the consumer
@@ -4668,6 +4678,7 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None,
     """
     from .alloc import AllocTracker, InFlightBudget
     from .iostore import CoalescedFetcher
+    from .iostore_async import engine_for_store
     from .pipeline import prefetch_map
 
     budget = InFlightBudget(budget_bytes)
@@ -4680,6 +4691,25 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None,
     pending: dict[tuple, dict] = {}
     current = {"stats": None}  # stats of the reader whose item is submitting
     depth_owner = {"stats": None}  # last stats whose queue_depth gauge we set
+    feedbox = {"eng": None}  # the async fetch engine, once any store routes
+
+    class _Feed:
+        """Late-binding feed gate for prefetch_map: a multi-file work
+        stream mixes engine-routed and plain stores, and the engine only
+        becomes known when gen_items first plans a routed group — until
+        then the feed reports no lookahead appetite (plain threaded
+        behavior), after which in-flight IO is bounded by the engine cap
+        instead of the decode window."""
+
+        @property
+        def max_inflight(self):
+            eng = feedbox["eng"]
+            return eng.max_inflight if eng is not None else 0
+
+        @staticmethod
+        def want_more():
+            eng = feedbox["eng"]
+            return eng is not None and eng.want_more()
 
     class _StatsFwd:
         """Route prefetch_map's stall/peak accounting to the owning reader.
@@ -4762,11 +4792,18 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None,
             # fetches it) — only for stores that ask for it
             st = sr.store
             tok = sr._scan
-            if (st.prefers_coalescing
-                    and not (tok.coalesce_disabled if tok is not None
-                             else st.coalesce_disabled)
-                    and len(ranges) > 1):
-                fetcher = CoalescedFetcher(st, ranges, scan=tok)
+            eng = engine_for_store(st)
+            if eng is not None:
+                feedbox["eng"] = eng
+            use_coalesce = (st.prefers_coalescing
+                            and not (tok.coalesce_disabled if tok is not None
+                                     else st.coalesce_disabled)
+                            and len(ranges) > 1)
+            if ranges and (use_coalesce or eng is not None):
+                # engine mode submits the group's fetches NOW (merged
+                # spans, or singles once the ladder disables merging)
+                fetcher = CoalescedFetcher(st, ranges, scan=tok, engine=eng,
+                                           coalesce=use_coalesce)
                 for it in items:
                     if it[8] is None:
                         it[9] = fetcher
@@ -4838,7 +4875,7 @@ def _chunk_feed(work, prefetch: int, budget_bytes: int = 0, watchdog=None,
         for key, p, payload in prefetch_map(gen_items(), collect, prefetch,
                                             budget=budget, cost=cost,
                                             stats=_StatsFwd(),
-                                            cancel=cancel):
+                                            cancel=cancel, feed=_Feed()):
             slot = pending[key]
             if p is not None:
                 slot["chunks"][p] = payload

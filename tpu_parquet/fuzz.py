@@ -1394,6 +1394,144 @@ def crafted_stream_cursor_blobs() -> "list[bytes]":
     ]
 
 
+def fuzz_fetch_engine(data: bytes) -> None:
+    """Async fetch-engine op-stream interpreter (iostore_async.py): the
+    blob picks the in-flight cap, hedge/fault plan, and an op stream of
+    submits / collects / a cancel against a tiny in-memory store.
+    Whatever the stream does, the engine's ledger must hold: the in-flight
+    gauge never exceeds the cap even transiently, submitted reconciles
+    with completed+failed once every future resolves, hedge losers are
+    always reaped, cancellation wakes every waiter with a typed verdict,
+    and ``close()`` leaves no engine thread behind.  Successful reads must
+    return the store's true bytes; failures must be the typed iostore
+    verdicts — anything else is a finding."""
+    import threading as _threading
+
+    from .errors import (
+        CancelledError, DeadlineExceededError, RetryExhaustedError,
+        TransientIOError,
+    )
+    from .iostore import GenericRangeStore, IOConfig, RetryBudget, ScanToken
+    from .iostore_async import FetchEngine
+    from .resilience import CancelToken
+
+    if len(data) < 2:
+        return
+    cap = 1 + data[0] % 8
+    flags = data[1]
+    file_size = 4096
+    plan = list(data[2:26])  # per-attempt fault codes, popped in order
+    ops = data[26:74]
+    lock = _threading.Lock()
+
+    class _Store(GenericRangeStore):
+        def size(self):
+            return file_size
+
+        async def _fetch_once_async(self, offset, size, timeout):
+            import asyncio as _asyncio
+
+            with lock:
+                code = (plan.pop(0) % 8) if plan else 0
+            if code == 5:
+                raise TransientIOError(f"injected fault (code {code})")
+            if code == 6:
+                await _asyncio.sleep(0.002)  # slow leg: hedge bait
+            n = max(min(size, file_size - offset), 0)
+            true = bytes((offset + j) % 251 for j in range(n))
+            if code == 7 and n > 1:
+                return true[: n // 2]  # torn prefix (verified re-read)
+            return true
+
+    store = _Store(config=IOConfig(
+        retries=3, backoff_ms=0.05, retry_budget=0,
+        hedge_ms=(1.0 if flags & 1 else 0.0), deadline_s=10.0))
+    cancel = CancelToken()
+    scan = ScanToken(budget=RetryBudget(6 if flags & 2 else 0),
+                     cancel=cancel)
+    eng = FetchEngine(max_inflight=cap, name="tpq-fetch-fuzz")
+    outstanding: "list[tuple]" = []
+
+    def collect(fut, off, sz):
+        try:
+            buf = fut.result(timeout=10.0)
+        except (RetryExhaustedError, TransientIOError, CancelledError,
+                DeadlineExceededError):
+            return
+        n = max(min(sz, file_size - off), 0)
+        if bytes(buf) != bytes((off + j) % 251 for j in range(n)):
+            raise AssertionError(
+                f"engine corrupted range [{off}, {off + sz})")
+
+    cancelled = False
+    try:
+        for b in ops:
+            op, arg = b >> 5, b & 31
+            if op == 6:
+                if outstanding:
+                    collect(*outstanding.pop(0))
+                continue
+            if op == 7:
+                cancel.cancel()
+                cancelled = True
+                continue
+            off = (arg * 173) % (file_size + 64)  # may cross or pass EOF
+            sz = 1 + (b * 37) % 200
+            fut = eng.submit(store, off, sz, scan=scan)
+            if eng.stats.inflight > cap:
+                raise AssertionError(
+                    f"in-flight gauge {eng.stats.inflight} exceeded the "
+                    f"cap {cap}")
+            outstanding.append((fut, off, sz))
+        while outstanding:
+            collect(*outstanding.pop(0))
+    finally:
+        eng.close()
+    st = eng.stats
+    if st.inflight != 0:
+        raise AssertionError(f"in-flight gauge leaked: {st.inflight}")
+    if st.inflight_peak > cap:
+        raise AssertionError(
+            f"in-flight peak {st.inflight_peak} exceeded the cap {cap}")
+    if st.completed + st.failed != st.submitted:
+        raise AssertionError(
+            f"ledger does not reconcile: {st.submitted} submitted != "
+            f"{st.completed} completed + {st.failed} failed"
+            f" (cancelled={cancelled})")
+    if store._hedges_outstanding != 0:
+        raise AssertionError(
+            f"{store._hedges_outstanding} hedge loser(s) never reaped")
+    for t in _threading.enumerate():
+        if t.name.startswith("tpq-fetch-fuzz"):
+            raise AssertionError("engine thread leaked after close()")
+
+
+def crafted_fetch_engine_blobs() -> "list[bytes]":
+    """Hand-crafted ``fetch_engine`` inputs (and corpus blobs): a deep
+    clean burst through a cap-1 engine (every submit queues for the one
+    slot), a fault-heavy hedged plan (transient + slow + torn legs racing
+    duplicates), a cancel dropped mid-burst with waiters parked on slots,
+    a retry-budget-capped scan under pure transient pressure, and an
+    interleaved submit/collect stream across EOF."""
+    SUB, COLLECT, CANCEL = 0 << 5, 6 << 5, 7 << 5
+
+    def blob(cap_byte, flags, plan, ops):
+        return (bytes([cap_byte, flags])
+                + bytes(plan[:24]).ljust(24, b"\x00") + bytes(ops))
+
+    deep = blob(0, 0, [], [SUB | (i % 32) for i in range(32)])
+    hedged = blob(7, 1, [6, 5, 7, 6, 6, 5, 7, 6] * 3,
+                  [SUB | (i % 32) for i in range(16)])
+    cancel_mid = blob(0, 0, [6] * 8,
+                      [SUB | (i % 32) for i in range(8)] + [CANCEL]
+                      + [SUB | 3, SUB | 9] + [COLLECT] * 10)
+    budget = blob(3, 2, [5] * 24, [SUB | (i % 32) for i in range(8)])
+    interleave = blob(2, 3, [5, 6, 7, 0, 5, 6],
+                      [SUB | 31, SUB | 30, COLLECT, SUB | 1, COLLECT,
+                       SUB | 29, COLLECT, COLLECT, COLLECT])
+    return [deep, hedged, cancel_mid, budget, interleave]
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -1416,6 +1554,7 @@ TARGETS = {
     "result_cache": fuzz_result_cache,
     "footer_merge": fuzz_footer_merge,
     "stream_cursor": fuzz_stream_cursor,
+    "fetch_engine": fuzz_fetch_engine,
 }
 
 
@@ -1625,6 +1764,8 @@ def _seed_inputs(target: str) -> list[bytes]:
         return crafted_footer_merge_blobs()
     if target == "stream_cursor":
         return crafted_stream_cursor_blobs()
+    if target == "fetch_engine":
+        return crafted_fetch_engine_blobs()
     if target == "loader_state":
         from .data import checkpoint as ck
 

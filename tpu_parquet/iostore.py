@@ -41,6 +41,7 @@ verdict ``network-stall``).
 
 from __future__ import annotations
 
+import asyncio
 import os
 import random
 import threading
@@ -321,6 +322,12 @@ class ByteStore:
     parallel = True
     prefers_coalescing = False
     coalesce_gap = 0
+    # capability flag for the async fetch engine (iostore_async.FetchEngine):
+    # True when the store carries the non-blocking attempt primitive
+    # (GenericRangeStore._fetch_once_async), so a scan can put hundreds of
+    # ranges in flight on ONE event-loop thread.  LocalStore stays False —
+    # its os.pread path is zero-overhead and never routes through the engine.
+    supports_async = False
     stats: "IOStats | None" = None
     # object-identity token for read-through caches (serve.PlanCache):
     # a stable name + generation marker for the REMOTE object this store
@@ -542,6 +549,25 @@ class GenericRangeStore(ByteStore):
         request's deadline (None = unbounded); implementations honor it as
         well as their transport allows."""
         raise NotImplementedError
+
+    async def _fetch_once_async(self, offset: int, size: int,
+                                timeout: "float | None") -> bytes:
+        """The non-blocking twin of :meth:`_fetch_once`: one attempt as a
+        coroutine on the fetch engine's event loop — waits (latency,
+        stalls, socket reads in a real adapter) must be ``await``\\ ed, not
+        slept, so hundreds of attempts overlap on one thread.  A subclass
+        providing this flips :attr:`supports_async` and becomes eligible
+        for :class:`tpu_parquet.iostore_async.FetchEngine` routing; the
+        retry/hedge discipline around it lives engine-side
+        (``FetchEngine._read_range_async``) and mirrors :meth:`read_range`
+        bit-for-bit."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no async fetch primitive")
+
+    @property
+    def supports_async(self) -> bool:  # type: ignore[override]
+        return (type(self)._fetch_once_async
+                is not GenericRangeStore._fetch_once_async)
 
     # -- scan lifecycle -------------------------------------------------------
 
@@ -972,6 +998,59 @@ class FaultInjectingStore(GenericRangeStore):
                                 spec.corrupt_seed ^ offset)
         return buf
 
+    async def _fetch_once_async(self, offset: int, size: int,
+                                timeout: "float | None") -> bytes:
+        """The async twin of :meth:`_fetch_once`, decision-for-decision:
+        the SAME per-offset attempt counter and the same ``_spec_for``
+        hook (so a :class:`~tpu_parquet.resilience.ChaosSchedule` drives
+        the async path unchanged), with every injected wait ``await``\\ ed
+        instead of slept — an injected 50 ms latency on 256 ranges costs
+        ~50 ms wall, not 256 thread-slots.  The inner read itself stays a
+        blocking call on the loop (it is a local fd / memory buffer in
+        every test topology; a real network adapter awaits its socket)."""
+        if (self.spec.match is not None
+                and not self.spec.match(offset, size)):
+            return self.inner.read_range(offset, size)
+        with self._attempts_lock:
+            n = self._attempts.get(offset, 0)
+            self._attempts[offset] = n + 1
+        spec = self._spec_for(offset, size, n)
+        if spec.latency_s > 0:
+            wait = spec.latency_s
+            if timeout is not None and wait > timeout:
+                await asyncio.sleep(max(timeout, 0.0))
+                raise TransientIOError(
+                    f"injected latency {spec.latency_s:g}s exceeded the "
+                    f"deadline for range [{offset}, {offset + size})")
+            await asyncio.sleep(wait)
+        if n < spec.stall_first:
+            deadline = time.monotonic() + (spec.stall_s if timeout is None
+                                           else min(spec.stall_s, timeout))
+            # sliced wait: wakes promptly on release() AND on a watchdog
+            # abort; the events are threading primitives set off-loop, so
+            # poll them (the engine's cancel race bounds a cancelled scan)
+            while not self._unstall.is_set() and self._abort_exc is None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                await asyncio.sleep(min(left, 0.05))
+            raise TransientIOError(
+                f"injected stall at range [{offset}, {offset + size}) "
+                f"(attempt {n})")
+        if n < spec.fail_first:
+            raise TransientIOError(
+                f"injected transient error #{n} at range "
+                f"[{offset}, {offset + size})")
+        buf = self.inner.read_range(offset, size)
+        if n < spec.fail_first + spec.torn_first and len(buf) > 1:
+            return buf[: max(len(buf) // 2, 1)]
+        if spec.corrupt is not None:
+            from .quarantine import corrupt_bytes
+
+            buf = corrupt_bytes(bytes(buf), spec.corrupt,
+                                spec.corrupt_seed ^ offset)
+        return buf
+
 
 # ---------------------------------------------------------------------------
 # range coalescing
@@ -1045,32 +1124,79 @@ class CoalescedFetcher:
     marks the group degraded, and its members fall back to individual
     single-range reads; repeated span failures disable coalescing for the
     rest of the scan (``GenericRangeStore.note_coalesce_failure``).
+
+    **Engine mode** (``engine=`` a :class:`tpu_parquet.iostore_async
+    .FetchEngine`): construction SUBMITS every planned fetch — merged
+    spans and lone ranges alike — so a whole row group's IO is in flight
+    the moment the pipeline pulls its first item; ``read`` then merely
+    awaits the matching future.  ``coalesce=False`` (the ladder said stop
+    merging) keeps engine mode but submits single ranges only.  The
+    failure ladder is unchanged: a failed span future degrades the group
+    to per-member engine fetches.
     """
 
     def __init__(self, store: ByteStore, ranges,
                  gap: "int | None" = None,
                  max_span: int = MAX_COALESCED_SPAN,
-                 scan: "ScanToken | None" = None):
+                 scan: "ScanToken | None" = None,
+                 engine=None, coalesce: bool = True):
         self.store = store
         self.scan = scan  # the owning scan's token: budget + ladder scope
-        g = store.coalesce_gap if gap is None else gap
+        self._engine = engine
+        g = (store.coalesce_gap if gap is None else gap) if coalesce else 0
         self._by_member: dict[tuple, _Group] = {}
+        # engine mode: futures submitted up front — one per merged span
+        # (keyed by group identity) and a queue per lone (offset, size)
+        # (a deque, because the same range can be requested twice)
+        self._span_futs: dict[int, object] = {}
+        self._single_futs: dict[tuple, list] = {}
         for grp in plan_coalesced(ranges, g, max_span):
             if len(grp.members) <= 1:
-                continue  # lone range: a merged fetch buys nothing
+                # lone range: a merged fetch buys nothing — but the engine
+                # still wants it in flight NOW, not when decode reaches it
+                if engine is not None:
+                    for (o, s), cnt in grp.members.items():
+                        futs = self._single_futs.setdefault((o, s), [])
+                        for _ in range(cnt):
+                            futs.append(engine.submit(store, o, s,
+                                                      scan=scan))
+                continue
             for m in grp.members:
                 self._by_member[m] = grp
+            if engine is not None:
+                self._span_futs[id(grp)] = engine.submit(
+                    store, grp.offset, grp.size, scan=scan)
         self.groups = len({id(g) for g in self._by_member.values()})
+
+    def _fetch_single(self, offset: int, size: int) -> bytes:
+        """One single-range read on whichever path this fetcher rides:
+        a pre-submitted engine future when one is queued for this range,
+        a fresh engine submission otherwise, or the plain blocking read."""
+        if self._engine is not None:
+            futs = self._single_futs.get((offset, size))
+            if futs:
+                return futs.pop(0).result()
+            return self._engine.submit(self.store, offset, size,
+                                       scan=self.scan).result()
+        return self.store.read_range(offset, size, scan=self.scan)
 
     def read(self, offset: int, size: int) -> bytes:
         grp = self._by_member.get((offset, size))
         if grp is None:
-            return self.store.read_range(offset, size, scan=self.scan)
+            return self._fetch_single(offset, size)
         with grp.lock:
             if grp.buf is None and not grp.degraded:
                 try:
-                    buf = self.store.read_range(grp.offset, grp.size,
-                                                scan=self.scan)
+                    fut = self._span_futs.pop(id(grp), None)
+                    if fut is not None:
+                        buf = fut.result()
+                    elif self._engine is not None:
+                        buf = self._engine.submit(
+                            self.store, grp.offset, grp.size,
+                            scan=self.scan).result()
+                    else:
+                        buf = self.store.read_range(grp.offset, grp.size,
+                                                    scan=self.scan)
                     if len(buf) != grp.size:
                         # short span: EOF mid-group or a lying store —
                         # per-member reads diagnose precisely
@@ -1099,7 +1225,7 @@ class CoalescedFetcher:
         # degraded: individual single-range fetch (outside the group lock,
         # so members recover in parallel); its own retries still apply, and
         # ITS failure is the ladder's final rung — the error propagates
-        return self.store.read_range(offset, size, scan=self.scan)
+        return self._fetch_single(offset, size)
 
 
 # ---------------------------------------------------------------------------

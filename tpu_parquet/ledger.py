@@ -62,7 +62,8 @@ _ENV_KEYS = (
     "TPQ_SERVE_BROWNOUT", "TPQ_IO_HEDGE_MS",
     "TPQ_SERVE_FAIR", "TPQ_SERVE_TENANTS", "TPQ_STREAM_BUFFER_BATCHES",
     "TPQ_WRITE_CRC", "TPQ_WRITE_WORKERS",
-    "TPQ_IO_HEDGE_MAX", "TPQ_CIRCUIT_FAILS", "TPQ_CIRCUIT_WINDOW_S",
+    "TPQ_IO_HEDGE_MAX", "TPQ_IO_INFLIGHT", "TPQ_IO_ASYNC",
+    "TPQ_CIRCUIT_FAILS", "TPQ_CIRCUIT_WINDOW_S",
     "TPQ_CIRCUIT_COOLDOWN_S", "BENCH_SCALE", "BENCH_DEVICE_REPS",
     "BENCH_BASELINE_REPS", "BENCH_RESAMPLE", "BENCH_CONFIGS",
     "JAX_PLATFORMS",

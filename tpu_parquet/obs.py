@@ -1695,6 +1695,17 @@ HEDGE_VERDICT_MIN_WIN_RATE = 0.2
 # work it immediately throws away
 CACHE_THRASH_MIN_EVICTIONS = 8
 CACHE_THRASH_MAX_HIT_RATE = 0.5
+# io-concurrency advisory thresholds (the async fetch engine's
+# ``io.engine`` subtree): with the io lane dominant, ranges spending at
+# least IO_CONC_QUEUE_WAIT_RATIO× as long waiting for an in-flight slot
+# as actually fetching means concurrency — not the store — is the
+# bottleneck.  A peak within IO_CONC_PIN_FRACTION of the engine cap names
+# TPQ_IO_INFLIGHT; a peak pinned at the decode window instead names
+# ``prefetch=`` (the feed could not submit deeper than decode allowed).
+# Fewer than IO_CONC_MIN_FETCHES finished fetches is noise, not evidence.
+IO_CONC_MIN_FETCHES = 16
+IO_CONC_PIN_FRACTION = 0.9
+IO_CONC_QUEUE_WAIT_RATIO = 2.0
 # overload advisory threshold: fewer rejects+sheds than this is routine
 # backpressure noise, not a verdict.  At or above it doctor names the
 # tenant with the largest demand (submitted + rejected) as the offender
@@ -1956,6 +1967,52 @@ def doctor_registry(tree: dict) -> "dict | None":
                 "win_rate": round(win_rate, 3),
                 "wasted_bytes": int(wasted),
             }
+    eng = io_sec.get("engine")
+    eng = eng if isinstance(eng, dict) else {}
+    eng_done = g(eng, "completed") + g(eng, "failed")
+    if eng_done >= IO_CONC_MIN_FETCHES:
+        cap = g(eng, "inflight_cap")
+        peak = g(eng, "inflight_peak")
+        qw = g(eng, "queue_wait_seconds")
+        fs = g(eng, "fetch_seconds")
+        io_lane = g(pipe, "io_seconds")
+        # the io lane must actually dominate the decode-side lanes: a run
+        # bottlenecked on decompress or staging has no concurrency story
+        io_dominant = (io_lane > 0
+                       and io_lane >= (g(pipe, "decompress_seconds")
+                                       + g(pipe, "recompress_seconds"))
+                       and io_lane >= g(pipe, "stage_seconds"))
+        pf = int(g(pipe, "prefetch"))
+        if io_dominant and cap > 0:
+            knob = None
+            if (peak >= IO_CONC_PIN_FRACTION * cap and qw > 0
+                    and qw >= IO_CONC_QUEUE_WAIT_RATIO * fs):
+                # every slot stayed occupied and ranges queued for slots
+                # far longer than they fetched: the engine cap is the wall
+                knob = "TPQ_IO_INFLIGHT"
+            elif (pf > 0 and peak <= pf + 1
+                  and peak < IO_CONC_PIN_FRACTION * cap and fs > 0):
+                # slots were free (no slot queueing to speak of) but the
+                # feed never got deeper than the decode window: in-flight
+                # depth is prefetch-limited, not engine-limited
+                knob = "prefetch="
+            if knob is not None:
+                out["io_concurrency"] = {
+                    "verdict": "io-concurrency-bound",
+                    "inflight_peak": int(peak),
+                    "inflight_cap": int(cap),
+                    "queue_wait_seconds": round(qw, 6),
+                    "fetch_seconds": round(fs, 6),
+                    "knob": knob,
+                    "advice": (
+                        f"in-flight peak {int(peak)} pinned at the engine "
+                        f"cap {int(cap)} with {qw:.3f}s of slot queueing vs "
+                        f"{fs:.3f}s fetching: raise TPQ_IO_INFLIGHT"
+                        if knob == "TPQ_IO_INFLIGHT" else
+                        f"in-flight peak {int(peak)} never left the "
+                        f"prefetch={pf} decode window (engine cap "
+                        f"{int(cap)} idle): raise prefetch="),
+                }
     fb = reader.get("ship_feedback")
     routes = (fb or {}).get("routes") or {}
     if routes:

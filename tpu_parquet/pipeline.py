@@ -323,6 +323,7 @@ def prefetch_map(
     cost: Optional[Callable[[T], int]] = None,
     stats: Optional[PipelineStats] = None,
     cancel=None,
+    feed=None,
 ) -> Iterator[R]:
     """Ordered overlapped map: run ``fn`` over ``items`` on a bounded pool.
 
@@ -344,6 +345,17 @@ def prefetch_map(
     cancelled or deadline-expired request stops issuing new work, raises
     its TYPED verdict at the consumer, and still runs the full cleanup
     path (window drained, budget released, pool joined: nothing orphaned).
+
+    ``feed`` (a :class:`tpu_parquet.iostore_async.FetchEngine`, or any
+    object with ``want_more()``/``max_inflight``) decouples IO depth from
+    decode depth: pulling an item is what SUBMITS its IO (the engine-mode
+    :class:`~tpu_parquet.iostore.CoalescedFetcher` puts its ranges in
+    flight at construction), so while the engine reports free fetch slots,
+    items are pulled ahead of the ``prefetch``-deep decode window into a
+    ready queue — ``prefetch=K`` bounds DECODE parallelism, in-flight IO
+    is bounded by ``TPQ_IO_INFLIGHT`` and the memory budget (ahead-pulls
+    charge ``budget`` non-blocking and stop at the first refusal, so
+    backpressure still holds).
 
     ``prefetch <= 0`` degrades to a plain sequential map with zero threads —
     the bit-identical baseline the tests compare against.
@@ -368,6 +380,8 @@ def prefetch_map(
 
     it = iter(items)
     pending: deque = deque()  # (future, charged_cost)
+    ready: deque = deque()    # (item, cost): charged + IO submitted, awaiting
+    #                           a decode slot (only the feed pulls ahead here)
     carried: Optional[tuple] = None  # (item, cost) awaiting budget headroom
     # the WINDOW is prefetch items deep, but the pool never exceeds the
     # machine's cores: chunk decode is a numpy/ctypes mix that still holds
@@ -379,37 +393,57 @@ def prefetch_map(
                             thread_name_prefix="tpq-prefetch")
     try:
         exhausted = False
+
+        def pull(block_ok: bool) -> bool:
+            # move ONE item generator → (budget charge) → ready queue;
+            # False when the generator is dry or the budget said not now.
+            # A blocking budget wait is allowed only with nothing in
+            # flight (block_ok + empty window) — the no-deadlock contract
+            nonlocal carried, exhausted
+            if carried is None:
+                try:
+                    item = next(it)
+                except StopIteration:
+                    exhausted = True
+                    return False
+                carried = (item, int(cost(item)) if cost is not None else 0)
+            item, c = carried
+            if budget is not None and c:
+                if not budget.try_acquire(c):
+                    if not block_ok or pending:
+                        return False  # drain the head; its release frees room
+                    t0 = time.perf_counter()
+                    budget.acquire(c, cancel=cancel)
+                    if stats is not None:
+                        stats.add_stall(time.perf_counter() - t0, t0)
+                if stats is not None:
+                    stats.note_peak(budget)
+            carried = None
+            ready.append((item, c))
+            return True
+
         while True:
             if cancel is not None:
                 # the unit-boundary gate: stop issuing new IO the moment
                 # the request is cancelled/expired; the finally below still
                 # drains the window and releases every charged byte
                 cancel.check()
-            while not exhausted and len(pending) < prefetch:
-                if carried is None:
-                    try:
-                        item = next(it)
-                    except StopIteration:
-                        exhausted = True
-                        break
-                    carried = (item, int(cost(item)) if cost is not None else 0)
-                item, c = carried
-                if budget is not None and c:
-                    if not budget.try_acquire(c):
-                        if pending:
-                            break  # drain the head; its release frees room
-                        t0 = time.perf_counter()
-                        budget.acquire(c, cancel=cancel)
-                        if stats is not None:
-                            stats.add_stall(time.perf_counter() - t0, t0)
-                    if stats is not None:
-                        stats.note_peak(budget)
-                carried = None
+            while len(pending) < prefetch:
+                if not ready and (exhausted or not pull(block_ok=True)):
+                    break
+                item, c = ready.popleft()
                 pending.append((ex.submit(run, item), c))
                 if stats is not None:
                     stats.set_queue_depth(len(pending))
+            if feed is not None:
+                # the engine-backed lookahead: pulling submits IO, so keep
+                # pulling while the engine has free fetch slots (and the
+                # ready backlog stays bounded); never block on the budget
+                while (not exhausted and len(ready) < feed.max_inflight
+                       and feed.want_more() and pull(block_ok=False)):
+                    pass
             if not pending:
-                if carried is None:
+                if exhausted and carried is None and not ready:
                     break
                 continue  # budget-carried item with empty window: block-acquire
             fut, c = pending.popleft()
@@ -432,3 +466,7 @@ def prefetch_map(
                 budget.release(c)
             if not fut.cancelled():
                 fut.exception()  # retrieve, so failures aren't warned as lost
+        for _item, c in ready:
+            # ahead-pulled items never reached the pool: refund their charge
+            if budget is not None and c:
+                budget.release(c)

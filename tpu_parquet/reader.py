@@ -23,6 +23,7 @@ from .errors import DataIntegrityError
 from .footer import ParquetError, read_file_metadata
 from .format import FileMetaData, Type
 from .iostore import CoalescedFetcher, require_full, resolve_store
+from .iostore_async import engine_for_store
 from .pipeline import PipelineStats, SharedReader, prefetch_map
 from .schema.core import Schema, SchemaNode
 
@@ -250,6 +251,12 @@ class FileReader:
         reg.note_alloc_peak(self.alloc)
         if self._store.stats is not None:
             reg.add_io(self._store.stats)
+        if getattr(self._store, "supports_async", False):
+            # the io.engine subtree + io.queue_wait histogram (the doctor's
+            # io-concurrency-bound evidence); no-op when no engine ran
+            from .iostore_async import fold_engine_stats
+
+            fold_engine_stats(reg)
         if len(self.quarantine.log) or self.quarantine.units_skipped:
             reg.add_data_errors(self.quarantine)
         return reg
@@ -325,6 +332,11 @@ class FileReader:
         # read_range
         scan_tok = store.begin_scan(cancel=self._cancel)
         sr.set_scan(scan_tok)
+        # async fetch engine routing (iostore_async): eligible stores put
+        # a whole row group's ranges in flight on the engine's event loop
+        # — prefetch=k keeps bounding DECODE parallelism, in-flight IO is
+        # bounded by TPQ_IO_INFLIGHT; None = the threaded/pread path
+        eng = engine_for_store(store)
         q = self.quarantine
         contain = contain and q.contains
         if contain:
@@ -352,17 +364,33 @@ class FileReader:
                 # retryable fetches — only for stores that ask for it
                 # (remote/fault-injecting; the local path pays nothing,
                 # not even the range collection below)
-                if (store.prefers_coalescing
-                        and not (scan_tok.coalesce_disabled
-                                 if scan_tok is not None
-                                 else store.coalesce_disabled)
-                        and len(items) > 1):
+                use_coalesce = (store.prefers_coalescing
+                                and not (scan_tok.coalesce_disabled
+                                         if scan_tok is not None
+                                         else store.coalesce_disabled)
+                                and len(items) > 1)
+                fetch_items = items
+                if eng is not None and rc is not None and items:
+                    # engine mode submits IO at PLAN time, so the result
+                    # cache must be probed here, not at decode time: a
+                    # warm unit's bytes are never fetched (the zero-store-
+                    # read warm-scan contract).  Evicted-between-probe-and-
+                    # decode units fall back to a plain single-range read.
+                    fetch_items = [
+                        c for c in items
+                        if not rc.has_group(c[0], [".".join(c[1])])]
+                if fetch_items and (use_coalesce or eng is not None):
                     ranges = []
-                    for it in items:
+                    for it in fetch_items:
                         _md, offset = validate_chunk_meta(it[2], it[3])
                         ranges.append((offset, _md.total_compressed_size))
-                    fetcher = CoalescedFetcher(store, ranges, scan=scan_tok)
-                    for it in items:
+                    # engine mode submits the group's fetches NOW (merged
+                    # spans, or single ranges once the ladder disables
+                    # merging) — decode catches up through the futures
+                    fetcher = CoalescedFetcher(store, ranges, scan=scan_tok,
+                                               engine=eng,
+                                               coalesce=use_coalesce)
+                    for it in fetch_items:
                         it[4] = fetcher
                 pending[i] = {
                     "expect": {".".join(p) for p in by_path},
@@ -434,7 +462,8 @@ class FileReader:
         stats.touch_wall()
         for i, name, cd in prefetch_map(gen_items(), decode_item, k,
                                         budget=budget, cost=chunk_cost,
-                                        stats=stats, cancel=self._cancel):
+                                        stats=stats, cancel=self._cancel,
+                                        feed=eng):
             slot = pending[i]
             if name is not None:
                 if isinstance(cd, _ChunkFailed):

@@ -394,12 +394,16 @@ class ScanService:
 
     def register_tenant(self, name: str, weight: int = 1,
                         slo_p99_ms: "float | None" = None,
-                        cache_fraction: "float | None" = None):
+                        cache_fraction: "float | None" = None,
+                        deadline_s: "float | None" = None):
         """Configure a tenant's QoS: fair-share ``weight``, optional SLO
-        target (the ``serve.tenants`` subtree and doctor read it), and an
-        optional fraction of the result cache its inserts may hold."""
+        target (the ``serve.tenants`` subtree and doctor read it), an
+        optional fraction of the result cache its inserts may hold, and an
+        optional default request deadline (inherited by requests that set
+        no ``deadline_s`` of their own)."""
         t = self.tenants.register(name, weight=weight, slo_p99_ms=slo_p99_ms,
-                                  cache_fraction=cache_fraction)
+                                  cache_fraction=cache_fraction,
+                                  deadline_s=deadline_s)
         self.cache.results.set_tenant_share(name, cache_fraction)
         return t
 
@@ -451,9 +455,13 @@ class ScanService:
         worker picks it up; a resume ``cursor`` is validated HERE,
         synchronously, so a mismatched blob fails the caller typed and
         immediately rather than mid-stream."""
-        ticket = ScanTicket(next(_req_ids),
-                            CancelToken.with_timeout(request.deadline_s))
         tenant = self.tenants.get(request.tenant)
+        # an explicit request deadline always wins; otherwise the tenant's
+        # registered default applies (None -> no deadline, as before)
+        deadline = (request.deadline_s if request.deadline_s is not None
+                    else tenant.deadline_s)
+        ticket = ScanTicket(next(_req_ids),
+                            CancelToken.with_timeout(deadline))
         self._maybe_shed(request, tenant)
         session = None
         if request.stream:
@@ -906,4 +914,8 @@ class ScanService:
         for st in list(self._served_stores):
             if st.stats is not None:
                 reg.add_io(st.stats)
+        # async fetch-engine counters (in-flight gauge, queue-wait) for
+        # requests whose stores routed through the shared engine
+        from ..iostore_async import fold_engine_stats
+        fold_engine_stats(reg)
         return reg

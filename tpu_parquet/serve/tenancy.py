@@ -55,12 +55,14 @@ def fair_enabled(flag: "bool | None" = None) -> bool:
     return os.environ.get("TPQ_SERVE_FAIR", "1") != "0"
 
 
-def parse_tenant_spec(spec: "str | None") -> "dict[str, int]":
-    """Parse ``TPQ_SERVE_TENANTS``: ``"name=weight,name2=weight2"``
-    (weight optional, defaults 1, floored at 1).  Malformed entries are
+def parse_tenant_spec(spec: "str | None") -> "dict[str, tuple]":
+    """Parse ``TPQ_SERVE_TENANTS``: ``"name=weight:deadline_s,..."``
+    (weight optional, defaults 1, floored at 1; ``:deadline_s`` optional —
+    a per-tenant default request deadline in seconds).  Returns
+    ``{name: (weight, deadline_s_or_None)}``.  Malformed entries are
     ignored rather than raised — a bad env var must not take the serve
     tier down at import time."""
-    out: "dict[str, int]" = {}
+    out: "dict[str, tuple]" = {}
     if not spec:
         return out
     for part in str(spec).split(","):
@@ -71,11 +73,18 @@ def parse_tenant_spec(spec: "str | None") -> "dict[str, int]":
         name = name.strip()
         if not name:
             continue
+        w, _, d = w.partition(":")
         try:
             weight = max(int(w), 1) if w.strip() else 1
         except ValueError:
             weight = 1
-        out[name] = weight
+        try:
+            deadline = float(d) if d.strip() else None
+            if deadline is not None and deadline <= 0:
+                deadline = None
+        except ValueError:
+            deadline = None
+        out[name] = (weight, deadline)
     return out
 
 
@@ -89,14 +98,18 @@ class Tenant:
                  "submitted", "completed", "rejected", "failed",
                  "shed_low", "shed_normal", "queue_wait_seconds",
                  "exec_seconds", "rows", "stream_batches",
-                 "cache_fraction")
+                 "cache_fraction", "deadline_s")
 
     def __init__(self, name: str, weight: int = 1,
                  slo_p99_ms: "float | None" = None,
-                 cache_fraction: "float | None" = None):
+                 cache_fraction: "float | None" = None,
+                 deadline_s: "float | None" = None):
         self.name = str(name)
         self.weight = max(int(weight), 1)
         self.slo_p99_ms = None if slo_p99_ms is None else float(slo_p99_ms)
+        # default request deadline: requests that name no deadline_s of
+        # their own inherit this (an explicit request value always wins)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
         # this tenant's slice of the service budget; max_bytes is set by
         # TenantRegistry._rebalance (0 until the service sizes it)
         self.budget = InFlightBudget(0)
@@ -136,6 +149,8 @@ class Tenant:
             }
             if self.slo_p99_ms is not None:
                 out["slo_p99_ms"] = self.slo_p99_ms
+            if self.deadline_s is not None:
+                out["deadline_s"] = self.deadline_s
             return out
 
 
@@ -154,8 +169,9 @@ class TenantRegistry:
         self._tenants: "dict[str, Tenant]" = {}
         if spec is None:
             spec = os.environ.get("TPQ_SERVE_TENANTS")
-        for name, weight in parse_tenant_spec(spec).items():
-            self._tenants[name] = Tenant(name, weight=weight)
+        for name, (weight, deadline) in parse_tenant_spec(spec).items():
+            self._tenants[name] = Tenant(name, weight=weight,
+                                         deadline_s=deadline)
         if DEFAULT_TENANT not in self._tenants:
             self._tenants[DEFAULT_TENANT] = Tenant(DEFAULT_TENANT)
         self._rebalance_locked()
@@ -174,20 +190,23 @@ class TenantRegistry:
 
     def register(self, name: str, weight: int = 1,
                  slo_p99_ms: "float | None" = None,
-                 cache_fraction: "float | None" = None) -> Tenant:
+                 cache_fraction: "float | None" = None,
+                 deadline_s: "float | None" = None) -> Tenant:
         """Add or reconfigure a tenant; slices rebalance immediately."""
         with self._lock:
             t = self._tenants.get(name)
             if t is None:
                 t = self._tenants[name] = Tenant(
                     name, weight=weight, slo_p99_ms=slo_p99_ms,
-                    cache_fraction=cache_fraction)
+                    cache_fraction=cache_fraction, deadline_s=deadline_s)
             else:
                 t.weight = max(int(weight), 1)
                 if slo_p99_ms is not None:
                     t.slo_p99_ms = float(slo_p99_ms)
                 if cache_fraction is not None:
                     t.cache_fraction = float(cache_fraction)
+                if deadline_s is not None:
+                    t.deadline_s = float(deadline_s)
             self._rebalance_locked()
             return t
 
