@@ -132,6 +132,20 @@ def test_make_record_fingerprint(monkeypatch):
     rec4 = ledger.make_record(_record(c=_cfg()), ts=124.5)
     assert rec4["env"]["TPQ_IO_INFLIGHT"] == "64"
     assert rec4["env"]["TPQ_IO_ASYNC"] == "0"
+    # the tracing/metrics knobs ride too (ISSUE 19): a retain-all run pays
+    # for every tree where a tail-sampled one doesn't — different
+    # experiments, and the dump spec names where the evidence went
+    monkeypatch.setenv("TPQ_TRACE_TAIL", "1")
+    monkeypatch.setenv("TPQ_TRACE_RING", "2097152")
+    monkeypatch.setenv("TPQ_TRACE_SPANS", "256")
+    monkeypatch.setenv("TPQ_TRACE_SLOW_Q", "0.99")
+    monkeypatch.setenv("TPQ_METRICS_DUMP", "/tmp/m.json:2")
+    rec5 = ledger.make_record(_record(c=_cfg()), ts=125.0)
+    assert rec5["env"]["TPQ_TRACE_TAIL"] == "1"
+    assert rec5["env"]["TPQ_TRACE_RING"] == "2097152"
+    assert rec5["env"]["TPQ_TRACE_SPANS"] == "256"
+    assert rec5["env"]["TPQ_TRACE_SLOW_Q"] == "0.99"
+    assert rec5["env"]["TPQ_METRICS_DUMP"] == "/tmp/m.json:2"
     assert "python" in rec["env"]
     # inside this repo the short revision resolves
     rev = rec["git_rev"]

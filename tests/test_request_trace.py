@@ -1,0 +1,615 @@
+"""Per-request tracing + tail sampling + live metrics export (ISSUE 19).
+
+The contracts under test, in rough order of importance:
+
+- tracing is bit-identity-neutral: a scan (and a streaming scan) through a
+  fault-injecting store returns THE SAME bytes with tracing off
+  (``TPQ_TRACE_TAIL=0``) and retain-all (``=1``), across the recoverable
+  fault matrix — the spans observe the request, they never steer it;
+- a slow/errored request under injected chaos is reconstructable after the
+  fact: its retained tree is well-nested, carries the queue-wait / cache
+  probe / range-fetch (with retry annotations) / decode story, survives
+  ``trace_dump`` → ``pq_tool trace --request``;
+- the tail sampler retains errored/flagged/slow/1-in-N trees into a ring
+  bounded by BYTES with ledger-consistent counters, and ``offer``'s verdict
+  gates exemplars so a histogram bucket only ever names a fetchable trace;
+- exemplars ride ``LatencyHistogram.as_dict``/``from_dict`` round-trips,
+  re-derive their own bucket from the raw value, and render as OpenMetrics
+  exemplar suffixes (``# {trace_id="..."} value``) behind ``# EOF``;
+- the ``slo-burn`` doctor verdict walks a breached per-tenant SLO histogram
+  back to the offending bucket and its retained exemplar trace;
+- ``MetricsDumper`` (``TPQ_METRICS_DUMP=path:interval``) writes atomic
+  snapshots, stops with its service, and never leaves a thread behind.
+"""
+
+import argparse
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_parquet.column import ByteArrayData, ColumnData
+from tpu_parquet.format import (CompressionCodec, FieldRepetitionType as FRT,
+                                Type)
+from tpu_parquet.iostore import (FaultInjectingStore, FaultSpec, IOConfig,
+                                 LocalStore)
+from tpu_parquet.obs import (LatencyHistogram, MetricsDumper, RequestTrace,
+                             TailSampler, current_request_trace,
+                             diff_registry_trees, doctor_registry,
+                             render_openmetrics, resolve_metrics_dump,
+                             set_request_trace)
+from tpu_parquet.schema.core import build_schema, data_column
+from tpu_parquet.serve import ScanRequest, ScanService
+from tpu_parquet.writer import FileWriter
+
+
+def _strings(vals):
+    return ColumnData(values=ByteArrayData(
+        offsets=np.cumsum([0] + [len(v) for v in vals]),
+        heap=np.frombuffer(b"".join(vals), np.uint8).copy(),
+    ))
+
+
+def _write_file(path, seed=0, groups=2, rows=400):
+    rng = np.random.default_rng(seed)
+    schema = build_schema([
+        data_column("a", Type.INT64, FRT.REQUIRED),
+        data_column("s", Type.BYTE_ARRAY, FRT.REQUIRED),
+    ])
+    pool = [b"alpha", b"beta", b"gamma", b"delta", b""]
+    with open(path, "wb") as fh:
+        with FileWriter(fh, schema, codec=CompressionCodec.SNAPPY) as w:
+            for _g in range(groups):
+                svals = [pool[i] for i in rng.integers(0, len(pool), rows)]
+                w.write_columns({
+                    "a": rng.integers(-(1 << 40), 1 << 40, rows),
+                    "s": _strings(svals),
+                })
+                w.flush_row_group()
+    return path
+
+
+@pytest.fixture(scope="module")
+def files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("reqtrace")
+    return [_write_file(str(d / f"f{i}.parquet"), seed=i) for i in range(2)]
+
+
+def _assert_cols_equal(got, want):
+    assert set(got) == set(want)
+    for name in want:
+        g, w = got[name], want[name]
+        if isinstance(w.values, ByteArrayData):
+            np.testing.assert_array_equal(g.values.offsets, w.values.offsets)
+            np.testing.assert_array_equal(g.values.heap, w.values.heap)
+        else:
+            np.testing.assert_array_equal(g.values, w.values)
+
+
+def _drain(session):
+    cols = {}
+    for batch in session:
+        mask = np.asarray(batch["mask"])
+        for name, arr in batch.items():
+            if name != "mask":
+                cols.setdefault(name, []).append(np.asarray(arr)[mask])
+    return {n: np.concatenate(v) for n, v in cols.items()}
+
+
+# ---------------------------------------------------------------------------
+# RequestTrace: well-nestedness, the span cap, cross-thread stacks
+# ---------------------------------------------------------------------------
+
+def test_trace_well_nested_and_closed():
+    tr = RequestTrace()
+    with tr.span("root", kind="request"):
+        with tr.span("child"):
+            tr.annotate(bytes=7)
+        tr.add_timed("timed", 0.0, 0.001, n=3)
+    dur = tr.finish()
+    assert dur >= 0.0
+    assert [s[0] for s in tr.spans] == ["root", "child", "timed"]
+    # parent index strictly smaller than the child's own index
+    assert [s[3] for s in tr.spans] == [-1, 0, 0]
+    assert all(s[2] is not None and s[2] >= 0.0 for s in tr.spans)
+    assert tr.spans[1][4] == {"bytes": 7}
+    doc = tr.as_dict()
+    assert doc["trace_id"] == tr.trace_id and doc["dropped"] == 0
+    assert [s["parent"] for s in doc["spans"]] == [-1, 0, 0]
+
+
+def test_trace_error_close_and_flags():
+    tr = RequestTrace()
+    with pytest.raises(ValueError):
+        with tr.span("fetch", offset=0):
+            raise ValueError("boom")
+    tr.mark_error(ValueError("boom"))
+    tr.set_flag("deadline")
+    tr.finish()
+    assert tr.spans[0][4]["error"] == "ValueError"
+    assert tr.error == {"type": "ValueError", "message": "boom"}
+    assert tr.flags == {"deadline"}
+
+
+def test_trace_span_cap_counts_drops():
+    tr = RequestTrace(max_spans=4)
+    for i in range(9):
+        with tr.span(f"s{i}"):
+            pass
+    tr.finish()
+    assert len(tr.spans) == 4
+    assert tr.dropped == 5
+    assert tr.as_dict()["dropped"] == 5
+
+
+def test_trace_cross_thread_nesting_and_orphan_close():
+    tr = RequestTrace()
+    started = threading.Event()
+    release = threading.Event()
+
+    def helper():
+        s = tr.span("worker")  # first span on this thread: parents to root
+        s.__enter__()
+        with tr.span("inner"):
+            started.set()
+            release.wait(5.0)
+        # "worker" left open on purpose: finish() must close the orphan
+
+    with tr.span("request"):
+        t = threading.Thread(target=helper)
+        t.start()
+        started.wait(5.0)
+        with tr.span("main_child"):
+            pass
+        release.set()
+        t.join()
+    tr.finish()
+    by_name = {s[0]: s for s in tr.spans}
+    assert by_name["worker"][3] == -1          # own stack, not main's
+    assert by_name["inner"][3] == tr.spans.index(by_name["worker"])
+    assert by_name["main_child"][3] == tr.spans.index(by_name["request"])
+    assert all(s[2] is not None for s in tr.spans)  # orphan closed
+
+
+def test_current_request_trace_install_restore():
+    assert current_request_trace() is None
+    tr = RequestTrace()
+    prev = set_request_trace(tr)
+    assert prev is None and current_request_trace() is tr
+    set_request_trace(prev)
+    assert current_request_trace() is None
+
+
+# ---------------------------------------------------------------------------
+# TailSampler: retention policy, the byte-bounded ring, counters
+# ---------------------------------------------------------------------------
+
+def _finished_trace(nspans=3):
+    tr = RequestTrace()
+    for i in range(nspans):
+        with tr.span(f"s{i}"):
+            pass
+    tr.finish()
+    return tr
+
+
+def test_sampler_one_in_n_and_interesting():
+    s = TailSampler(one_in_n=100, ring_bytes=1 << 20)
+    assert s.enabled
+    assert not s.offer(_finished_trace(), duration_s=0.001)  # boring
+    err = _finished_trace()
+    assert s.offer(err, duration_s=0.001, error=True)        # errored
+    flagged = _finished_trace()
+    flagged.set_flag("shed")
+    assert s.offer(flagged, duration_s=0.001)                # flagged
+    marked = _finished_trace()
+    marked.mark_error(ValueError("x"))
+    assert s.offer(marked, duration_s=0.001)                 # trace.error
+    c = s.counters()
+    assert c["offered"] == 4 and c["retained"] == 3 and c["evicted"] == 0
+    assert s.get(err.trace_id)["trace_id"] == err.trace_id
+    assert s.get("nope") is None
+
+
+def test_sampler_retain_all_and_slow_gate():
+    s = TailSampler(one_in_n=1, ring_bytes=1 << 20)
+    traces = [_finished_trace() for _ in range(3)]
+    for tr in traces:
+        assert s.offer(tr, duration_s=0.001)  # 1-in-1: everything retains
+    ids = {t["trace_id"] for t in s.traces()}
+    assert ids == {tr.trace_id for tr in traces}
+
+    slow = TailSampler(one_in_n=10 ** 9, ring_bytes=1 << 20, slow_q=0.9)
+    # below SLOW_MIN_SAMPLES nothing is "slow"; past it the tail retains
+    for _ in range(TailSampler.SLOW_MIN_SAMPLES):
+        slow.offer(_finished_trace(), duration_s=0.001)
+    assert slow.offer(_finished_trace(), duration_s=0.5)  # way past p90
+    assert not slow.offer(_finished_trace(), duration_s=0.0001)
+
+
+def test_sampler_disabled_and_ring_byte_bound():
+    off = TailSampler(one_in_n=0)
+    assert not off.enabled
+    assert not off.offer(_finished_trace(), duration_s=1.0, error=True)
+    assert off.counters()["offered"] == 0
+
+    s = TailSampler(one_in_n=1, ring_bytes=4096)
+    for i in range(64):
+        s.offer(_finished_trace(nspans=8), duration_s=0.001)
+        c = s.counters()
+        assert c["retained_bytes"] <= c["ring_capacity_bytes"], c
+    c = s.counters()
+    assert c["evicted"] > 0  # 64 8-span trees cannot fit 4 KiB
+    assert len(s.traces()) == c["retained"] - c["evicted"]
+    # one pathological tree larger than the whole ring: rejected, ring kept
+    huge = RequestTrace(max_spans=4096)
+    for i in range(2000):
+        huge.add_timed(f"pad{i}", 0.0, 0.0, note="x" * 40)
+    huge.finish()
+    before = s.counters()["retained"]
+    assert not s.offer(huge, duration_s=0.001)
+    assert s.counters()["retained"] == before
+
+
+def test_sampler_dump_roundtrip(tmp_path):
+    s = TailSampler(one_in_n=1)
+    tr = _finished_trace()
+    s.offer(tr, duration_s=0.002)
+    path = str(tmp_path / "traces.json")
+    assert s.dump(path) == path
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["trace_dump_version"] == 1
+    assert doc["traces"][0]["trace_id"] == tr.trace_id
+
+
+# ---------------------------------------------------------------------------
+# exemplars: raw values, bucket re-derivation, serialization, OpenMetrics
+# ---------------------------------------------------------------------------
+
+def test_exemplar_roundtrip_and_bucket_rederive():
+    h = LatencyHistogram()
+    h.record(0.001)
+    assert "exemplars" not in h.as_dict()  # absent key when none recorded
+    h.record(0.004, exemplar="tpq-aaaa")
+    h.record(0.2, exemplar="tpq-bbbb")
+    for idx, (tid, val) in h.exemplars.items():
+        assert LatencyHistogram.bucket_index(val) == idx, (idx, val)
+    d = h.as_dict()
+    assert set(d["exemplars"]) == {str(i) for i in h.exemplars}
+    h2 = LatencyHistogram.from_dict(d)
+    assert h2.exemplars == h.exemplars
+    assert h2.count == h.count
+
+
+def test_render_openmetrics_exemplars_and_eof():
+    h = LatencyHistogram()
+    h.record(0.004, exemplar="tpq-dead")
+    tree = {"serve": {"requests": 3, "rejected": 0},
+            "histograms": {"serve.request": h.as_dict()}}
+    text = render_openmetrics(tree)
+    assert text.endswith("# EOF\n")
+    assert "# TYPE tpq_serve_requests gauge" in text
+    assert "tpq_serve_requests 3" in text
+    assert "# TYPE tpq_serve_request_seconds histogram" in text
+    assert 'trace_id="tpq-dead"' in text
+    assert "tpq_serve_request_seconds_count 1" in text
+    with pytest.raises(ValueError):
+        render_openmetrics([1, 2])  # type: ignore[arg-type]
+    d = diff_registry_trees({"serve": {"requests": 3}},
+                            {"serve": {"requests": 5, "rejected": 1}})
+    assert d == {"serve.requests": (3, 5, 2), "serve.rejected": (0, 1, 1)}
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: tracing on vs off across the recoverable fault matrix
+# ---------------------------------------------------------------------------
+
+RECOVERABLE = {
+    "latency_spike": FaultSpec(latency_s=0.005),
+    "transient_errors": FaultSpec(fail_first=2),
+    "torn_read": FaultSpec(torn_first=1),
+    "torn_then_error": FaultSpec(torn_first=1, fail_first=2),
+}
+
+
+def _fault_factory(spec):
+    return lambda f: FaultInjectingStore(
+        LocalStore(f), spec, config=IOConfig(retries=4, backoff_ms=1.0))
+
+
+@pytest.mark.parametrize("fault", sorted(RECOVERABLE))
+def test_scan_bit_identical_tracing_on_off(files, fault, monkeypatch):
+    """The spans observe the request, they never steer it: the same
+    faulted scan returns the same bytes with tracing off and retain-all,
+    and the retained tree carries the fetch story (retry annotations)."""
+    path = files[0]
+    results = {}
+    for mode, env in (("off", "0"), ("retain_all", "1")):
+        monkeypatch.setenv("TPQ_TRACE_TAIL", env)
+        svc = ScanService(concurrency=2,
+                          store=_fault_factory(RECOVERABLE[fault]))
+        try:
+            results[mode] = svc.scan(ScanRequest(path), timeout=60)[path]
+            c = svc.sampler.counters()
+            if mode == "off":
+                assert c["offered"] == 0  # genuinely zero-cost off
+            else:
+                assert c["retained"] >= 1
+                docs = svc.sampler.traces()
+                names = {s["name"] for d in docs for s in d["spans"]}
+                assert {"submit", "queue_wait", "read", "fetch"} <= names
+                for d in docs:  # retained trees are well-nested, closed
+                    for i, s in enumerate(d["spans"]):
+                        assert s["parent"] == -1 or 0 <= s["parent"] < i
+                        assert s["dur_s"] is not None
+                if "errors" in fault:
+                    anns = [s.get("args") or {} for d in docs
+                            for s in d["spans"] if s["name"] == "fetch"]
+                    assert any(a.get("retries") for a in anns)
+        finally:
+            svc.close()
+    _assert_cols_equal(results["retain_all"], results["off"])
+
+
+@pytest.mark.parametrize("fault", ["transient_errors", "torn_then_error"])
+def test_stream_bit_identical_tracing_on_off(files, fault, monkeypatch):
+    drained = {}
+    for mode, env in (("off", "0"), ("retain_all", "1")):
+        monkeypatch.setenv("TPQ_TRACE_TAIL", env)
+        svc = ScanService(concurrency=2,
+                          store=_fault_factory(RECOVERABLE[fault]))
+        try:
+            session = svc.scan(
+                ScanRequest(files, stream=True, batch_rows=128), timeout=60)
+            drained[mode] = _drain(session)
+            if mode == "retain_all":
+                # the worker's finish/offer bookkeeping can trail the
+                # consumer's last batch by a beat
+                deadline = time.time() + 10.0
+                while (time.time() < deadline
+                       and not svc.sampler.counters()["retained"]):
+                    time.sleep(0.01)
+                docs = svc.sampler.traces()
+                names = {s["name"] for d in docs for s in d["spans"]}
+                # the streaming story: per-batch and per-group spans ride
+                assert {"submit", "batch", "group"} <= names
+        finally:
+            svc.close()
+    for name in drained["off"]:
+        np.testing.assert_array_equal(drained["retain_all"][name],
+                                      drained["off"][name])
+
+
+def test_errored_request_trace_reconstructable(files, tmp_path, monkeypatch):
+    """The acceptance story: a request that died under chaos is
+    reconstructable — retained on error, fetchable by id, dumpable, and
+    ``pq_tool trace --request`` prints its span tree with the error."""
+    from tpu_parquet.cli import pq_tool
+
+    monkeypatch.setenv("TPQ_TRACE_TAIL", "128")  # NOT retain-all: the
+    # errored-trace gate, not 1-in-N, must do the retaining here
+    path = files[0]
+    svc = ScanService(concurrency=1, store=_fault_factory(
+        FaultSpec(fail_first=10 ** 6)))  # never recovers: scan fails
+    try:
+        with pytest.raises(Exception):
+            svc.scan(ScanRequest(path), timeout=60)
+        docs = svc.sampler.traces()
+        assert len(docs) == 1 and docs[0]["error"] is not None
+        tid = docs[0]["trace_id"]
+        assert svc.get_trace(tid)["trace_id"] == tid
+        dump = str(tmp_path / "traces.json")
+        svc.trace_dump(dump)
+    finally:
+        svc.close()
+    buf = io.StringIO()
+    rc = pq_tool.cmd_trace(argparse.Namespace(
+        file=dump, request=tid, config=None), out=buf)
+    out = buf.getvalue()
+    assert rc == 0
+    assert tid in out and "error:" in out and "fetch" in out
+    # unknown id: exit 1 with the retained ids and the sampling advice
+    buf = io.StringIO()
+    rc = pq_tool.cmd_trace(argparse.Namespace(
+        file=dump, request="tpq-nope", config=None), out=buf)
+    assert rc == 1
+    assert "TPQ_TRACE_TAIL" in buf.getvalue()
+
+
+def test_exemplar_links_tenant_histogram_to_trace(files, monkeypatch):
+    """Retain-all: the per-tenant SLO histogram's exemplars name traces
+    the sampler can actually fetch back — the exemplar gate contract."""
+    monkeypatch.setenv("TPQ_TRACE_TAIL", "1")
+    svc = ScanService(concurrency=1)
+    try:
+        svc.register_tenant("acme", weight=2, slo_p99_ms=50.0)
+        for _ in range(3):
+            svc.scan(ScanRequest(files[0], tenant="acme"), timeout=60)
+        tree = svc.obs_registry().as_dict()
+        hd = tree["histograms"]["serve.tenant.acme"]
+        assert hd.get("exemplars"), hd
+        for idx, (tid, val) in hd["exemplars"].items():
+            assert svc.get_trace(tid) is not None
+            assert LatencyHistogram.bucket_index(float(val)) == int(idx)
+        assert tree["serve"]["tenants"]["acme"]["traces_retained"] >= 3
+        assert tree["serve"]["trace"]["retained"] >= 3
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# slo-burn doctor verdict
+# ---------------------------------------------------------------------------
+
+def _slo_burn_tree(trace_id="tpq-feed-1"):
+    h = LatencyHistogram()
+    for _ in range(200):
+        h.record(0.002)
+    for _ in range(10):
+        h.record(0.5, exemplar=trace_id)  # tail way past the 10ms SLO
+    return {
+        "obs_version": 1,
+        "serve": {"requests": 210,
+                  "tenants": {"acme": {"weight": 1, "slo_p99_ms": 10.0}}},
+        "histograms": {"serve.tenant.acme": h.as_dict()},
+    }
+
+
+def test_doctor_slo_burn_names_bucket_and_exemplar(tmp_path):
+    report = doctor_registry(_slo_burn_tree())
+    assert report is not None
+    sb = report.get("slo_burn")
+    assert sb is not None and sb["verdict"] == "slo-burn"
+    assert sb["tenant"] == "acme" and sb["burn_ratio"] > 1.0
+    assert sb["exemplar_trace"] == "tpq-feed-1"
+    assert sb["bucket"] == LatencyHistogram.bucket_index(0.5)
+    assert sb["burning_tenants"] == ["acme"]
+    assert "pq_tool trace --request tpq-feed-1" in sb["advice"]
+    # within SLO: no verdict
+    ok = _slo_burn_tree()
+    ok["serve"]["tenants"]["acme"]["slo_p99_ms"] = 10_000.0
+    rep = doctor_registry(ok)
+    assert rep is None or rep.get("slo_burn") is None
+
+    from tpu_parquet.cli import pq_tool
+
+    path = str(tmp_path / "run.json")
+    with open(path, "w") as f:
+        json.dump(_slo_burn_tree(), f)
+    buf = io.StringIO()
+    rc = pq_tool.cmd_doctor(
+        argparse.Namespace(file=path, config=None), out=buf)
+    out = buf.getvalue()
+    assert rc == 0
+    assert "slo-burn:" in out and "'acme'" in out
+    assert "tpq-feed-1" in out
+
+
+# ---------------------------------------------------------------------------
+# pq_tool metrics + serve-stats surfaces
+# ---------------------------------------------------------------------------
+
+def _metrics_ns(file, file2=None, **kw):
+    kw.setdefault("config", None)
+    kw.setdefault("watch", False)
+    kw.setdefault("interval", 2.0)
+    kw.setdefault("count", None)
+    return argparse.Namespace(file=file, file2=file2, **kw)
+
+
+def test_metrics_cli_render_diff_watch(tmp_path):
+    from tpu_parquet.cli import pq_tool
+
+    a = _slo_burn_tree()
+    b = json.loads(json.dumps(a))
+    b["serve"]["requests"] = 250
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    with open(pa, "w") as f:
+        json.dump(a, f)
+    with open(pb, "w") as f:
+        json.dump(b, f)
+
+    buf = io.StringIO()
+    assert pq_tool.cmd_metrics(_metrics_ns(pa), out=buf) == 0
+    text = buf.getvalue()
+    assert "tpq_serve_requests 210" in text and "# EOF" in text
+    assert 'trace_id="tpq-feed-1"' in text
+
+    buf = io.StringIO()
+    assert pq_tool.cmd_metrics(_metrics_ns(pa, pb), out=buf) == 0
+    assert "serve.requests" in buf.getvalue()
+    assert "210 -> 250" in buf.getvalue()
+
+    buf = io.StringIO()  # --watch bounded by --count exits cleanly
+    assert pq_tool.cmd_metrics(
+        _metrics_ns(pa, watch=True, interval=0.01, count=2), out=buf) == 0
+    assert "watching" in buf.getvalue()
+
+    buf = io.StringIO()
+    assert pq_tool.cmd_metrics(_metrics_ns(str(tmp_path / "nope.json")),
+                               out=buf) == 1
+
+
+def test_serve_stats_exemplar_rows_and_tracing_line(files, tmp_path,
+                                                    monkeypatch):
+    from tpu_parquet.cli import pq_tool
+
+    monkeypatch.setenv("TPQ_TRACE_TAIL", "1")
+    with ScanService(concurrency=1) as svc:
+        svc.register_tenant("acme", weight=1, slo_p99_ms=75.0)
+        svc.scan(ScanRequest(files[0], tenant="acme"), timeout=60)
+        tree = svc.obs_registry().as_dict()
+    path = str(tmp_path / "reg.json")
+    with open(path, "w") as f:
+        json.dump(tree, f)
+    buf = io.StringIO()
+    rc = pq_tool.cmd_serve_stats(
+        argparse.Namespace(file=path, config=None), out=buf)
+    out = buf.getvalue()
+    assert rc == 0
+    assert "tracing:" in out and "retained" in out
+    assert "exemplars (bucket -> retained trace):" in out
+    # at least one retained trace id appears in an exemplar row
+    ex_ids = [ex[0] for hd in tree["histograms"].values()
+              for ex in (hd.get("exemplars") or {}).values()]
+    assert ex_ids and any(t in out for t in ex_ids)
+
+
+# ---------------------------------------------------------------------------
+# MetricsDumper: lifecycle, atomicity, the env spec
+# ---------------------------------------------------------------------------
+
+def test_resolve_metrics_dump_spec():
+    assert resolve_metrics_dump("/tmp/m.json:2.5") == ("/tmp/m.json", 2.5)
+    assert resolve_metrics_dump("") is None
+    assert resolve_metrics_dump("noseparator") is None
+    assert resolve_metrics_dump("path:notafloat") is None
+    assert resolve_metrics_dump("path:-1") is None
+    assert resolve_metrics_dump(":2.0") is None
+
+
+def _dumper_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith("tpq-metricsdump")]
+
+
+def test_metrics_dumper_lifecycle(tmp_path):
+    path = str(tmp_path / "snap.json")
+    tree = {"serve": {"requests": 1}}
+    d = MetricsDumper(lambda: tree, spec=f"{path}:0.02")
+    assert d.enabled
+    with d:
+        time.sleep(0.08)
+    assert not _dumper_threads()  # stop() joined
+    assert d.written >= 2
+    with open(path) as f:
+        assert json.load(f) == tree  # atomic: never a torn file
+    # malformed spec: inert, start() is a no-op, dump_once returns None
+    inert = MetricsDumper(lambda: tree, spec="bad")
+    assert not inert.enabled
+    inert.start()
+    assert not _dumper_threads()
+    assert inert.dump_once() is None
+    # a failing source is counted, never raised
+    fail = MetricsDumper(lambda: 1 / 0, spec=f"{path}:5")
+    assert fail.dump_once() is None and fail.dropped == 1
+
+
+def test_service_dumper_env_snapshot(files, tmp_path, monkeypatch):
+    path = str(tmp_path / "live.json")
+    monkeypatch.setenv("TPQ_METRICS_DUMP", f"{path}:30")
+    svc = ScanService(concurrency=1)
+    try:
+        svc.scan(ScanRequest(files[0]), timeout=60)
+        assert _dumper_threads()  # running alongside the service
+    finally:
+        svc.close()
+    assert not _dumper_threads()  # joined by close()
+    with open(path) as f:  # stop() wrote the final end-state snapshot
+        tree = json.load(f)
+    assert tree["serve"]["submitted"] >= 1

@@ -194,6 +194,74 @@ def _load_doc(spec: str):
         raise ValueError(f"{spec}: not JSON ({e})") from None
 
 
+def _fmt_span_args(args_d: dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in args_d.items())
+
+
+def cmd_trace_request(args, out=sys.stdout) -> int:
+    """``pq_tool trace --request <id> <dump>``: print one retained
+    request's span tree from a tail-sampler dump
+    (:meth:`~tpu_parquet.serve.ScanService.trace_dump` /
+    ``TailSampler.dump`` output) — indentation is nesting, each line a
+    span's start offset, duration, and annotations (retry counts, hedge
+    outcomes, cache hits), so a bad exemplar percentile reads as a story:
+    which range fetch stalled, which probe missed, where the time went."""
+    doc = _load_doc(args.file)
+    if isinstance(doc, dict) and isinstance(doc.get("traces"), list):
+        traces = [t for t in doc["traces"] if isinstance(t, dict)]
+    elif isinstance(doc, dict) and "trace_id" in doc:
+        traces = [doc]
+    else:
+        out.write(f"pq-tool trace: {args.file}: not a trace dump (expected "
+                  f"the ScanService.trace_dump / TailSampler.dump format)\n")
+        return 1
+    want = args.request
+    match = [t for t in traces if t.get("trace_id") == want]
+    if not match:  # prefix match: ids are long, tails are what users copy
+        match = [t for t in traces
+                 if str(t.get("trace_id", "")).startswith(want)]
+    if not match:
+        ids = ", ".join(str(t.get("trace_id")) for t in traces[-8:])
+        out.write(f"pq-tool trace: {args.file}: no retained trace "
+                  f"{want!r} ({len(traces)} retained"
+                  + (f"; most recent: {ids}" if ids else "")
+                  + ") — it may have been evicted (raise TPQ_TRACE_RING) "
+                    "or never retained (raise sampling: TPQ_TRACE_TAIL)\n")
+        return 1
+    tr = match[0]
+    dur = tr.get("duration_s")
+    out.write(f"trace {tr.get('trace_id')}: "
+              + (f"{dur * 1e3:.2f}ms" if dur is not None else "?")
+              + (f", dropped {tr['dropped']} span(s)"
+                 if tr.get("dropped") else "")
+              + (f", flags [{', '.join(tr['flags'])}]"
+                 if tr.get("flags") else "")
+              + "\n")
+    err = tr.get("error")
+    if err:
+        out.write(f"error: {err.get('type')}: {err.get('message')}\n")
+    spans = tr.get("spans") or []
+    children: dict = {}
+    for i, s in enumerate(spans):
+        children.setdefault(s.get("parent", -1), []).append(i)
+
+    def emit(idx: int, depth: int) -> None:
+        s = spans[idx]
+        d = s.get("dur_s")
+        line = (f"  {'  ' * depth}{s.get('name', '?'):<{18 - 2 * min(depth, 6)}} "
+                f"@{s.get('t_s', 0) * 1e3:>9.3f}ms "
+                + (f"{d * 1e3:>9.3f}ms" if d is not None else f"{'?':>11}"))
+        extra = _fmt_span_args(s.get("args") or {})
+        out.write(line + (f"  {extra}" if extra else "") + "\n")
+        for c in children.get(idx, ()):
+            emit(c, depth + 1)
+
+    out.write("spans:\n")
+    for root in children.get(-1, ()):
+        emit(root, 0)
+    return 0
+
+
 def cmd_trace(args, out=sys.stdout) -> int:
     """Render a Chrome trace-event JSON (a ``TPQ_TRACE`` run) as the
     per-stage latency / overlap / stall / route-prediction report — the
@@ -203,9 +271,14 @@ def cmd_trace(args, out=sys.stdout) -> int:
     Also accepts ledger refs (``latest``, ``#N``): the record's env names
     the run's ``TPQ_TRACE`` base, and the per-config artifact
     ``<base>.<config>.json`` (``--config``, default the record's first
-    config) is summarized in its place."""
+    config) is summarized in its place.
+
+    ``--request <trace_id>`` switches modes: the argument is a tail-sampler
+    dump and the named retained REQUEST trace prints as a span tree."""
     from ..obs import trace_summary
 
+    if getattr(args, "request", None):
+        return cmd_trace_request(args, out)
     doc = _load_doc(args.file)
     label = args.file
     if isinstance(doc, dict) and "traceEvents" not in doc and "configs" in doc:
@@ -431,6 +504,21 @@ def cmd_doctor(args, out=sys.stdout) -> int:
                      if ov.get("victims") else "")
                   + (f"; retry-after {hint:g}s" if hint else "")
                   + f" — {ov['advice']}\n")
+    sb = rep.get("slo_burn")
+    if sb:
+        out.write(f"slo-burn: tenant {sb['tenant']!r} p99 "
+                  f"{sb['p99_ms']:.2f}ms vs slo {sb['slo_p99_ms']:g}ms "
+                  f"({sb['burn_ratio']:.1f}x), offending bucket le "
+                  f"{sb['bucket_le_s'] * 1e3:g}ms"
+                  + (f", exemplar trace {sb['exemplar_trace']}"
+                     if sb.get("exemplar_trace") else "")
+                  + (f" ({sb['exemplar_value_s'] * 1e3:.2f}ms)"
+                     if sb.get("exemplar_value_s") is not None else "")
+                  + f" — {sb['advice']}\n")
+        burning = sb.get("burning_tenants") or []
+        if len(burning) > 1:
+            out.write(f"slo-burn: {len(burning)} tenants over target "
+                      f"({', '.join(burning)}); worst burn shown\n")
     hg = rep.get("hedge")
     if hg:
         out.write(f"hedge-ineffective: {hg['won']}/{hg['issued']} hedges "
@@ -455,6 +543,70 @@ def cmd_doctor(args, out=sys.stdout) -> int:
                   f"seconds; {wrt['rows_per_sec']:.0f} rows/s, "
                   f"{wrt['bytes_per_sec'] / 1e6:.1f} MB/s)\n")
     return 0
+
+
+def cmd_metrics(args, out=sys.stdout) -> int:
+    """Live metrics plumbing over registry snapshots (the JSON trees
+    ``TPQ_METRICS_DUMP`` writes, or any input ``doctor`` accepts):
+
+    - one snapshot: render the OpenMetrics text exposition (counters,
+      gauges, ``_bucket``/``_sum``/``_count`` histogram families with
+      trace-id exemplars) — what a scraper would ingest;
+    - two snapshots: the numeric counter deltas A → B;
+    - ``--watch``: poll the snapshot file and print deltas as they land
+      (``--count`` bounds the polls for scripting)."""
+    from ..obs import diff_registry_trees, render_openmetrics
+
+    def load(spec):
+        tree, why = _load_registry_tree(spec, getattr(args, "config", None))
+        if tree is None:
+            raise ValueError(f"{spec}: {why}")
+        return tree
+
+    def write_diff(old, new, indent="  "):
+        d = diff_registry_trees(old, new)
+        if not d:
+            out.write(f"{indent}(no numeric changes)\n")
+            return
+        w = max(len(p) for p in d)
+        for path in sorted(d):
+            o, n, delta = d[path]
+            out.write(f"{indent}{path:<{w}}  {o:g} -> {n:g}  ({delta:+g})\n")
+
+    if getattr(args, "watch", False):
+        import time as _time
+
+        interval = max(float(args.interval), 0.01)
+        out.write(f"metrics: watching {args.file} "
+                  f"(interval {interval:g}s"
+                  + (f", {args.count} poll(s)" if args.count else "")
+                  + ")\n")
+        prev = None
+        polls = 0
+        while args.count is None or polls < args.count:
+            if polls:
+                _time.sleep(interval)
+            polls += 1
+            try:
+                tree = load(args.file)
+            except (OSError, ValueError):
+                continue  # dumper mid-replace or not written yet: next poll
+            if prev is not None and tree != prev:
+                out.write(f"poll {polls}:\n")
+                write_diff(prev, tree)
+            prev = tree
+        return 0
+    try:
+        if getattr(args, "file2", None):
+            old, new = load(args.file), load(args.file2)
+            out.write(f"metrics diff: {args.file} -> {args.file2}\n")
+            write_diff(old, new)
+            return 0
+        out.write(render_openmetrics(load(args.file)))
+        return 0
+    except (OSError, ValueError) as e:
+        out.write(f"pq-tool metrics: {e}\n")
+        return 1
 
 
 def cmd_autopsy(args, out=sys.stdout) -> int:
@@ -664,6 +816,28 @@ def cmd_serve_stats(args, out=sys.stdout) -> int:
                       f"{h.quantile(0.95) * 1e3:>10.2f}ms"
                       f"{h.quantile(0.99) * 1e3:>10.2f}ms"
                       f"{h.max_seconds * 1e3:>10.2f}ms\n")
+    # exemplar rows: the percentile-to-trace link — each populated bucket's
+    # most recent RETAINED trace id (pq_tool trace --request fetches it)
+    ex_rows = []
+    for name, hd in sorted(hists.items()):
+        if not name.startswith("serve.") or not isinstance(hd, dict):
+            continue
+        for b, ex in sorted((hd.get("exemplars") or {}).items(),
+                            key=lambda kv: int(kv[0])):
+            ex_rows.append((name.split(".", 1)[1], int(b), ex))
+    if ex_rows:
+        out.write("exemplars (bucket -> retained trace):\n")
+        for lane, b, ex in ex_rows:
+            le = LatencyHistogram.bucket_upper_seconds(b) * 1e3
+            out.write(f"  {lane:<16} le={le:g}ms  {ex[0]}  "
+                      f"({float(ex[1]) * 1e3:.3f}ms)\n")
+    trc = sv.get("trace") or {}
+    if trc.get("offered"):
+        out.write(f"tracing: {trc.get('offered', 0)} offered, "
+                  f"{trc.get('retained', 0)} retained, "
+                  f"{trc.get('evicted', 0)} evicted, "
+                  f"{trc.get('retained_bytes', 0)}/"
+                  f"{trc.get('ring_capacity_bytes', 0)} B ring\n")
     return 0
 
 
@@ -885,6 +1059,10 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--config", default=None,
                     help="ledger-ref input: which config's trace artifact "
                          "to summarize (default: the record's first)")
+    tr.add_argument("--request", default=None, metavar="TRACE_ID",
+                    help="FILE is a tail-sampler dump (ScanService."
+                         "trace_dump): print the named retained request's "
+                         "span tree (prefix match accepted)")
     tr.set_defaults(func=cmd_trace)
 
     dr = sub.add_parser(
@@ -921,6 +1099,26 @@ def build_parser() -> argparse.ArgumentParser:
                     help="bench-artifact input: which config's registry to "
                          "summarize")
     ss.set_defaults(func=cmd_serve_stats)
+
+    mt = sub.add_parser(
+        "metrics",
+        help="OpenMetrics exposition of a registry snapshot "
+             "(TPQ_METRICS_DUMP output); two snapshots diff; --watch polls")
+    mt.add_argument("file", help="registry snapshot JSON, trace/bench "
+                                 "artifact, or ledger ref")
+    mt.add_argument("file2", nargs="?", default=None,
+                    help="second snapshot: print numeric counter deltas "
+                         "FILE -> FILE2 instead of rendering")
+    mt.add_argument("--config", default=None,
+                    help="bench-artifact input: which config's registry to "
+                         "render")
+    mt.add_argument("--watch", action="store_true",
+                    help="poll FILE, printing counter deltas as they land")
+    mt.add_argument("--interval", type=float, default=2.0,
+                    help="--watch poll interval seconds (default 2)")
+    mt.add_argument("--count", type=int, default=None,
+                    help="--watch: stop after N polls (default: forever)")
+    mt.set_defaults(func=cmd_metrics)
 
     be = sub.add_parser(
         "bench", help="run-ledger tools: compare and list recorded runs")

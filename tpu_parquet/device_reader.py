@@ -4325,7 +4325,17 @@ class DeviceFileReader:
         import time as _time
 
         out, plans, stager = prepared
+        # the request trace rides the reader's cancel token (the serve tier
+        # sets it); the device pass is one span per dispatched group
+        _cancel = getattr(self._host, "_cancel", None)
+        _rtrace = getattr(_cancel, "trace", None) if _cancel is not None \
+            else None
+        if _rtrace is None:
+            from .obs import current_request_trace
+
+            _rtrace = current_request_trace()
         if plans:
+            _tr0 = _time.perf_counter() if _rtrace is not None else 0.0
             if buf_dev is None:
                 t0 = _time.perf_counter()
                 with self._pipe_stats.timed("stage", bytes=stager.total), \
@@ -4342,6 +4352,10 @@ class DeviceFileReader:
             with self._stats_lock:
                 self._stats.dispatch_seconds += _time.perf_counter() - t1
             self._note_dispatched(stager)
+            if _rtrace is not None:
+                _rtrace.add_timed("device", _tr0, _time.perf_counter(),
+                                  plans=len(plans),
+                                  staged_bytes=int(stager.total))
         if self._result_cache is not None:
             ent = self._rc_pending.get(id(out))
             if ent is not None:

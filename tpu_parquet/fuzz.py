@@ -1532,6 +1532,141 @@ def crafted_fetch_engine_blobs() -> "list[bytes]":
     return [deep, hedged, cancel_mid, budget, interleave]
 
 
+def fuzz_request_trace(data: bytes) -> None:
+    """Request-tracing op-stream interpreter (obs.py, ISSUE 19): the blob
+    picks the tail sampler's 1-in-N rate, ring size, worker-thread count,
+    and per-trace span cap, then drives randomized span open / close /
+    error-close / annotate / flag / early-finish ops across threads on
+    shared ``RequestTrace`` trees offered to one ``TailSampler``.
+    Whatever the stream does: every finished tree is well-nested (a span's
+    parent index is always smaller than its own, no null durations after
+    ``finish``), the span cap bounds the tree with drops counted, trace
+    ids never collide, the export ring honours its byte bound with a
+    ledger-consistent retained/evicted count, every retained trace is
+    fetchable by id, and every histogram exemplar's raw value re-derives
+    the bucket it is stored under — anything else is a finding."""
+    import threading as _threading
+    import time as _time
+
+    from .obs import LatencyHistogram, RequestTrace, TailSampler
+
+    if len(data) < 6:
+        return
+    one_in_n = 1 + data[0] % 4
+    ring = 4096 + (data[1] & 7) * 1024
+    nthreads = 1 + data[2] % 3
+    max_spans = 4 + data[3] % 29
+    ntraces = 1 + data[4] % 6
+    ops = data[5:133]
+    sampler = TailSampler(one_in_n=one_in_n, ring_bytes=ring, slow_q=0.95)
+    hist = LatencyHistogram()
+    ids = []
+    for ti in range(ntraces):
+        tr = RequestTrace(max_spans=max_spans)
+        ids.append(tr.trace_id)
+
+        def run(ops_slice, _tr=tr):
+            open_spans = []  # deliberately may leave some open: finish()
+            for b in ops_slice:  # must close the orphans
+                op, arg = b >> 5, b & 31
+                if op in (0, 1):
+                    s = _tr.span(f"s{arg}", arg=arg)
+                    s.__enter__()
+                    open_spans.append(s)
+                elif op == 2:
+                    if open_spans:
+                        open_spans.pop().__exit__(None, None, None)
+                elif op == 3:
+                    if open_spans:
+                        e = ValueError("boom")
+                        open_spans.pop().__exit__(ValueError, e, None)
+                elif op == 4:
+                    t = _time.perf_counter()
+                    _tr.add_timed(f"t{arg}", t, t + arg * 1e-6, n=arg)
+                elif op == 5:
+                    _tr.annotate(bytes=arg)
+                elif op == 6:
+                    if arg % 3 == 0:
+                        _tr.mark_error(ValueError(f"e{arg}"))
+                    else:
+                        _tr.set_flag(("deadline", "shed")[arg % 2])
+                else:
+                    _tr.finish()  # racing early finish must stay safe
+
+        threads = [_threading.Thread(target=run, args=(ops[t::nthreads],))
+                   for t in range(nthreads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tr.finish()
+        if len(tr.spans) > max_spans:
+            raise AssertionError(
+                f"span cap {max_spans} breached: {len(tr.spans)} spans")
+        if tr.dropped and len(tr.spans) != max_spans:
+            raise AssertionError(
+                f"{tr.dropped} drops counted below the cap "
+                f"({len(tr.spans)}/{max_spans} spans)")
+        for i, s in enumerate(tr.spans):
+            if not (s[3] == -1 or 0 <= s[3] < i):
+                raise AssertionError(
+                    f"tree not well-nested: span {i} has parent {s[3]}")
+            if s[2] is None or s[2] < 0.0:
+                raise AssertionError(
+                    f"span {i} duration {s[2]!r} after finish()")
+        # deterministic synthetic durations spread offers across buckets
+        dur = 1e-4 * (ti + 1) + len(tr.spans) * 1e-6
+        retained = sampler.offer(tr, duration_s=dur)
+        hist.record(dur, exemplar=tr.trace_id if retained else None)
+        if retained and sampler.get(tr.trace_id) is None \
+                and sampler.counters()["evicted"] == 0:
+            raise AssertionError(
+                f"retained trace {tr.trace_id} not fetchable by id")
+    if len(set(ids)) != len(ids):
+        raise AssertionError(f"trace ids collided: {ids}")
+    c = sampler.counters()
+    if c["retained_bytes"] > c["ring_capacity_bytes"]:
+        raise AssertionError(f"export ring over its byte bound: {c}")
+    docs = sampler.traces()
+    if len(docs) != c["retained"] - c["evicted"]:
+        raise AssertionError(
+            f"ring ledger does not reconcile: {len(docs)} held vs {c}")
+    for doc in docs:
+        if sampler.get(doc["trace_id"]) != doc:
+            raise AssertionError(
+                f"get({doc['trace_id']}) diverged from the ring entry")
+    for idx, ex in hist.exemplars.items():
+        if LatencyHistogram.bucket_index(ex[1]) != idx:
+            raise AssertionError(
+                f"exemplar {ex} stored under bucket {idx} but its value "
+                f"re-derives bucket {LatencyHistogram.bucket_index(ex[1])}")
+
+
+def crafted_request_trace_blobs() -> "list[bytes]":
+    """Hand-crafted ``request_trace`` inputs (and corpus blobs): a deep
+    open chain against a tiny span cap (counted drops + orphan close on
+    finish), an interleaved open/error-close/flag storm across 3 threads,
+    a retain-all sampler on the smallest ring (eviction churn under the
+    byte bound), an early-finish race with ops still arriving, and a
+    bucket-spreading run that exercises the exemplar map."""
+    OPEN, CLOSE, ERRC, TIMED, ANN, FLAG, FIN = (
+        0 << 5, 2 << 5, 3 << 5, 4 << 5, 5 << 5, 6 << 5, 7 << 5)
+    deep = bytes([0, 7, 0, 0, 0]) + bytes(
+        [OPEN | (i % 32) for i in range(40)])
+    storm = bytes([0, 7, 2, 12, 2]) + bytes(
+        [OPEN | 1, OPEN | 2, ERRC | 0, CLOSE | 0, TIMED | 9, ANN | 3,
+         OPEN | 4, FLAG | 3, CLOSE | 0] * 6)
+    churn = bytes([0, 0, 0, 28, 5]) + bytes(
+        [(OPEN | (i % 32)) if i % 3 else (TIMED | (i % 32))
+         for i in range(64)])
+    early = bytes([0, 0, 1, 10, 1]) + bytes(
+        [OPEN | 5, FIN, OPEN | 6, TIMED | 2, CLOSE, FIN, OPEN | 7,
+         ANN | 1, CLOSE])
+    spread = bytes([0, 3, 1, 20, 5]) + bytes(
+        [TIMED | (1 + i % 31) for i in range(32)] + [OPEN | 9, CLOSE])
+    return [deep, storm, churn, early, spread]
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -1555,6 +1690,7 @@ TARGETS = {
     "footer_merge": fuzz_footer_merge,
     "stream_cursor": fuzz_stream_cursor,
     "fetch_engine": fuzz_fetch_engine,
+    "request_trace": fuzz_request_trace,
 }
 
 
@@ -1766,6 +1902,8 @@ def _seed_inputs(target: str) -> list[bytes]:
         return crafted_stream_cursor_blobs()
     if target == "fetch_engine":
         return crafted_fetch_engine_blobs()
+    if target == "request_trace":
+        return crafted_request_trace_blobs()
     if target == "loader_state":
         from .data import checkpoint as ck
 

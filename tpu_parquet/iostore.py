@@ -722,6 +722,37 @@ class GenericRangeStore(ByteStore):
     def read_range(self, offset: int, size: int,
                    deadline: "float | None" = None,
                    scan: "ScanToken | None" = None) -> bytes:
+        if scan is None:
+            scan = self._default_scan
+        cancel = scan.cancel
+        trace = getattr(cancel, "trace", None) if cancel is not None else None
+        if trace is None:
+            # tracing off (or no request context): the retry loop runs
+            # bare — zero added work on the hot path
+            return self._read_range_retry(offset, size, deadline, scan)
+        attempts: list[dict] = []
+        h0, w0 = self.stats.hedges_issued, self.stats.hedges_won
+        with trace.span("fetch", offset=offset, size=size):
+            try:
+                buf = self._read_range_retry(offset, size, deadline, scan,
+                                             attempts_out=attempts)
+            finally:
+                # retry/hedge annotations on the span the `with` just
+                # opened (the thread's open-span stack still points at it
+                # inside this finally)
+                if attempts:
+                    trace.annotate(retries=len(attempts),
+                                   last_error=attempts[-1]["error"])
+                hi = self.stats.hedges_issued - h0
+                if hi > 0:
+                    trace.annotate(
+                        hedged=hi, hedge_won=self.stats.hedges_won > w0)
+        return buf
+
+    def _read_range_retry(self, offset: int, size: int,
+                          deadline: "float | None" = None,
+                          scan: "ScanToken | None" = None,
+                          attempts_out: "list | None" = None) -> bytes:
         cfg = self.config
         if scan is None:
             scan = self._default_scan
@@ -736,7 +767,7 @@ class GenericRangeStore(ByteStore):
             deadline = (scan.deadline if deadline is None
                         else min(deadline, scan.deadline))
         cancel = scan.cancel
-        attempts: list[dict] = []
+        attempts: list[dict] = ([] if attempts_out is None else attempts_out)
         torn_prefix: "bytes | None" = None
         backoff = cfg.backoff_ms / 1e3
         stats = self.stats

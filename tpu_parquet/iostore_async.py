@@ -357,6 +357,13 @@ class FetchEngine:
         if scan is None:
             scan = getattr(store, "_default_scan", None)
         cancel = scan.cancel if scan is not None else None
+        trace = getattr(cancel, "trace", None) if cancel is not None else None
+        # the request-trace span is recorded with add_timed AFTER the fact:
+        # coroutines interleave on this one engine thread, so an open-span
+        # context here would nest unrelated in-flight ranges into each other
+        attempts: "list[dict] | None" = [] if trace is not None else None
+        tr0 = time.perf_counter() if trace is not None else 0.0
+        err_name = None
         ev = self._cancel_event(cancel)
         t0 = time.monotonic()
         ok = had_slot = False
@@ -368,16 +375,30 @@ class FetchEngine:
             had_slot = True
             try:
                 buf = await self._read_range_async(
-                    store, offset, size, scan, deadline, ev, cancel)
+                    store, offset, size, scan, deadline, ev, cancel,
+                    attempts_out=attempts)
                 ok = True
                 return buf
             finally:
                 self._sem.release()
+        except BaseException as e:
+            err_name = type(e).__name__
+            raise
         finally:
             estats.note_done(ok, had_slot, time.monotonic() - t_slot)
+            if trace is not None:
+                args = {"offset": offset, "size": size, "engine": True,
+                        "queue_wait_ms": round(
+                            max(t_slot - t0, 0.0) * 1e3, 3)}
+                if attempts:
+                    args["retries"] = len(attempts)
+                    args["last_error"] = attempts[-1]["error"]
+                if err_name is not None:
+                    args["error"] = err_name
+                trace.add_timed("fetch", tr0, time.perf_counter(), **args)
 
     async def _read_range_async(self, store, offset, size, scan, deadline,
-                                ev, cancel):
+                                ev, cancel, attempts_out: "list | None" = None):
         """The retry/deadline/backoff loop of
         ``GenericRangeStore.read_range``, as a coroutine.  Every branch,
         counter, and error message mirrors the threaded loop — the
@@ -391,7 +412,7 @@ class FetchEngine:
         if scan is not None and scan.deadline is not None:
             deadline = (scan.deadline if deadline is None
                         else min(deadline, scan.deadline))
-        attempts: list[dict] = []
+        attempts: list[dict] = ([] if attempts_out is None else attempts_out)
         torn_prefix: "bytes | None" = None
         backoff = cfg.backoff_ms / 1e3
         stats = store.stats

@@ -64,7 +64,10 @@ _ENV_KEYS = (
     "TPQ_WRITE_CRC", "TPQ_WRITE_WORKERS",
     "TPQ_IO_HEDGE_MAX", "TPQ_IO_INFLIGHT", "TPQ_IO_ASYNC",
     "TPQ_CIRCUIT_FAILS", "TPQ_CIRCUIT_WINDOW_S",
-    "TPQ_CIRCUIT_COOLDOWN_S", "BENCH_SCALE", "BENCH_DEVICE_REPS",
+    "TPQ_CIRCUIT_COOLDOWN_S",
+    "TPQ_TRACE_TAIL", "TPQ_TRACE_RING", "TPQ_TRACE_SPANS",
+    "TPQ_TRACE_SLOW_Q", "TPQ_METRICS_DUMP",
+    "BENCH_SCALE", "BENCH_DEVICE_REPS",
     "BENCH_BASELINE_REPS", "BENCH_RESAMPLE", "BENCH_CONFIGS",
     "JAX_PLATFORMS",
 )

@@ -78,14 +78,17 @@ from typing import Optional
 
 __all__ = [
     "FLIGHT_VERSION", "OBS_VERSION", "ConsumerLane", "FlightRecorder",
-    "LatencyHistogram",
-    "Sampler", "StatsRegistry", "Tracer", "Watchdog", "autopsy_dump",
-    "current_tracer", "doctor_registry", "env_float", "env_int",
+    "LatencyHistogram", "MetricsDumper", "RequestTrace",
+    "Sampler", "StatsRegistry", "TailSampler", "Tracer", "Watchdog",
+    "autopsy_dump",
+    "current_request_trace", "current_tracer", "doctor_registry",
+    "env_float", "env_int",
     "flight_dump_path",
     "flight_recorder", "install_flight_hooks", "note_worker_crash",
     "register_flight_registry", "register_flight_source",
+    "render_openmetrics",
     "resolve_hang_s", "resolve_sample_ms", "resolve_tracer",
-    "trace_summary",
+    "set_request_trace", "trace_summary", "warn_env_once",
 ]
 
 # version of every schema this module emits (the registry tree, the trace
@@ -169,7 +172,8 @@ class LatencyHistogram:
     process boundaries (the loader-resume shaped 2-process test).
     """
 
-    __slots__ = ("_lock", "buckets", "count", "sum_seconds", "max_seconds")
+    __slots__ = ("_lock", "buckets", "count", "sum_seconds", "max_seconds",
+                 "exemplars")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -177,30 +181,53 @@ class LatencyHistogram:
         self.count = 0
         self.sum_seconds = 0.0
         self.max_seconds = 0.0
+        # bucket idx -> [trace_id, seconds]: the most recent RETAINED trace
+        # whose duration landed in that bucket — the OpenMetrics exemplar
+        # (one per bucket, last-writer-wins; a map, not a ring, so the
+        # memory bound is the bucket count)
+        self.exemplars: "dict[int, list]" = {}
 
-    def record(self, seconds: float) -> None:
+    @staticmethod
+    def bucket_index(seconds: float) -> int:
+        """The bucket a duration lands in (the ``record`` formula)."""
         ns = int(seconds * 1e9)
-        idx = ns.bit_length() if ns > 0 else 0
+        return ns.bit_length() if ns > 0 else 0
+
+    @staticmethod
+    def bucket_upper_seconds(idx: int) -> float:
+        """Bucket ``idx``'s exclusive upper bound in seconds (``2^idx`` ns;
+        bucket 0 is exactly 0) — the OpenMetrics ``le`` value."""
+        return 0.0 if idx <= 0 else (2.0 ** idx) / 1e9
+
+    def record(self, seconds: float, exemplar: "str | None" = None) -> None:
+        idx = self.bucket_index(seconds)
         with self._lock:
             self.buckets[idx] = self.buckets.get(idx, 0) + 1
             self.count += 1
             self.sum_seconds += seconds
             if seconds > self.max_seconds:
                 self.max_seconds = seconds
+            if exemplar is not None:
+                # raw seconds, never rounded: the exemplar's value must
+                # re-derive the SAME bucket index (fuzz target #23 checks)
+                self.exemplars[idx] = [str(exemplar), seconds]
 
     def merge_from(self, other: "LatencyHistogram") -> None:
         with other._lock:
             snap = (dict(other.buckets), other.count, other.sum_seconds,
-                    other.max_seconds)
+                    other.max_seconds, dict(other.exemplars))
         self._merge_snap(*snap)
 
-    def _merge_snap(self, buckets, count, sum_s, max_s) -> None:
+    def _merge_snap(self, buckets, count, sum_s, max_s,
+                    exemplars=None) -> None:
         with self._lock:
             for i, n in buckets.items():
                 self.buckets[i] = self.buckets.get(i, 0) + n
             self.count += count
             self.sum_seconds += sum_s
             self.max_seconds = max(self.max_seconds, max_s)
+            for i, ex in (exemplars or {}).items():
+                self.exemplars[i] = list(ex)
 
     def quantile(self, q: float) -> float:
         """Approximate quantile in seconds (geometric bucket midpoint)."""
@@ -226,7 +253,9 @@ class LatencyHistogram:
         with self._lock:
             buckets = {str(i): n for i, n in sorted(self.buckets.items())}
             count, sum_s, max_s = self.count, self.sum_seconds, self.max_seconds
-        return {
+            exemplars = {str(i): [ex[0], ex[1]]
+                         for i, ex in sorted(self.exemplars.items())}
+        out = {
             "count": count,
             "sum_seconds": round(sum_s, 6),
             "max_seconds": round(max_s, 6),
@@ -234,13 +263,25 @@ class LatencyHistogram:
             "p95_seconds": round(self.quantile(0.95), 9),
             "buckets": buckets,
         }
+        if exemplars:
+            # only when present: exemplar-free histograms keep the exact
+            # key set the golden tests pin, and the round-trip below holds
+            out["exemplars"] = exemplars
+        return out
+
+    @staticmethod
+    def _parse_exemplars(d: dict) -> dict:
+        return {int(i): [str(ex[0]), float(ex[1])]
+                for i, ex in (d.get("exemplars") or {}).items()
+                if isinstance(ex, (list, tuple)) and len(ex) == 2}
 
     @classmethod
     def from_dict(cls, d: dict) -> "LatencyHistogram":
         h = cls()
         h._merge_snap({int(i): int(n) for i, n in d.get("buckets", {}).items()},
                       int(d.get("count", 0)), float(d.get("sum_seconds", 0.0)),
-                      float(d.get("max_seconds", 0.0)))
+                      float(d.get("max_seconds", 0.0)),
+                      cls._parse_exemplars(d))
         return h
 
     def merge_dict(self, d: dict) -> None:
@@ -248,7 +289,327 @@ class LatencyHistogram:
         self._merge_snap(
             {int(i): int(n) for i, n in d.get("buckets", {}).items()},
             int(d.get("count", 0)), float(d.get("sum_seconds", 0.0)),
-            float(d.get("max_seconds", 0.0)))
+            float(d.get("max_seconds", 0.0)), self._parse_exemplars(d))
+
+
+# ---------------------------------------------------------------------------
+# request tracing: per-request span trees + tail sampling
+# ---------------------------------------------------------------------------
+
+# version of the retained-trace document (`RequestTrace.as_dict`,
+# `TailSampler.dump`) — `pq_tool trace --request` keys on it
+TRACE_VERSION = 1
+
+# process-unique trace-id minting: a random base per process plus a
+# counter, so ids stay unique across services in one process and collide
+# across processes only with 2^-32 probability
+_trace_lock = threading.Lock()
+_trace_base = os.urandom(4).hex()
+_trace_seq = 0
+
+
+def _mint_trace_id() -> str:
+    global _trace_seq
+    with _trace_lock:
+        _trace_seq += 1
+        return f"{_trace_base}-{_trace_seq:06x}"
+
+
+class _TraceSpan:
+    """Span context manager for :class:`RequestTrace` (slots, one lock
+    round-trip per open and per close)."""
+
+    __slots__ = ("_tr", "_idx")
+
+    def __init__(self, tr, idx):
+        self._tr = tr
+        self._idx = idx
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, tp, val, tb):
+        self._tr._close(self._idx, val)
+        return False
+
+
+class RequestTrace:
+    """One request's span tree: allocation-light, always on, completed for
+    EVERY request so the tail sampler can decide afterwards (Dapper-style
+    tail sampling needs the whole tree in hand at the decision point).
+
+    Spans are small lists ``[name, t0_rel, dur, parent, args]`` appended at
+    OPEN time, so a parent's index is always smaller than its children's
+    (the well-nestedness invariant fuzz target #23 checks).  Nesting is
+    per-thread: each thread keeps its own open-span stack, and the first
+    span a helper thread opens parents to the top-level (the producer /
+    prefetch-worker / fetch-engine spans hang off the request root without
+    cross-thread stack corruption).  A ``max_spans`` cap bounds memory per
+    request (``TPQ_TRACE_SPANS``); drops are counted, never silent.
+    """
+
+    __slots__ = ("trace_id", "t0", "t0_unix", "duration_s", "spans",
+                 "max_spans", "dropped", "error", "flags", "_lock", "_local")
+
+    def __init__(self, trace_id: "str | None" = None,
+                 max_spans: "int | None" = None):
+        if max_spans is None:
+            max_spans = env_int("TPQ_TRACE_SPANS", 512, lo=1)
+        self.trace_id = trace_id or _mint_trace_id()
+        self.t0 = time.perf_counter()
+        self.t0_unix = time.time()
+        self.duration_s: "float | None" = None
+        self.spans: list = []  # [name, t0_rel, dur, parent, args]
+        self.max_spans = int(max_spans)
+        self.dropped = 0
+        self.error: "dict | None" = None
+        self.flags: set = set()
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    # -- recording ------------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def span(self, name: str, **args):
+        """Open a nested span (context manager).  Over the cap: counted
+        drop, shared no-op."""
+        st = self._stack()
+        parent = st[-1] if st else -1
+        with self._lock:
+            if len(self.spans) >= self.max_spans:
+                self.dropped += 1
+                return _NULL_SPAN
+            idx = len(self.spans)
+            self.spans.append([name, time.perf_counter() - self.t0, None,
+                               parent, args or None])
+        st.append(idx)
+        return _TraceSpan(self, idx)
+
+    def _close(self, idx: int, exc) -> None:
+        now = time.perf_counter() - self.t0
+        st = self._stack()
+        # pop through idx: an interleaved close (fuzzed op streams) closes
+        # the children it skipped, keeping every retained tree well-nested
+        while st and st[-1] >= idx:
+            st.pop()
+        with self._lock:
+            s = self.spans[idx]
+            if s[2] is None:
+                s[2] = max(now - s[1], 0.0)
+            if exc is not None:
+                args = s[4] or {}
+                args["error"] = type(exc).__name__
+                s[4] = args
+
+    def add_timed(self, name: str, t0: float, t1: float, **args) -> None:
+        """Record an already-timed interval (perf_counter seconds) as a
+        closed child of the current thread's open span."""
+        st = self._stack()
+        parent = st[-1] if st else -1
+        with self._lock:
+            if len(self.spans) >= self.max_spans:
+                self.dropped += 1
+                return
+            self.spans.append([name, t0 - self.t0, max(t1 - t0, 0.0),
+                               parent, args or None])
+
+    def annotate(self, idx: "int | None" = None, **kv) -> None:
+        """Attach facts to a span (default: the current thread's open
+        one) — retry counts, hedge outcomes, byte sizes."""
+        st = self._stack()
+        if idx is None:
+            idx = st[-1] if st else None
+        if idx is None:
+            return
+        with self._lock:
+            if 0 <= idx < len(self.spans):
+                s = self.spans[idx]
+                args = s[4] or {}
+                args.update(kv)
+                s[4] = args
+
+    def mark_error(self, exc: BaseException) -> None:
+        with self._lock:
+            self.error = {"type": type(exc).__name__,
+                          "message": str(exc)[:300]}
+
+    def set_flag(self, flag: str) -> None:
+        """Request-level outcome flags the sampler keys on
+        (``deadline``, ``shed``, ``cancelled``)."""
+        with self._lock:
+            self.flags.add(str(flag))
+
+    def finish(self) -> float:
+        """Close the tree (idempotent); returns the request duration."""
+        with self._lock:
+            if self.duration_s is None:
+                self.duration_s = time.perf_counter() - self.t0
+            # close any span left open by an abandoned thread: a retained
+            # tree never carries null durations
+            for s in self.spans:
+                if s[2] is None:
+                    s[2] = max((self.t0 + self.duration_s)
+                               - (self.t0 + s[1]), 0.0)
+            return self.duration_s
+
+    # -- export ---------------------------------------------------------------
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            spans = [{
+                "name": s[0],
+                "t_s": round(s[1], 6),
+                "dur_s": round(s[2], 6) if s[2] is not None else None,
+                "parent": s[3],
+                **({"args": s[4]} if s[4] else {}),
+            } for s in self.spans]
+            return {
+                "trace_version": TRACE_VERSION,
+                "trace_id": self.trace_id,
+                "t0_unix": round(self.t0_unix, 3),
+                "duration_s": (round(self.duration_s, 6)
+                               if self.duration_s is not None else None),
+                "error": self.error,
+                "flags": sorted(self.flags),
+                "dropped": self.dropped,
+                "spans": spans,
+            }
+
+
+# the request trace of the thread currently executing a request — how code
+# with no token in hand (plan/result cache probes, device dispatch) finds
+# the trace; serve workers and stream producers set/restore it per unit
+_req_local = threading.local()
+
+
+def current_request_trace() -> "RequestTrace | None":
+    return getattr(_req_local, "trace", None)
+
+
+def set_request_trace(trace: "RequestTrace | None"):
+    """Install ``trace`` as this thread's current request trace; returns
+    the previous one (callers restore it, nesting-safe)."""
+    prev = getattr(_req_local, "trace", None)
+    _req_local.trace = trace
+    return prev
+
+
+class TailSampler:
+    """Tail sampler + bounded retained-trace ring.
+
+    Every request's completed tree is ``offer()``-ed with its outcome; the
+    sampler RETAINS the interesting ones — errored, deadline-exceeded,
+    brownout-shed, slower than a rolling quantile of its own traffic
+    (``TPQ_TRACE_SLOW_Q`` over an internal :class:`LatencyHistogram`), or
+    1-in-N (``TPQ_TRACE_TAIL``; 1 retains everything, 0 disables request
+    tracing entirely) — serialized into a ring bounded by BYTES
+    (``TPQ_TRACE_RING``), evicting oldest-first.  ``offer`` returns whether
+    the trace was retained, so exemplars only ever name a trace that can
+    actually be fetched back (``get``/``dump`` → ``pq_tool trace
+    --request``).
+    """
+
+    # the rolling-quantile gate needs this many samples before "slow" means
+    # anything; below it only errors/flags/1-in-N retain
+    SLOW_MIN_SAMPLES = 32
+
+    def __init__(self, one_in_n: "int | None" = None,
+                 ring_bytes: "int | None" = None,
+                 slow_q: "float | None" = None):
+        if one_in_n is None:
+            one_in_n = env_int("TPQ_TRACE_TAIL", 128, lo=0)
+        if ring_bytes is None:
+            ring_bytes = env_int("TPQ_TRACE_RING", 1 << 20, lo=4096)
+        if slow_q is None:
+            slow_q = env_float("TPQ_TRACE_SLOW_Q", 0.95, lo=0.5, hi=0.9999)
+        self.one_in_n = int(one_in_n)
+        self.ring_bytes = int(ring_bytes)
+        self.slow_q = float(slow_q)
+        self._lock = threading.Lock()
+        self._ring: "deque[tuple[str, bytes]]" = deque()
+        self._index: dict[str, bytes] = {}
+        self._hist = LatencyHistogram()
+        self.offered = 0
+        self.retained = 0
+        self.evicted = 0
+        self.retained_bytes = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.one_in_n > 0
+
+    def offer(self, trace: RequestTrace, duration_s: "float | None" = None,
+              error: bool = False) -> bool:
+        """Decide on a completed trace; retain interesting ones.  Returns
+        True iff retained (the exemplar gate)."""
+        if not self.enabled:
+            return False
+        dur = trace.finish() if duration_s is None else float(duration_s)
+        slow_bar = None
+        if self._hist.count >= self.SLOW_MIN_SAMPLES:
+            slow_bar = self._hist.quantile(self.slow_q)
+        self._hist.record(dur)
+        with self._lock:
+            self.offered += 1
+            keep = (error or trace.error is not None or bool(trace.flags)
+                    or (slow_bar is not None and dur >= slow_bar)
+                    or self.offered % self.one_in_n == 0)
+        if not keep:
+            return False
+        blob = json.dumps(trace.as_dict(), default=repr).encode()
+        with self._lock:
+            if len(blob) > self.ring_bytes:
+                return False  # one pathological tree must not flush the ring
+            self._ring.append((trace.trace_id, blob))
+            self._index[trace.trace_id] = blob
+            self.retained += 1
+            self.retained_bytes += len(blob)
+            while self.retained_bytes > self.ring_bytes and len(self._ring) > 1:
+                old_id, old = self._ring.popleft()
+                self.retained_bytes -= len(old)
+                self.evicted += 1
+                if self._index.get(old_id) is old:
+                    del self._index[old_id]
+        return True
+
+    def get(self, trace_id: str) -> "dict | None":
+        with self._lock:
+            blob = self._index.get(trace_id)
+        return json.loads(blob) if blob is not None else None
+
+    def traces(self) -> "list[dict]":
+        with self._lock:
+            blobs = [b for _, b in self._ring]
+        return [json.loads(b) for b in blobs]
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "offered": self.offered,
+                "retained": self.retained,
+                "evicted": self.evicted,
+                "retained_bytes": self.retained_bytes,
+                "ring_capacity_bytes": self.ring_bytes,
+            }
+
+    def dump(self, path: str) -> str:
+        """Write the retained traces (versioned; the ``pq_tool trace
+        --request`` input).  Same mkdir-parents contract as Tracer.write."""
+        doc = {"trace_dump_version": TRACE_VERSION, "traces": self.traces()}
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
 
 
 # ---------------------------------------------------------------------------
@@ -1523,6 +1884,223 @@ class StatsRegistry:
             tree["reader"]["ship_feedback"] = self.ship_feedback()
         return tree
 
+    def render_openmetrics(self) -> str:
+        """OpenMetrics text exposition of the live tree (see
+        :func:`render_openmetrics`)."""
+        return render_openmetrics(self.as_dict())
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics export: text exposition + periodic snapshot dumper
+# ---------------------------------------------------------------------------
+
+def _om_name(*parts) -> str:
+    """A legal OpenMetrics metric name from tree-path parts."""
+    name = "_".join(str(p) for p in parts if p not in (None, ""))
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isascii() and (ch.isalnum() or ch == "_"))
+                   else "_")
+    name = "".join(out) or "_"
+    return name if not name[0].isdigit() else f"_{name}"
+
+
+def _om_escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _om_num(v) -> str:
+    # integral floats render as ints: counter samples read naturally and
+    # snapshots diff cleanly
+    if isinstance(v, float) and not v.is_integer():
+        return repr(v)
+    return str(int(v))
+
+
+def _om_walk(lines: list, prefix: "tuple", tree: dict) -> None:
+    for k, v in sorted(tree.items()):
+        if isinstance(v, dict):
+            _om_walk(lines, prefix + (k,), v)
+        elif isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        else:
+            name = _om_name("tpq", *prefix, k)
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_om_num(v)}")
+
+
+def render_openmetrics(tree: dict) -> str:
+    """Render a registry tree (``StatsRegistry.as_dict`` form) as an
+    OpenMetrics text exposition: every numeric leaf as a gauge
+    ``tpq_<section>_<path>``, every histogram as a cumulative-``le``
+    bucket family with ``_sum``/``_count`` — and, where a bucket carries a
+    retained-trace exemplar, the OpenMetrics exemplar suffix
+    ``# {trace_id="..."} value`` that lets a dashboard jump from a bucket
+    straight to ``pq_tool trace --request``.  Ends with ``# EOF``.
+    """
+    if not isinstance(tree, dict):
+        raise ValueError("not a registry tree")
+    lines: list[str] = []
+    for section in ("pipeline", "reader", "loader", "io", "data_errors",
+                    "device", "serve", "cache", "write", "alloc"):
+        sub = tree.get(section)
+        if isinstance(sub, dict):
+            sub = dict(sub)
+            sub.pop("ship_feedback", None)  # ratios with nulls, not samples
+            _om_walk(lines, (section,), sub)
+    for hname, hd in sorted((tree.get("histograms") or {}).items()):
+        if not isinstance(hd, dict):
+            continue
+        name = _om_name("tpq", hname, "seconds")
+        lines.append(f"# TYPE {name} histogram")
+        exemplars = hd.get("exemplars") or {}
+        cum = 0
+        for i in sorted(int(k) for k in (hd.get("buckets") or {})):
+            cum += int(hd["buckets"][str(i)])
+            le = LatencyHistogram.bucket_upper_seconds(i)
+            line = f'{name}_bucket{{le="{le!r}"}} {cum}'
+            ex = exemplars.get(str(i))
+            if isinstance(ex, (list, tuple)) and len(ex) == 2:
+                line += (f' # {{trace_id="{_om_escape(ex[0])}"}}'
+                         f" {float(ex[1])!r}")
+            lines.append(line)
+        lines.append(f'{name}_bucket{{le="+Inf"}} {int(hd.get("count", 0))}')
+        lines.append(f'{name}_sum {float(hd.get("sum_seconds", 0.0))!r}')
+        lines.append(f'{name}_count {int(hd.get("count", 0))}')
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def diff_registry_trees(old: dict, new: dict) -> dict:
+    """Numeric-leaf deltas between two registry snapshots (``pq_tool
+    metrics A B`` / ``--watch``): ``{dotted.path: (old, new, delta)}`` for
+    every leaf that changed, sections and histograms alike."""
+
+    def leaves(tree, prefix, out):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                leaves(v, f"{prefix}.{k}" if prefix else str(k), out)
+        elif isinstance(tree, (int, float)) and not isinstance(tree, bool):
+            out[prefix] = tree
+
+    a: dict = {}
+    b: dict = {}
+    leaves(old, "", a)
+    leaves(new, "", b)
+    out = {}
+    for key in sorted(set(a) | set(b)):
+        va, vb = a.get(key, 0), b.get(key, 0)
+        if va != vb:
+            out[key] = (va, vb, vb - va)
+    return out
+
+
+def resolve_metrics_dump(spec: "str | None" = None):
+    """Parse a ``path:interval_s`` metrics-dump spec (default:
+    ``TPQ_METRICS_DUMP``).  Returns ``(path, interval_s)`` or ``None``;
+    malformed values degrade with one :func:`warn_env_once` line, never
+    raise (the env-knob contract)."""
+    raw = os.environ.get("TPQ_METRICS_DUMP", "") if spec is None else spec
+    if not raw:
+        return None
+    path, sep, interval = raw.rpartition(":")
+    if not sep or not path:
+        warn_env_once("TPQ_METRICS_DUMP", raw, None)
+        return None
+    try:
+        iv = float(interval)
+    except (TypeError, ValueError):
+        warn_env_once("TPQ_METRICS_DUMP", raw, None)
+        return None
+    if iv <= 0:
+        warn_env_once("TPQ_METRICS_DUMP", raw, None)
+        return None
+    return path, iv
+
+
+class MetricsDumper:
+    """Daemon thread writing periodic registry snapshots to disk
+    (``TPQ_METRICS_DUMP=path:interval_s``) — the live scrape surface
+    ``pq_tool metrics --watch`` polls.
+
+    ``source`` is a zero-arg callable returning a :class:`StatsRegistry`
+    or an ``as_dict`` tree; each tick writes the JSON tree atomically
+    (tmp + ``os.replace`` — a watcher never reads a torn file).  Lifecycle
+    discipline matches :class:`Sampler`: inert when the spec is unset or
+    malformed, ``stop()`` joins (and writes one final snapshot so the file
+    ends at the end state), the thread is a daemon, and a failing source
+    or write is counted, never raised.
+    """
+
+    def __init__(self, source, spec: "str | None" = None,
+                 name: str = "tpq-metricsdump"):
+        self.source = source
+        parsed = resolve_metrics_dump(spec)
+        self.path, self.interval_s = parsed if parsed else (None, 0.0)
+        self.name = name
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self.written = 0
+        self.dropped = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.path is not None and self.interval_s > 0
+
+    def start(self) -> "MetricsDumper":
+        if not self.enabled or self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name=self.name,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Idempotent; joins the dumper thread (no leak, bench-gated)."""
+        t = self._thread
+        if t is None:
+            return
+        self._stop.set()
+        t.join(timeout=10.0)
+        self._thread = None
+
+    def __enter__(self) -> "MetricsDumper":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _run(self) -> None:
+        while True:
+            stopping = self._stop.wait(self.interval_s)
+            self.dump_once()
+            if stopping:
+                return
+
+    def dump_once(self) -> "str | None":
+        if self.path is None:
+            return None
+        try:
+            tree = self.source()
+            if isinstance(tree, StatsRegistry):
+                tree = tree.as_dict()
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(tree, f, default=repr)
+                f.write("\n")
+            os.replace(tmp, self.path)
+            self.written += 1
+            return self.path
+        except Exception:  # noqa: BLE001 — metrics export never takes the run down
+            self.dropped += 1
+            return None
+
 
 # ---------------------------------------------------------------------------
 # trace summarization (the pq_tool backend)
@@ -1714,6 +2292,70 @@ IO_CONC_QUEUE_WAIT_RATIO = 2.0
 OVERLOAD_MIN_REJECTS = 4
 
 
+def _slo_burn_block(serve: dict, tree: dict) -> "dict | None":
+    """The ``slo-burn`` verdict: a tenant whose measured p99 (its
+    ``serve.tenant.<name>`` histogram) exceeds its declared ``slo_p99_ms``.
+    Names the worst offender (largest p99/SLO ratio), the offending
+    bucket, and — when the tail sampler linked one — the exemplar trace id
+    that turns the bad percentile into a ``pq_tool trace --request``."""
+    tens = {n: t for n, t in (serve.get("tenants") or {}).items()
+            if isinstance(t, dict)}
+    hists = tree.get("histograms")
+    hists = hists if isinstance(hists, dict) else {}
+    burns = []
+    for name, t in sorted(tens.items()):
+        slo_ms = t.get("slo_p99_ms")
+        slo_ms = float(slo_ms) if isinstance(slo_ms, (int, float)) else 0.0
+        hd = hists.get(f"serve.tenant.{name}")
+        if slo_ms <= 0 or not isinstance(hd, dict) or not hd.get("count"):
+            continue
+        p99 = LatencyHistogram.from_dict(hd).quantile(0.99)
+        if p99 * 1e3 <= slo_ms:
+            continue
+        # the offending bucket: the slowest populated bucket at/above the
+        # SLO bound — where the burn actually lives (never the fast body)
+        slo_idx = LatencyHistogram.bucket_index(slo_ms / 1e3)
+        pop = [i for i, n in ((int(k), int(v))
+                              for k, v in (hd.get("buckets") or {}).items())
+               if n > 0]
+        over = [i for i in pop if i >= slo_idx]
+        bucket = max(over) if over else (max(pop) if pop else 0)
+        ex = (hd.get("exemplars") or {}).get(str(bucket))
+        burns.append({
+            "tenant": name,
+            "slo_p99_ms": round(slo_ms, 3),
+            "p99_ms": round(p99 * 1e3, 3),
+            "burn_ratio": round(p99 * 1e3 / slo_ms, 3),
+            "bucket": bucket,
+            "bucket_le_s": round(
+                LatencyHistogram.bucket_upper_seconds(bucket), 9),
+            "exemplar_trace": (str(ex[0])
+                               if isinstance(ex, (list, tuple)) and ex
+                               else None),
+            "exemplar_value_s": (round(float(ex[1]), 6)
+                                 if isinstance(ex, (list, tuple))
+                                 and len(ex) == 2 else None),
+        })
+    if not burns:
+        return None
+    burns.sort(key=lambda b: (-b["burn_ratio"], b["tenant"]))
+    worst = burns[0]
+    ex_hint = (f"; pq_tool trace --request {worst['exemplar_trace']} "
+               f"prints the retained trace"
+               if worst["exemplar_trace"] else
+               "; no exemplar retained yet (raise sampling: TPQ_TRACE_TAIL)")
+    return {
+        "verdict": "slo-burn",
+        **worst,
+        "burning_tenants": [b["tenant"] for b in burns],
+        "advice": (
+            f"tenant '{worst['tenant']}' p99 {worst['p99_ms']:g}ms exceeds "
+            f"its {worst['slo_p99_ms']:g}ms SLO ({worst['burn_ratio']}x); "
+            f"the burn sits in bucket {worst['bucket']} "
+            f"(<= {worst['bucket_le_s']:g}s){ex_hint}"),
+    }
+
+
 def doctor_registry(tree: dict) -> "dict | None":
     """Attribute a run's bottleneck from its registry tree (rule-based).
 
@@ -1798,11 +2440,13 @@ def doctor_registry(tree: dict) -> "dict | None":
     _sheds = _sheds if isinstance(_sheds, dict) else {}
     overload_pressure = (g(serve, "rejected") + g(_sheds, "low")
                          + g(_sheds, "normal"))
+    slo_burn = _slo_burn_block(serve, tree)
     if total <= 0 and wr_total <= 0:
         # no decode/write lane ran — but a service rejecting work IS
         # evidence: an overload where nothing got far enough to decode is
-        # exactly when the operator reaches for doctor
-        if overload_pressure < OVERLOAD_MIN_REJECTS:
+        # exactly when the operator reaches for doctor, and a tenant
+        # burning its SLO is evidence the same way
+        if overload_pressure < OVERLOAD_MIN_REJECTS and slo_burn is None:
             return None
     out: dict = {}
     if total > 0:
@@ -1948,6 +2592,8 @@ def doctor_registry(tree: dict) -> "dict | None":
                 if offender else
                 "raise queue_depth/max_memory or shed earlier"),
         }
+    if slo_burn is not None:
+        out["slo_burn"] = slo_burn
     io_sec = tree.get("io")
     io_sec = io_sec if isinstance(io_sec, dict) else {}
     hedges_issued = g(io_sec, "hedges_issued")

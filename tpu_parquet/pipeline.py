@@ -360,11 +360,17 @@ def prefetch_map(
     ``prefetch <= 0`` degrades to a plain sequential map with zero threads —
     the bit-identical baseline the tests compare against.
     """
+    trace = getattr(cancel, "trace", None) if cancel is not None else None
     if prefetch <= 0:
         for item in items:
             if cancel is not None:
                 cancel.check()
-            yield fn(item)
+            if trace is None:
+                yield fn(item)
+            else:
+                with trace.span("decode"):
+                    res = fn(item)
+                yield res
         return
 
     def run(item):
@@ -373,7 +379,12 @@ def prefetch_map(
         # BEFORE the future carries the exception back — the consumer may
         # be blocked elsewhere and never surface it promptly
         try:
-            return fn(item)
+            if trace is None:
+                return fn(item)
+            # one request-trace span per decoded unit, on the worker
+            # thread (per-thread nesting parents it to the request root)
+            with trace.span("decode"):
+                return fn(item)
         except BaseException as e:
             note_worker_crash(e)
             raise

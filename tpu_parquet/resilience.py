@@ -67,7 +67,8 @@ class CancelToken:
     token's ``check()`` is two attribute reads.
     """
 
-    __slots__ = ("deadline", "deadline_s", "_exc", "_lock", "_callbacks")
+    __slots__ = ("deadline", "deadline_s", "_exc", "_lock", "_callbacks",
+                 "trace")
 
     def __init__(self, deadline: "float | None" = None,
                  deadline_s: "float | None" = None):
@@ -78,6 +79,11 @@ class CancelToken:
         self._exc: "BaseException | None" = None
         self._lock = threading.Lock()
         self._callbacks: "list | None" = None
+        # the request's RequestTrace rides the token — it already flows
+        # from the serve tier through readers, prefetch workers, and both
+        # iostores, so span sites guard on `token.trace is not None` and
+        # pay nothing when tracing is off
+        self.trace = None
 
     @classmethod
     def with_timeout(cls, seconds: "float | None") -> "CancelToken":

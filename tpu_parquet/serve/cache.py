@@ -31,11 +31,12 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 
 from ..footer import read_file_metadata
 from ..iostore import ByteStore
-from ..obs import env_int
+from ..obs import current_request_trace, env_int
 
 __all__ = ["PlanCache", "BoundDictCache", "CacheStats"]
 
@@ -145,6 +146,21 @@ class PlanCache:
         per key runs (one miss counted); concurrent callers wait on the
         build lock and count as hits.  ``build()`` returns
         ``(value, nbytes)``."""
+        tr = current_request_trace()
+        if tr is None:
+            return self._read_through_inner(kind, key, build)
+        t0 = time.perf_counter()
+        misses0 = self.stats.misses[kind]
+        try:
+            return self._read_through_inner(kind, key, build)
+        finally:
+            # best-effort hit attribution: under concurrent traffic a
+            # neighbor's miss can tick between our two reads, but a probe
+            # span is evidence, not accounting
+            tr.add_timed(f"cache_{kind}", t0, time.perf_counter(),
+                         hit=self.stats.misses[kind] == misses0)
+
+    def _read_through_inner(self, kind: str, key: tuple, build):
         full = (kind, *key)
         with self._lock:
             hit = self._entries.get(full)

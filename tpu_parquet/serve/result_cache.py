@@ -50,10 +50,11 @@ by the plan cache's budget when no result budget is set.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 
 from ..alloc import AllocTracker
-from ..obs import env_int, register_flight_source
+from ..obs import current_request_trace, env_int, register_flight_source
 
 __all__ = ["BoundResultCache", "ResultCache", "ResultTierStats",
            "decode_signature", "column_nbytes", "device_column_nbytes"]
@@ -626,8 +627,13 @@ class BoundResultCache:
         miss per column on failure (the group will decode that many
         units); returns ``{column: value}`` or None."""
         cols = list(columns)
+        tr = current_request_trace()
+        t0 = time.perf_counter() if tr is not None else 0.0
         got = self.cache.lookup_units([self._full(rg, c) for c in cols],
                                       count_misses=True)
+        if tr is not None:
+            tr.add_timed("result_probe", t0, time.perf_counter(), rg=rg,
+                         columns=len(cols), hit=got is not None)
         if got is None:
             return None
         return {c: v for c, (v, _n) in zip(cols, got)}

@@ -39,13 +39,15 @@ import threading
 import time
 import weakref
 from collections.abc import Mapping
+from contextlib import nullcontext
 
 from ..alloc import InFlightBudget
 from ..errors import (CancelledError, DeadlineExceededError, HangError,
                       OverloadError, ParquetError, RetryExhaustedError,
                       TransientIOError)
-from ..obs import (LatencyHistogram, env_float, env_int,
-                   register_flight_source, resolve_hang_s)
+from ..obs import (LatencyHistogram, MetricsDumper, RequestTrace,
+                   TailSampler, env_float, env_int, register_flight_source,
+                   resolve_hang_s, set_request_trace)
 from ..resilience import BreakerBoard, CancelToken
 from .cache import BoundDictCache, PlanCache
 from .stream import (StreamingScan, check_cursor_compatible, request_digest,
@@ -69,6 +71,12 @@ _CLASSIFIED_FAILURES = (RetryExhaustedError, TransientIOError, ParquetError,
                         HangError)
 
 _req_ids = itertools.count(1)
+
+
+def _span(trace, name, **args):
+    """A RequestTrace span, or a no-op when the request carries no trace
+    (tracing off) — keeps the instrumented call sites branch-free."""
+    return trace.span(name, **args) if trace is not None else nullcontext()
 
 
 def _count_rows(result: dict) -> int:
@@ -350,6 +358,16 @@ class ScanService:
         self._hist_wait = LatencyHistogram()
         self._hist_exec = LatencyHistogram()
         self._hist_total = LatencyHistogram()
+        # request tracing: every admitted request carries a RequestTrace on
+        # its cancel token; the tail sampler keeps the interesting trees
+        # (slow / errored / deadline / shed / 1-in-N) in a byte-bounded
+        # ring.  Per-instance (env-tuned at construction) so one test's or
+        # service's retention never bleeds into another's.
+        self.sampler = TailSampler()
+        # periodic registry snapshots (TPQ_METRICS_DUMP=path:interval_s) —
+        # the file `pq_tool metrics --watch` polls; inert when unset
+        self._dumper = MetricsDumper(self.obs_registry)
+        self._dumper.start()
         self._inflight: dict = {}  # rid -> (path0, t_start)
         self._inflight_lock = threading.Lock()
         self._closed = False
@@ -462,6 +480,18 @@ class ScanService:
                     else tenant.deadline_s)
         ticket = ScanTicket(next(_req_ids),
                             CancelToken.with_timeout(deadline))
+        if self.sampler.enabled:
+            # the trace rides the cancel token into every downstream layer
+            # (readers, prefetch pipeline, iostore, device dispatch); the
+            # zero-length "submit" span carries the request's identity
+            trace = RequestTrace()
+            t_sub = time.perf_counter()
+            trace.add_timed("submit", t_sub, t_sub, request=ticket.id,
+                            tenant=tenant.name, paths=len(request.paths),
+                            stream=bool(request.stream),
+                            device=bool(request.device),
+                            priority=int(request.priority))
+            ticket.token.trace = trace
         self._maybe_shed(request, tenant)
         session = None
         if request.stream:
@@ -534,6 +564,14 @@ class ScanService:
             wait = t_start - t_submit
             ticket.queue_wait_s = wait
             self._hist_wait.record(wait)
+            trace = getattr(ticket.token, "trace", None)
+            prev_trace = None
+            if trace is not None:
+                trace.add_timed("queue_wait", t_submit, t_start)
+                # install as this worker thread's request trace: cache
+                # probes and device dispatch deep in the call tree find it
+                # without a token in hand
+                prev_trace = set_request_trace(trace)
             first = request.paths[0] if request.paths else None
             with self._inflight_lock:
                 self._inflight[ticket.id] = (str(first), t_start)
@@ -560,10 +598,28 @@ class ScanService:
             # never a zero the worker hadn't written yet
             t_end = time.perf_counter()
             ticket.exec_s = t_end - t_start
-            self._hist_exec.record(ticket.exec_s)
-            self._hist_total.record(t_end - t_submit)
+            retained = False
+            if trace is not None:
+                set_request_trace(prev_trace)
+                if exc is not None:
+                    trace.mark_error(exc)
+                    if isinstance(exc, DeadlineExceededError):
+                        trace.set_flag("deadline")
+                    elif isinstance(exc, CancelledError):
+                        trace.set_flag("cancelled")
+                    elif isinstance(exc, OverloadError):
+                        trace.set_flag("shed")
+                trace.finish()
+                retained = self.sampler.offer(trace,
+                                              duration_s=t_end - t_submit,
+                                              error=exc is not None)
+            # exemplars only name RETAINED traces — a percentile's example
+            # must be fetchable back via `pq_tool trace --request`
+            ex = trace.trace_id if retained else None
+            self._hist_exec.record(ticket.exec_s, exemplar=ex)
+            self._hist_total.record(t_end - t_submit, exemplar=ex)
             if tenant is not None:
-                tenant.hist.record(t_end - t_submit)
+                tenant.hist.record(t_end - t_submit, exemplar=ex)
             with self._inflight_lock:
                 self._inflight.pop(ticket.id, None)
                 self._streams.pop(ticket.id, None)
@@ -582,6 +638,8 @@ class ScanService:
             with tenant.lock:
                 tenant.queue_wait_seconds += wait
                 tenant.exec_seconds += ticket.exec_s
+                if retained:
+                    tenant.traces_retained += 1
                 if exc is not None:
                     tenant.failed += 1
                 else:
@@ -661,6 +719,7 @@ class ScanService:
 
         pred = self._resolve_filter(request)
         tenant = self.tenants.get(request.tenant)
+        trace = getattr(token, "trace", None) if token is not None else None
         out: dict = {}
         for path in request.paths:
             if token is not None:
@@ -680,17 +739,24 @@ class ScanService:
                 rcache = self.cache.bind_results(
                     key, plan, row_filter=pred, device=request.device,
                     validate_crc=vcrc, tenant=tenant.name)
-                served = (self._serve_from_cache(rcache, plan, request,
-                                                 token, tenant)
-                          if rcache is not None else None)
+                with _span(trace, "cache_probe", path=str(path)):
+                    served = (self._serve_from_cache(rcache, plan, request,
+                                                     token, tenant)
+                              if rcache is not None else None)
+                    if trace is not None:
+                        trace.annotate(hit=served is not None)
                 if served is not None:
                     # pure cache hit: no reader, no store, no device
                     # dispatch — the file's breaker still notes the success
                     out[str(path)] = served
                     self.breakers.note(bkey, str(path), ok=True)
                     continue
-                charges = self._charge_stream(tenant,
-                                              plan.estimated_bytes(), token)
+                # admission wait: the budget acquire is where a request
+                # blocks behind its tenant's slice or the global pool
+                with _span(trace, "admission",
+                           estimated_bytes=plan.estimated_bytes()):
+                    charges = self._charge_stream(
+                        tenant, plan.estimated_bytes(), token)
                 try:
                     kw = dict(columns=request.columns, metadata=meta,
                               row_filter=pred, prefetch=request.prefetch,
@@ -699,21 +765,25 @@ class ScanService:
                               dict_cache=BoundDictCache(self.cache, key),
                               result_cache=rcache,
                               cancel=token)
-                    if request.device:
-                        from ..device_reader import DeviceFileReader
+                    with _span(trace, "read", path=str(path),
+                               device=request.device):
+                        if request.device:
+                            from ..device_reader import DeviceFileReader
 
-                        with DeviceFileReader(path, hang_s=self._hang_s,
-                                              **kw) as r:
-                            cols: dict = {}
-                            for group in r.iter_row_groups():
-                                for name, cd in group.items():
-                                    cols.setdefault(name, []).append(cd)
-                            out[str(path)] = {
-                                name: parts[0] if len(parts) == 1 else parts
-                                for name, parts in cols.items()}
-                    else:
-                        with FileReader(path, **kw) as r:
-                            out[str(path)] = self._read_watched(r)
+                            with DeviceFileReader(path, hang_s=self._hang_s,
+                                                  **kw) as r:
+                                cols: dict = {}
+                                for group in r.iter_row_groups():
+                                    for name, cd in group.items():
+                                        cols.setdefault(name,
+                                                        []).append(cd)
+                                out[str(path)] = {
+                                    name: (parts[0] if len(parts) == 1
+                                           else parts)
+                                    for name, parts in cols.items()}
+                        else:
+                            with FileReader(path, **kw) as r:
+                                out[str(path)] = self._read_watched(r)
                 finally:
                     self._release_stream(tenant, charges)
             except _CLASSIFIED_FAILURES:
@@ -839,6 +909,7 @@ class ScanService:
             self._q.put_sentinel()
         for t in self._workers:
             t.join(timeout=60)
+        self._dumper.stop()
 
     def __enter__(self) -> "ScanService":
         return self
@@ -847,6 +918,16 @@ class ScanService:
         self.close()
 
     # -- reporting -------------------------------------------------------------
+
+    def get_trace(self, trace_id: str) -> "dict | None":
+        """A retained trace tree by id (exemplar trace ids resolve here —
+        the ``pq_tool trace --request`` backend)."""
+        return self.sampler.get(trace_id)
+
+    def trace_dump(self, path: str) -> str:
+        """Write every retained trace tree to ``path`` (the versioned
+        dump ``pq_tool trace --request`` reads offline)."""
+        return self.sampler.dump(path)
 
     def sample(self) -> dict:
         """Live admission state (flight dumps + obs.Sampler track): queue
@@ -884,7 +965,8 @@ class ScanService:
             d["cache_held_bytes"] = self.cache.results.tenant_bytes(name)
             tenants[name] = d
         return {**self.stats.as_dict(), "cache": self.cache.counters(),
-                "circuit": self.breakers.counters(), "tenants": tenants}
+                "circuit": self.breakers.counters(), "tenants": tenants,
+                "trace": self.sampler.counters()}
 
     def obs_registry(self):
         """Unified metrics tree: the ``serve`` section, the request

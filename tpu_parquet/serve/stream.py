@@ -45,6 +45,7 @@ import hashlib
 import json
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -419,6 +420,8 @@ class StreamingScan:
         charge its bytes, and buffer it."""
         from ..data.loader import pad_and_mask, ship_to_device
 
+        trace = getattr(token, "trace", None)
+        t0 = time.perf_counter() if trace is not None else 0.0
         batch = pad_and_mask(cols, n, self.batch_rows, mask_key="mask")
         if self.request.device:
             try:
@@ -437,6 +440,12 @@ class StreamingScan:
             self._service._release_stream(self._tenant, charges)
             raise
         self.rows_emitted += n
+        if trace is not None:
+            # closed after the fact: includes assembly + (device) ship +
+            # the buffer wait behind a slow consumer
+            trace.add_timed("batch", t0, time.perf_counter(), rows=n,
+                            nbytes=nbytes, path_index=path_index,
+                            file_done=file_done)
 
     def _produce(self) -> int:
         """The producer loop: per file, per surviving row group, decode
@@ -502,6 +511,7 @@ class StreamingScan:
             pend_cold = False
             consumed = 0   # surviving rows walked (skip arithmetic)
             emitted = skip_rows  # rows delivered so far within this file
+            trace = getattr(token, "trace", None)
             for rg in ordinals:
                 token.check()
                 nr = nrows.get(rg, 0)
@@ -510,6 +520,7 @@ class StreamingScan:
                 if consumed + nr <= skip_rows:
                     consumed += nr  # wholly before the cursor: no decode
                     continue
+                t_g = time.perf_counter() if trace is not None else 0.0
                 got = rcache.lookup_group(rg, columns) \
                     if rcache is not None else None
                 if got is not None:
@@ -529,6 +540,10 @@ class StreamingScan:
                     arrays = {c: _column_rows(group[c], c) for c in columns}
                     self.cold_groups += 1
                     cold = True
+                if trace is not None:
+                    trace.add_timed("group", t_g, time.perf_counter(),
+                                    rg=rg, rows=nr, warm=not cold,
+                                    path=str(path))
                 lens = {len(a) for a in arrays.values()}
                 if len(lens) != 1 or lens != {nr}:
                     raise ParquetError(

@@ -98,7 +98,7 @@ class Tenant:
                  "submitted", "completed", "rejected", "failed",
                  "shed_low", "shed_normal", "queue_wait_seconds",
                  "exec_seconds", "rows", "stream_batches",
-                 "cache_fraction", "deadline_s")
+                 "cache_fraction", "deadline_s", "traces_retained")
 
     def __init__(self, name: str, weight: int = 1,
                  slo_p99_ms: "float | None" = None,
@@ -125,6 +125,9 @@ class Tenant:
         self.exec_seconds = 0.0
         self.rows = 0
         self.stream_batches = 0
+        # requests whose trace the tail sampler kept (the per-tenant half
+        # of the exemplar story: how many of MY requests are inspectable)
+        self.traces_retained = 0
         self.cache_fraction = cache_fraction
 
     def as_dict(self) -> dict:
@@ -145,6 +148,7 @@ class Tenant:
                 "exec_seconds": round(self.exec_seconds, 6),
                 "rows": self.rows,
                 "stream_batches": self.stream_batches,
+                "traces_retained": self.traces_retained,
                 "budget_bytes": self.budget.max_bytes,
             }
             if self.slo_p99_ms is not None:
