@@ -146,6 +146,20 @@ def test_make_record_fingerprint(monkeypatch):
     assert rec5["env"]["TPQ_TRACE_SPANS"] == "256"
     assert rec5["env"]["TPQ_TRACE_SLOW_Q"] == "0.99"
     assert rec5["env"]["TPQ_METRICS_DUMP"] == "/tmp/m.json:2"
+    # the fleet-spool knobs ride too (ISSUE 20): a spool-armed run pays the
+    # snapshot cadence, and the stream-yield flag changes the scheduler —
+    # different experiments
+    monkeypatch.setenv("TPQ_OBS_SPOOL", "/tmp/spool")
+    monkeypatch.setenv("TPQ_OBS_SPOOL_S", "0.5")
+    monkeypatch.setenv("TPQ_OBS_SPOOL_KEEP", "3")
+    monkeypatch.setenv("TPQ_OBS_STALE_S", "5")
+    monkeypatch.setenv("TPQ_SERVE_STREAM_YIELD", "0")
+    rec6 = ledger.make_record(_record(c=_cfg()), ts=125.5)
+    assert rec6["env"]["TPQ_OBS_SPOOL"] == "/tmp/spool"
+    assert rec6["env"]["TPQ_OBS_SPOOL_S"] == "0.5"
+    assert rec6["env"]["TPQ_OBS_SPOOL_KEEP"] == "3"
+    assert rec6["env"]["TPQ_OBS_STALE_S"] == "5"
+    assert rec6["env"]["TPQ_SERVE_STREAM_YIELD"] == "0"
     assert "python" in rec["env"]
     # inside this repo the short revision resolves
     rev = rec["git_rev"]

@@ -1,0 +1,753 @@
+"""ISSUE 20: fleet observability — spool, aggregate, stitch, diagnose.
+
+The contracts under test, in rough order of importance:
+
+- EXACT RECONCILIATION: the aggregated fleet registry equals the
+  per-process registries by construction — counters sum, ``_MERGE_MAXED``
+  gauges max, histogram buckets add, exemplars survive without
+  duplicating or orphaning trace ids;
+- the spool is crash-tolerant plumbing: torn/garbage/version-skewed
+  files are counted rejections, stale generations are skipped, a failing
+  source is a counted drop — none of it ever raises into the data path;
+- the doctor names processes: ``straggler`` carries host:pid + dominant
+  lane, ``dead-process`` fires on a stale heartbeat, fleet ``slo-burn``
+  says which process retained the exemplar;
+- request traces stitch across OS-process seams (``trace_context`` →
+  ``TPQ_TRACE_CONTEXT`` → ``adopt_context``), and the CLI renders one
+  multi-pid tree from the spool alone;
+- the real entry points (ScanService / DataLoader / write_sharded)
+  auto-arm a spool member when ``TPQ_OBS_SPOOL`` is set and leak no
+  threads after close;
+- the whole seam holds across three real OS processes (the e2e at the
+  bottom).
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_serve import _write_file  # noqa: E402
+
+from tpu_parquet.cli import pq_tool  # noqa: E402
+from tpu_parquet.obs import (LatencyHistogram, RequestTrace,  # noqa: E402
+                             StatsRegistry, current_request_trace,
+                             set_request_trace)
+from tpu_parquet.obs_fleet import (FleetAggregator, SpoolWriter,  # noqa: E402
+                                   ambient_request_trace, doctor_fleet,
+                                   process_lanes, render_fleet_openmetrics,
+                                   stitch_traces)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_tool(args):
+    out = io.StringIO()
+    parsed = pq_tool.build_parser().parse_args(args)
+    rc = parsed.func(parsed, out=out)
+    return rc, out.getvalue()
+
+
+def _member(spool, host, pid, role="serve", registry=None, **kw):
+    """A manually-driven (huge interval) spool member for one fake
+    process; publish via ``publish_once``."""
+    reg = registry if registry is not None else StatsRegistry()
+    w = SpoolWriter(reg, role=role, spool_dir=str(spool), interval_s=999.0,
+                    keep=kw.pop("keep", 4), host=host, pid=pid, **kw)
+    return reg, w
+
+
+def _spool_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith("tpq-spool")]
+
+
+# ---------------------------------------------------------------------------
+# SpoolWriter
+# ---------------------------------------------------------------------------
+
+def test_spool_disabled_without_env(monkeypatch):
+    monkeypatch.delenv("TPQ_OBS_SPOOL", raising=False)
+    w = SpoolWriter(StatsRegistry(), role="serve")
+    assert not w.enabled
+    assert w.start() is w and w._thread is None  # start is a no-op
+    assert w.publish_once() is None
+    w.stop()
+    assert w.written == 0 and w.dropped == 0
+
+
+def test_spool_publish_prune_heartbeat_seq(tmp_path):
+    reg, w = _member(tmp_path, "nodeA", 101, keep=2)
+    reg.add_write({"rows": 7})
+    paths = [w.publish_once() for _ in range(5)]
+    assert all(p is not None for p in paths)
+    files = sorted(os.listdir(tmp_path))
+    # pruned down to keep=2, newest generations survive
+    assert files == ["nodeA-101-serve.00000004.json",
+                     "nodeA-101-serve.00000005.json"]
+    docs = [json.load(open(tmp_path / f)) for f in files]
+    assert [d["seq"] for d in docs] == [4, 5]
+    assert docs[0]["heartbeat_ts"] <= docs[1]["heartbeat_ts"]  # monotonic
+    d = docs[-1]
+    assert d["spool_version"] == 1 and d["host"] == "nodeA" \
+        and d["pid"] == 101 and d["role"] == "serve" \
+        and d["registry"]["write"]["rows"] == 7 and d["traces"] == []
+    assert w.written == 5 and w.dropped == 0
+
+
+def test_spool_failing_source_counts_never_raises(tmp_path):
+    def boom():
+        raise RuntimeError("registry exploded")
+
+    w = SpoolWriter(boom, role="serve", spool_dir=str(tmp_path),
+                    interval_s=999.0)
+    assert w.publish_once() is None  # no raise
+    assert w.dropped == 1 and w.written == 0
+
+
+def test_spool_thread_lifecycle_publishes_final_generation(tmp_path):
+    reg, _ = _member(tmp_path, "x", 1)
+    w = SpoolWriter(reg, role="loader", spool_dir=str(tmp_path),
+                    interval_s=60.0, host="x", pid=1)
+    w.start()
+    assert _spool_threads() == ["tpq-spool-loader"]
+    w.stop()  # publishes the final generation on the way out
+    assert _spool_threads() == []
+    assert any(f.startswith("x-1-loader.") for f in os.listdir(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# FleetAggregator: exact reconciliation + rejection accounting
+# ---------------------------------------------------------------------------
+
+def test_aggregate_reconciles_exactly(tmp_path):
+    rows, workers, hist_n = [], [], 0
+    for i, (host, pid) in enumerate([("h0", 1), ("h0", 2), ("h1", 3)]):
+        reg, w = _member(tmp_path, host, pid, role="writer")
+        reg.add_write({"rows": 100 * (i + 1), "workers": i + 1})
+        rows.append(100 * (i + 1))
+        workers.append(i + 1)
+        for j in range(i + 1):
+            reg.histogram("serve.request").record(
+                1e-3 * (j + 1), exemplar=f"t-{host}-{pid}-{j}")
+            hist_n += 1
+        assert w.publish_once() is not None
+    snap = FleetAggregator(spool_dir=str(tmp_path)).scan()
+    assert snap["fleet_version"] == 1
+    assert snap["rejected"] == 0 and snap["stale_skipped"] == 0
+    assert snap["files_scanned"] == 3
+    assert sorted(snap["processes"]) == ["h0:1", "h0:2", "h1:3"]
+    assert all(p["role"] == "writer" and not p["stale"]
+               for p in snap["processes"].values())
+    merged = snap["registry"]
+    # counters reconcile EXACTLY: flows sum, gauges max
+    assert merged["write"]["rows"] == sum(rows)
+    assert merged["write"]["workers"] == max(workers)
+    hist = merged["histograms"]["serve.request"]
+    assert hist["count"] == hist_n
+
+
+def test_aggregate_rejects_garbage_and_skips_stale_generations(tmp_path):
+    reg, w = _member(tmp_path, "h", 1, keep=4)
+    reg.add_write({"rows": 5})
+    w.publish_once()
+    reg.add_write({"rows": 5})
+    w.publish_once()  # gen 2 supersedes gen 1
+    (tmp_path / "zz-torn.json").write_bytes(b'{"spool_version": 1, "ho')
+    (tmp_path / "zz-list.json").write_text("[1, 2, 3]\n")
+    (tmp_path / "zz-skew.json").write_text(json.dumps(
+        {"spool_version": 999, "host": "h", "pid": 9, "seq": 1,
+         "heartbeat_ts": time.time(), "registry": {}}))
+    (tmp_path / "notes.txt").write_text("not a spool file\n")  # ignored
+    snap = FleetAggregator(spool_dir=str(tmp_path)).scan()
+    assert snap["files_scanned"] == 5  # the .txt never counts
+    assert snap["rejected"] == 3
+    assert snap["stale_skipped"] == 1
+    assert list(snap["processes"]) == ["h:1"]
+    # only the NEWEST generation counted — no double-merge
+    assert snap["registry"]["write"]["rows"] == 10
+    assert snap["processes"]["h:1"]["seq"] == 2
+
+
+def test_aggregate_missing_spool_is_empty_never_raises(tmp_path):
+    snap = FleetAggregator(spool_dir=str(tmp_path / "nope")).scan()
+    assert snap["processes"] == {} and snap["files_scanned"] == 0
+
+
+# ---------------------------------------------------------------------------
+# exemplars survive the spool → merge_dict round-trip (satellite 4)
+# ---------------------------------------------------------------------------
+
+def test_exemplars_survive_spool_merge_roundtrip(tmp_path):
+    want = {}  # trace_id -> raw seconds
+    for i, (host, pid) in enumerate([("h0", 1), ("h1", 2)]):
+        reg, w = _member(tmp_path, host, pid)
+        for j in range(3):
+            s = (2.0 ** (8 * i + 2 * j)) / 1e6  # distinct buckets per member
+            tid = f"t-{host}-{j}"
+            reg.histogram("serve.request").record(s, exemplar=tid)
+            want[tid] = s
+        w.publish_once()
+    snap = FleetAggregator(spool_dir=str(tmp_path)).scan()
+    hd = snap["registry"]["histograms"]["serve.request"]
+    got = {ex[0]: ex[1] for ex in (hd.get("exemplars") or {}).values()}
+    # no duplicated ids (one exemplar per bucket, distinct buckets here),
+    # no orphans (every retained id is one we recorded), and each raw
+    # value re-derives the bucket it was filed under
+    assert set(got) == set(want)
+    for idx, ex in hd["exemplars"].items():
+        assert LatencyHistogram.bucket_index(float(ex[1])) == int(idx)
+        assert abs(got[ex[0]] - want[ex[0]]) < 1e-12
+    # a second merge hop (fleet snapshot folded again) keeps them intact
+    reg2 = StatsRegistry()
+    reg2.merge_dict(snap["registry"])
+    hd2 = reg2.as_dict()["histograms"]["serve.request"]
+    assert hd2["exemplars"] == hd["exemplars"]
+    assert hd2["count"] == hd["count"] == 6
+
+
+# ---------------------------------------------------------------------------
+# doctor: straggler / dead-process / fleet slo-burn
+# ---------------------------------------------------------------------------
+
+def _fleet_with_straggler(tmp_path, slow=10.0):
+    for i, (host, pid) in enumerate([("h0", 1), ("h0", 2), ("h1", 3)]):
+        reg, w = _member(tmp_path, host, pid, role="writer")
+        reg.add_write({"rows": 10,
+                       "encode_seconds": slow if i == 2 else 1.0})
+        w.publish_once()
+    return FleetAggregator(spool_dir=str(tmp_path)).scan()
+
+
+def test_straggler_names_process_and_dominant_lane(tmp_path):
+    snap = _fleet_with_straggler(tmp_path)
+    rep = doctor_fleet(snap)
+    blocks = [b for b in rep["verdicts"] if b["verdict"] == "straggler"]
+    assert len(blocks) == 1, rep["verdicts"]
+    b = blocks[0]
+    assert b["process"] == "h1:3" and b["role"] == "writer"
+    assert b["dominant_lane"] == "write_encode"
+    assert b["deviation"] > 1.0  # ~10x the fleet median
+    assert "h1:3" in b["advice"] or "write_encode" in b["advice"]
+
+
+def test_no_straggler_below_min_procs_or_band(tmp_path):
+    # two members only: below STRAGGLER_MIN_PROCS, never fires
+    for i, pid in enumerate([1, 2]):
+        reg, w = _member(tmp_path, "h", pid)
+        reg.add_write({"encode_seconds": 10.0 if i else 1.0})
+        w.publish_once()
+    snap = FleetAggregator(spool_dir=str(tmp_path)).scan()
+    rep = doctor_fleet(snap)
+    verdicts = (rep or {}).get("verdicts") or []
+    assert not [b for b in verdicts if b["verdict"] == "straggler"]
+    # and a flat fleet (3 equal members) stays quiet too
+    for f in os.listdir(tmp_path):
+        os.remove(tmp_path / f)
+    snap = _fleet_with_straggler(tmp_path, slow=1.0)
+    rep = doctor_fleet(snap)
+    verdicts = (rep or {}).get("verdicts") or []
+    assert not [b for b in verdicts if b["verdict"] == "straggler"]
+
+
+def test_dead_process_fires_on_stale_heartbeat(tmp_path):
+    reg, w = _member(tmp_path, "live", 1)
+    reg.add_write({"rows": 1})
+    w.publish_once()
+    dead = {"spool_version": 1, "host": "gone", "pid": 9, "role": "loader",
+            "seq": 3, "heartbeat_ts": time.time() - 3600,
+            "registry": StatsRegistry().as_dict(), "traces": []}
+    (tmp_path / "gone-9.00000003.json").write_text(json.dumps(dead))
+    snap = FleetAggregator(spool_dir=str(tmp_path), stale_s=5.0).scan()
+    assert snap["processes"]["gone:9"]["stale"]
+    assert not snap["processes"]["live:1"]["stale"]
+    rep = doctor_fleet(snap)
+    blocks = [b for b in rep["verdicts"] if b["verdict"] == "dead-process"]
+    assert len(blocks) == 1
+    b = blocks[0]
+    assert b["process"] == "gone:9" and b["role"] == "loader"
+    assert b["heartbeat_age_s"] > 3000 and b["stale_after_s"] == 5.0
+
+
+def test_scan_now_override_ages_every_heartbeat(tmp_path):
+    reg, w = _member(tmp_path, "h", 1)
+    w.publish_once()
+    agg = FleetAggregator(spool_dir=str(tmp_path), stale_s=10.0)
+    assert not agg.scan()["processes"]["h:1"]["stale"]
+    assert agg.scan(now=time.time() + 100)["processes"]["h:1"]["stale"]
+
+
+def test_fleet_slo_burn_names_exemplar_owner(tmp_path):
+    reg, w = _member(tmp_path, "h0", 1)
+    reg.add_serve({"tenants": {"gold": {"weight": 2, "slo_p99_ms": 1.0}},
+                   "submitted": 5, "done": 5})
+    reg.histogram("serve.tenant.gold").record(0.05, exemplar="t-gold-slow")
+    w.publish_once()
+    reg2, w2 = _member(tmp_path, "h1", 2)  # innocent bystander
+    reg2.add_write({"rows": 1})
+    w2.publish_once()
+    snap = FleetAggregator(spool_dir=str(tmp_path)).scan()
+    rep = doctor_fleet(snap)
+    blocks = [b for b in rep["verdicts"] if b["verdict"] == "slo-burn"]
+    assert len(blocks) == 1, rep["verdicts"]
+    b = blocks[0]
+    assert b["tenant"] == "gold" and b["exemplar_trace"] == "t-gold-slow"
+    # the fleet doctor says WHICH process retained the evidence
+    assert b["exemplar_process"] == "h0:1"
+    assert "h0:1" in b["advice"]
+
+
+def test_process_lanes_cover_read_and_write_sides():
+    lanes = process_lanes({
+        "pipeline": {"stage_seconds": 2.0, "io_seconds": 1.0,
+                     "decompress_seconds": 0.5, "stall_seconds": 0.25},
+        "write": {"encode_seconds": 3.0, "flush_seconds": 1.5},
+        "serve": {"queue_wait_seconds": 0.75},
+    })
+    assert lanes["link"] == 2.0
+    assert lanes["host_decompress"] == 1.5  # io + decompress
+    assert lanes["stall"] == 0.25
+    assert lanes["write_encode"] == 3.0 and lanes["write_flush"] == 1.5
+    assert lanes["admission"] == 0.75
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics rendering
+# ---------------------------------------------------------------------------
+
+def test_render_fleet_openmetrics_labels_and_exemplars(tmp_path):
+    reg, w = _member(tmp_path, "nodeA", 101, role="serve")
+    reg.add_write({"rows": 9})
+    reg.histogram("serve.request").record(0.002, exemplar="t-om-1")
+    w.publish_once()
+    text = render_fleet_openmetrics(
+        FleetAggregator(spool_dir=str(tmp_path)).scan())
+    assert text.endswith("# EOF\n")
+    labels = 'host="nodeA",pid="101",role="serve"'
+    assert f"tpq_write_rows{{{labels}}} 9" in text
+    assert f"tpq_fleet_heartbeat_age_seconds{{{labels}}}" in text
+    assert 'trace_id="t-om-1"' in text  # exemplar rides the bucket line
+    assert f"tpq_serve_request_seconds_count{{{labels}}} 1" in text
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace stitching
+# ---------------------------------------------------------------------------
+
+def test_trace_context_roundtrip_and_validation():
+    tr = RequestTrace(trace_id="req-parent")
+    ctx = tr.trace_context()
+    assert ctx["trace_id"] == "req-parent" and ctx["pid"] == os.getpid()
+    child = RequestTrace.adopt_context(ctx)
+    assert child.trace_id != tr.trace_id  # ids stay process-unique
+    assert child.origin["trace_id"] == "req-parent"
+    assert child.origin["pid"] == os.getpid()
+    with pytest.raises(ValueError):
+        RequestTrace.adopt_context("not a dict")
+    with pytest.raises(ValueError):
+        RequestTrace.adopt_context({"host": "h"})  # no trace_id
+
+
+def test_stitch_traces_dedups_and_sorts_children():
+    root = {"trace_id": "R", "spans": []}
+    mk = lambda tid, host, pid: {"trace_id": tid, "host": host, "pid": pid,
+                                 "origin": {"trace_id": "R"}, "spans": []}
+    docs = [root, mk("c2", "h1", 7), mk("c1", "h0", 3),
+            mk("c1", "h0", 3),               # republished generation
+            {"trace_id": "other", "origin": {"trace_id": "X"}}]
+    st = stitch_traces(docs, "R")
+    assert st["root"] is root
+    assert [c["trace_id"] for c in st["children"]] == ["c1", "c2"]
+    # children with no root still stitch (the parent process may not spool)
+    st = stitch_traces(docs[1:], "R")
+    assert st["root"] is None and len(st["children"]) == 2
+    assert stitch_traces(docs, "nope") is None
+
+
+def test_ambient_request_trace_adopts_env(monkeypatch):
+    set_request_trace(None)
+    try:
+        monkeypatch.delenv("TPQ_TRACE_CONTEXT", raising=False)
+        assert ambient_request_trace() is None
+        parent = RequestTrace(trace_id="req-env")
+        monkeypatch.setenv("TPQ_TRACE_CONTEXT",
+                           json.dumps(parent.trace_context()))
+        tr = ambient_request_trace()
+        assert tr is not None and tr.origin["trace_id"] == "req-env"
+        # installed thread-locally: nested code finds the SAME trace
+        assert current_request_trace() is tr
+        assert ambient_request_trace() is tr
+        # a live thread-local trace beats the env blob
+        set_request_trace(None)
+        mine = RequestTrace(trace_id="req-mine")
+        set_request_trace(mine)
+        assert ambient_request_trace() is mine
+    finally:
+        set_request_trace(None)
+
+
+def test_ambient_request_trace_malformed_env_degrades(monkeypatch):
+    set_request_trace(None)
+    try:
+        monkeypatch.setenv("TPQ_TRACE_CONTEXT", "{not json")
+        assert ambient_request_trace() is None  # warn_env_once, no raise
+        monkeypatch.setenv("TPQ_TRACE_CONTEXT", '{"host": "h"}')
+        assert ambient_request_trace() is None  # valid JSON, invalid blob
+    finally:
+        set_request_trace(None)
+
+
+# ---------------------------------------------------------------------------
+# CLI: pq_tool top / trace --request --spool
+# ---------------------------------------------------------------------------
+
+def _three_member_spool(tmp_path):
+    for i, (pid, role) in enumerate([(101, "serve"), (102, "loader"),
+                                     (103, "writer")]):
+        reg, w = _member(tmp_path, "nodeA", pid, role=role)
+        reg.add_write({"rows": 10 * (i + 1), "encode_seconds": 0.1})
+        w.publish_once()
+
+
+def test_top_once_golden(tmp_path):
+    _three_member_spool(tmp_path)
+    rc, out = run_tool(["top", str(tmp_path), "--once"])
+    assert rc == 0, out
+    assert "fleet top" in out and "3 process(es)" in out
+    for pid, role in [(101, "serve"), (102, "loader"), (103, "writer")]:
+        assert f"nodeA:{pid}" in out and role in out
+    assert "verdicts: none" in out
+
+
+def test_top_once_renders_verdicts(tmp_path):
+    _fleet_with_straggler(tmp_path)
+    rc, out = run_tool(["top", str(tmp_path), "--once"])
+    assert rc == 0
+    assert "straggler" in out and "h1:3" in out and "write_encode" in out
+
+
+def test_top_empty_spool_rc1(tmp_path):
+    rc, out = run_tool(["top", str(tmp_path), "--once"])
+    assert rc == 1 and "no spool members" in out
+
+
+def test_metrics_spool_renders_fleet_exposition(tmp_path):
+    _three_member_spool(tmp_path)
+    rc, out = run_tool(["metrics", "--spool", str(tmp_path)])
+    assert rc == 0
+    assert 'tpq_write_rows{host="nodeA",pid="101",role="serve"} 10' in out
+    assert out.rstrip().endswith("# EOF")
+    rc, out = run_tool(["metrics", "--spool", str(tmp_path / "empty")])
+    assert rc == 1 and "no spool members" in out
+    rc, out = run_tool(["metrics"])
+    assert rc == 2 and "FILE is required" in out
+
+
+def test_trace_without_file_or_spool_errors():
+    rc, out = run_tool(["trace"])
+    assert rc == 2 and "FILE is required" in out
+    rc, out = run_tool(["trace", "--request", "abc"])
+    assert rc == 1 and "--spool" in out
+
+
+def test_trace_request_stitches_from_spool(tmp_path):
+    parent = RequestTrace(trace_id="req-stitch01")
+    with parent.span("plan"):
+        pass
+    parent.finish()
+    child = RequestTrace.adopt_context(parent.trace_context())
+    with child.span("child-decode", unit=3):
+        pass
+    child.finish()
+    cdoc = child.as_dict()
+    cdoc["host"], cdoc["pid"] = "workerbox", 4242  # a remote process's doc
+    _, w1 = _member(tmp_path, "h0", 1,
+                    sampler=lambda: [parent.as_dict()])
+    w1.publish_once()
+    _, w2 = _member(tmp_path, "workerbox", 4242, role="loader",
+                    sampler=lambda: [cdoc])
+    w2.publish_once()
+    rc, out = run_tool(["trace", "--request", "req-stitch",
+                        "--spool", str(tmp_path)])
+    assert rc == 0, out
+    assert "req-stitch01" in out and "plan" in out
+    assert "child [workerbox:4242]" in out and "child-decode" in out
+
+
+# ---------------------------------------------------------------------------
+# tenancy: shared tenants.json (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_tenant_file_spec_and_from_file(tmp_path):
+    from tpu_parquet.serve.tenancy import TenantRegistry, tenant_table
+
+    p = tmp_path / "tenants.json"
+    p.write_text(json.dumps({
+        "gold": {"weight": 3, "deadline_s": 2.5, "slo_p99_ms": 50},
+        "bronze": 1,                       # bare-number weight form
+        "weird": {"weight": -4},           # floored to 1
+        "": {"weight": 9},                 # nameless: dropped
+        "bool": True,                      # malformed entry: dropped
+    }))
+    table = tenant_table(f"@{p}")
+    assert table["gold"] == {"weight": 3, "deadline_s": 2.5,
+                             "slo_p99_ms": 50.0}
+    assert table["bronze"]["weight"] == 1
+    assert table["weird"]["weight"] == 1
+    assert set(table) == {"gold", "bronze", "weird"}
+    regy = TenantRegistry.from_file(str(p))
+    t = regy.get("gold")
+    assert t is not None and t.weight == 3 and t.slo_p99_ms == 50.0
+
+
+def test_tenant_file_malformed_degrades(tmp_path):
+    from tpu_parquet.serve.tenancy import tenant_table
+
+    p = tmp_path / "tenants.json"
+    p.write_text("{broken json")
+    assert tenant_table(f"@{p}") == {}          # warn_env_once, no raise
+    assert tenant_table(f"@{tmp_path}/missing.json") == {}
+    p.write_text("[1, 2]")                       # not an object
+    assert tenant_table(f"@{p}") == {}
+
+
+# ---------------------------------------------------------------------------
+# stream-aware fair scheduling (satellite 2)
+# ---------------------------------------------------------------------------
+
+def _yield_service(tmp_path, stream_yield):
+    from tpu_parquet.serve import ScanService
+
+    svc = ScanService(concurrency=1, queue_depth=64, fair=True,
+                      result_cache_mb=0, stream_yield=stream_yield)
+    svc.register_tenant("victim", weight=2)
+    svc.register_tenant("noisy", weight=1)
+    return svc
+
+
+@pytest.mark.parametrize("stream_yield", [True, False])
+def test_stream_yields_slot_between_batches(tmp_path, stream_yield):
+    from tpu_parquet.serve import ScanRequest
+
+    path = str(tmp_path / "f.parquet")
+    _write_file(path, seed=3, groups=8, rows=800)
+    svc = _yield_service(tmp_path, stream_yield)
+    try:
+        session = svc.scan(ScanRequest(path, columns=["a"], tenant="noisy",
+                                       stream=True, batch_rows=100),
+                           timeout=60)
+        rows = 0
+        victims = []
+        for i, batch in enumerate(session):
+            rows += len(batch["a"])
+            # keep another tenant visibly waiting while the stream runs
+            if i < 8:
+                victims.append(svc.submit(
+                    ScanRequest(path, columns=["a"], tenant="victim")))
+        assert rows == 8 * 800
+        for t in victims:
+            got = t.result(60)[path]["a"]
+            assert got.num_leaf_slots == 8 * 800
+        stats = svc.serve_stats()
+        if stream_yield:
+            assert stats["stream_yields"] > 0
+        else:
+            assert stats["stream_yields"] == 0
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# auto-armed spool members at the real entry points
+# ---------------------------------------------------------------------------
+
+def _roles_in(spool):
+    roles = set()
+    for fn in os.listdir(spool):
+        if fn.endswith(".json"):
+            roles.add(json.load(open(os.path.join(spool, fn)))["role"])
+    return roles
+
+
+def test_scan_service_auto_arms_spool(tmp_path, monkeypatch):
+    from tpu_parquet.serve import ScanRequest, ScanService
+
+    spool = tmp_path / "spool"
+    monkeypatch.setenv("TPQ_OBS_SPOOL", str(spool))
+    monkeypatch.setenv("TPQ_OBS_SPOOL_S", "60")  # stop() publishes anyway
+    path = str(tmp_path / "f.parquet")
+    _write_file(path, seed=1, groups=2, rows=300)
+    svc = ScanService(concurrency=1, result_cache_mb=0)
+    try:
+        svc.scan(ScanRequest(path, columns=["a"]), timeout=60)
+    finally:
+        svc.close()
+    assert _spool_threads() == []  # no leak after close
+    assert _roles_in(spool) == {"serve"}
+    snap = FleetAggregator(spool_dir=str(spool)).scan()
+    assert snap["registry"]["serve"]["submitted"] >= 1
+
+
+def test_loader_and_writer_auto_arm_spool(tmp_path, monkeypatch):
+    import numpy as np
+
+    from tpu_parquet.column import ByteArrayData, ColumnData
+    from tpu_parquet.data import DataLoader
+    from tpu_parquet.format import FieldRepetitionType as FRT, Type
+    from tpu_parquet.schema.core import build_schema, data_column
+    from tpu_parquet.write import write_sharded
+
+    spool = tmp_path / "spool"
+    monkeypatch.setenv("TPQ_OBS_SPOOL", str(spool))
+    monkeypatch.setenv("TPQ_OBS_SPOOL_S", "60")
+    schema = build_schema([data_column("a", Type.INT64, FRT.REQUIRED)])
+    rng = np.random.default_rng(0)
+    batches = [{"a": rng.integers(0, 1 << 20, 400)} for _ in range(3)]
+    out = str(tmp_path / "data.parquet")
+    write_sharded(out, schema, batches, workers=2)
+    assert "writer" in _roles_in(spool)
+    n = 0
+    for batch in DataLoader([out], 300, columns=["a"], shuffle=False):
+        n += len(batch["a"])
+    assert n == 1200
+    assert _roles_in(spool) == {"writer", "loader"}
+    assert _spool_threads() == []
+    snap = FleetAggregator(spool_dir=str(spool)).scan()
+    assert snap["rejected"] == 0
+    assert snap["registry"]["write"]["rows"] == 1200
+    # one OS process armed two entry points: the roles fold into ONE
+    # process entry (neither member's generations clobbered the other's)
+    assert len(snap["processes"]) == 1
+    (proc,) = snap["processes"].values()
+    assert proc["role"] == "loader+writer"
+    assert proc["registry"]["write"]["rows"] == 1200
+    assert proc["registry"]["loader"]["batches"] == 4
+
+
+# ---------------------------------------------------------------------------
+# the 3-OS-process end-to-end
+# ---------------------------------------------------------------------------
+
+_WORKER_SRC = textwrap.dedent("""
+    import json, os, sys, time
+
+    from tpu_parquet.obs import StatsRegistry
+    from tpu_parquet.obs_fleet import SpoolWriter, ambient_request_trace
+
+    spool, idx, mode = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    reg = StatsRegistry()
+    reg.add_write({"rows": 100 * (idx + 1), "workers": idx + 1,
+                   "encode_seconds": 10.0 if mode == "slow" else 1.0})
+    reg.histogram("serve.request").record(1e-3 * (idx + 1),
+                                          exemplar="t-w%d" % idx)
+    tr = ambient_request_trace()  # adopts TPQ_TRACE_CONTEXT
+    if tr is not None:
+        with tr.span("child-work", idx=idx):
+            pass
+        tr.finish()
+    w = SpoolWriter(reg, role="loader", spool_dir=spool, interval_s=999.0,
+                    sampler=lambda: [tr.as_dict()] if tr else [])
+    if mode == "dead":
+        w.publish_once()
+        print(json.dumps({"pid": os.getpid(), "host": w.host}), flush=True)
+        time.sleep(600)  # parent kills us; our heartbeat goes stale
+        sys.exit(0)
+    print(json.dumps({"pid": os.getpid(), "host": w.host}), flush=True)
+    sys.stdin.readline()  # parent's go signal: publish a FRESH heartbeat
+    path = w.publish_once()
+    assert path is not None
+    sys.exit(0)
+""")
+
+
+def test_three_process_fleet_e2e(tmp_path):
+    spool = str(tmp_path / "spool")
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER_SRC)
+    parent = RequestTrace(trace_id="req-e2e-fleet")
+    with parent.span("orchestrate"):
+        pass
+    parent.finish()
+    env = dict(os.environ,
+               PYTHONPATH=REPO_ROOT,
+               TPQ_TRACE_CONTEXT=json.dumps(parent.trace_context()))
+    env.pop("TPQ_OBS_SPOOL", None)
+    modes = ["live", "live", "slow", "dead"]
+    procs, info = [], []
+    try:
+        for idx, mode in enumerate(modes):
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script), spool, str(idx), mode],
+                env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        for p in procs:
+            line = p.stdout.readline()
+            assert line.strip(), "worker died before publishing"
+            info.append(json.loads(line))
+        dead_pid, dead_host = info[3]["pid"], info[3]["host"]
+        slow_pid, slow_host = info[2]["pid"], info[2]["host"]
+        time.sleep(0.9)  # the dead worker's heartbeat ages past stale_s
+        procs[3].kill()
+        for p in procs[:3]:  # live workers republish fresh heartbeats
+            p.stdin.write("go\n")
+            p.stdin.flush()
+            assert p.wait(timeout=60) == 0, p.stdout.read()
+        # the parent process is a fleet member too (role serve)
+        preg = StatsRegistry()
+        preg.add_serve({"submitted": 1, "done": 1})
+        pw = SpoolWriter(preg, role="serve", spool_dir=spool,
+                         interval_s=999.0,
+                         sampler=lambda: [parent.as_dict()])
+        assert pw.publish_once() is not None
+    finally:
+        for p in procs:
+            p.kill()
+            if p.stdin:
+                p.stdin.close()
+            if p.stdout:
+                p.stdout.close()
+            p.wait(timeout=30)
+
+    snap = FleetAggregator(spool_dir=spool, stale_s=0.5).scan()
+    assert snap["rejected"] == 0, snap
+    assert len(snap["processes"]) == 5  # 4 workers + the parent
+
+    # exact reconciliation across real OS processes: counters == sum of
+    # the per-process registries, gauges == max
+    merged = snap["registry"]
+    assert merged["write"]["rows"] == 100 + 200 + 300 + 400
+    assert merged["write"]["workers"] == 4
+    assert merged["histograms"]["serve.request"]["count"] == 4
+    assert merged["serve"]["submitted"] == 1
+
+    rep = doctor_fleet(snap)
+    verdicts = rep["verdicts"]
+    dead = [b for b in verdicts if b["verdict"] == "dead-process"]
+    assert [b["process"] for b in dead] == [f"{dead_host}:{dead_pid}"]
+    # straggler names the injected-slow process by host:pid + its lane
+    strag = [b for b in verdicts if b["verdict"] == "straggler"]
+    assert len(strag) == 1, verdicts
+    assert strag[0]["process"] == f"{slow_host}:{slow_pid}"
+    assert strag[0]["dominant_lane"] == "write_encode"
+
+    # one stitched tree, spans from >= 2 pids, rendered by the CLI
+    rc, out = run_tool(["trace", "--request", "req-e2e-fleet",
+                        "--spool", spool])
+    assert rc == 0, out
+    assert "orchestrate" in out and "child-work" in out
+    child_pids = {int(ln.split(":")[1].split("]")[0])
+                  for ln in out.splitlines() if ln.startswith("  child [")}
+    assert len(child_pids) >= 2  # live workers adopted across the seam
+    assert os.getpid() not in child_pids
+
+    # and the fleet exposition carries every member's labels
+    text = render_fleet_openmetrics(snap)
+    assert f'pid="{dead_pid}"' in text and f'pid="{os.getpid()}"' in text

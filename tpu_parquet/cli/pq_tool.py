@@ -198,6 +198,38 @@ def _fmt_span_args(args_d: dict) -> str:
     return " ".join(f"{k}={v}" for k, v in args_d.items())
 
 
+def _write_span_tree(out, tr: dict, indent: str = "") -> None:
+    """Emit one trace document's span tree (indentation is nesting)."""
+    spans = tr.get("spans") or []
+    children: dict = {}
+    for i, s in enumerate(spans):
+        children.setdefault(s.get("parent", -1), []).append(i)
+
+    def emit(idx: int, depth: int) -> None:
+        s = spans[idx]
+        d = s.get("dur_s")
+        line = (f"{indent}  {'  ' * depth}"
+                f"{s.get('name', '?'):<{18 - 2 * min(depth, 6)}} "
+                f"@{s.get('t_s', 0) * 1e3:>9.3f}ms "
+                + (f"{d * 1e3:>9.3f}ms" if d is not None else f"{'?':>11}"))
+        extra = _fmt_span_args(s.get("args") or {})
+        out.write(line + (f"  {extra}" if extra else "") + "\n")
+        for c in children.get(idx, ()):
+            emit(c, depth + 1)
+
+    for root in children.get(-1, ()):
+        emit(root, 0)
+
+
+def _spool_trace_docs(spool_dir: str) -> list:
+    """Every trace document published into a fleet spool (each member's
+    freshest generation), for stitched multi-process rendering."""
+    from ..obs_fleet import FleetAggregator
+
+    snap = FleetAggregator(spool_dir=spool_dir).scan()
+    return [t for t in snap.get("traces") or () if isinstance(t, dict)]
+
+
 def cmd_trace_request(args, out=sys.stdout) -> int:
     """``pq_tool trace --request <id> <dump>``: print one retained
     request's span tree from a tail-sampler dump
@@ -205,15 +237,33 @@ def cmd_trace_request(args, out=sys.stdout) -> int:
     ``TailSampler.dump`` output) — indentation is nesting, each line a
     span's start offset, duration, and annotations (retry counts, hedge
     outcomes, cache hits), so a bad exemplar percentile reads as a story:
-    which range fetch stalled, which probe missed, where the time went."""
-    doc = _load_doc(args.file)
-    if isinstance(doc, dict) and isinstance(doc.get("traces"), list):
-        traces = [t for t in doc["traces"] if isinstance(t, dict)]
-    elif isinstance(doc, dict) and "trace_id" in doc:
-        traces = [doc]
-    else:
-        out.write(f"pq-tool trace: {args.file}: not a trace dump (expected "
-                  f"the ScanService.trace_dump / TailSampler.dump format)\n")
+    which range fetch stalled, which probe missed, where the time went.
+
+    With ``--spool DIR`` the fleet spool's trace docs join the pool (the
+    dump file becomes optional) and children that adopted the request's
+    exported trace context — loader iterations, ``write_sharded`` encode
+    passes, any process that called ``adopt_context`` — render stitched
+    under it, labelled ``[host:pid]``: one request, every process."""
+    traces: list = []
+    label = args.file or ""
+    if args.file:
+        doc = _load_doc(args.file)
+        if isinstance(doc, dict) and isinstance(doc.get("traces"), list):
+            traces = [t for t in doc["traces"] if isinstance(t, dict)]
+        elif isinstance(doc, dict) and "trace_id" in doc:
+            traces = [doc]
+        else:
+            out.write(f"pq-tool trace: {args.file}: not a trace dump "
+                      f"(expected the ScanService.trace_dump / "
+                      f"TailSampler.dump format)\n")
+            return 1
+    spool = getattr(args, "spool", None)
+    if spool:
+        traces.extend(_spool_trace_docs(spool))
+        label = f"{label} + spool {spool}" if label else f"spool {spool}"
+    if not args.file and not spool:
+        out.write("pq-tool trace: --request needs a dump file and/or "
+                  "--spool DIR\n")
         return 1
     want = args.request
     match = [t for t in traces if t.get("trace_id") == want]
@@ -222,7 +272,7 @@ def cmd_trace_request(args, out=sys.stdout) -> int:
                  if str(t.get("trace_id", "")).startswith(want)]
     if not match:
         ids = ", ".join(str(t.get("trace_id")) for t in traces[-8:])
-        out.write(f"pq-tool trace: {args.file}: no retained trace "
+        out.write(f"pq-tool trace: {label}: no retained trace "
                   f"{want!r} ({len(traces)} retained"
                   + (f"; most recent: {ids}" if ids else "")
                   + ") — it may have been evicted (raise TPQ_TRACE_RING) "
@@ -230,7 +280,9 @@ def cmd_trace_request(args, out=sys.stdout) -> int:
         return 1
     tr = match[0]
     dur = tr.get("duration_s")
-    out.write(f"trace {tr.get('trace_id')}: "
+    origin = (f" [{tr['host']}:{tr['pid']}]"
+              if tr.get("host") and tr.get("pid") else "")
+    out.write(f"trace {tr.get('trace_id')}{origin}: "
               + (f"{dur * 1e3:.2f}ms" if dur is not None else "?")
               + (f", dropped {tr['dropped']} span(s)"
                  if tr.get("dropped") else "")
@@ -240,25 +292,18 @@ def cmd_trace_request(args, out=sys.stdout) -> int:
     err = tr.get("error")
     if err:
         out.write(f"error: {err.get('type')}: {err.get('message')}\n")
-    spans = tr.get("spans") or []
-    children: dict = {}
-    for i, s in enumerate(spans):
-        children.setdefault(s.get("parent", -1), []).append(i)
-
-    def emit(idx: int, depth: int) -> None:
-        s = spans[idx]
-        d = s.get("dur_s")
-        line = (f"  {'  ' * depth}{s.get('name', '?'):<{18 - 2 * min(depth, 6)}} "
-                f"@{s.get('t_s', 0) * 1e3:>9.3f}ms "
-                + (f"{d * 1e3:>9.3f}ms" if d is not None else f"{'?':>11}"))
-        extra = _fmt_span_args(s.get("args") or {})
-        out.write(line + (f"  {extra}" if extra else "") + "\n")
-        for c in children.get(idx, ()):
-            emit(c, depth + 1)
-
     out.write("spans:\n")
-    for root in children.get(-1, ()):
-        emit(root, 0)
+    _write_span_tree(out, tr)
+    from ..obs_fleet import stitch_traces
+
+    stitched = stitch_traces(traces, str(tr.get("trace_id")))
+    for ch in (stitched or {}).get("children") or ():
+        cdur = ch.get("duration_s")
+        out.write(f"  child [{ch.get('host', '?')}:{ch.get('pid', '?')}] "
+                  f"trace {ch.get('trace_id')}: "
+                  + (f"{cdur * 1e3:.2f}ms" if cdur is not None else "?")
+                  + "\n")
+        _write_span_tree(out, ch, indent="    ")
     return 0
 
 
@@ -279,6 +324,10 @@ def cmd_trace(args, out=sys.stdout) -> int:
 
     if getattr(args, "request", None):
         return cmd_trace_request(args, out)
+    if not args.file:
+        out.write("pq-tool trace: FILE is required (it is optional only "
+                  "with --request --spool)\n")
+        return 2
     doc = _load_doc(args.file)
     label = args.file
     if isinstance(doc, dict) and "traceEvents" not in doc and "configs" in doc:
@@ -554,7 +603,10 @@ def cmd_metrics(args, out=sys.stdout) -> int:
       trace-id exemplars) — what a scraper would ingest;
     - two snapshots: the numeric counter deltas A → B;
     - ``--watch``: poll the snapshot file and print deltas as they land
-      (``--count`` bounds the polls for scripting)."""
+      (``--count`` bounds the polls for scripting);
+    - ``--spool DIR``: aggregate a fleet spool instead of reading a file
+      and render the fleet exposition, every per-process series labelled
+      ``host``/``pid``/``role`` — one scrape, the whole fleet."""
     from ..obs import diff_registry_trees, render_openmetrics
 
     def load(spec):
@@ -562,6 +614,21 @@ def cmd_metrics(args, out=sys.stdout) -> int:
         if tree is None:
             raise ValueError(f"{spec}: {why}")
         return tree
+
+    spool = getattr(args, "spool", None)
+    if spool:
+        from ..obs_fleet import FleetAggregator, render_fleet_openmetrics
+
+        snap = FleetAggregator(spool_dir=spool).scan()
+        if not snap["processes"]:
+            out.write(f"pq-tool metrics: {spool}: no spool members\n")
+            return 1
+        out.write(render_fleet_openmetrics(snap))
+        return 0
+    if not getattr(args, "file", None):
+        out.write("pq-tool metrics: FILE is required (it is optional only "
+                  "with --spool DIR)\n")
+        return 2
 
     def write_diff(old, new, indent="  "):
         d = diff_registry_trees(old, new)
@@ -841,6 +908,127 @@ def cmd_serve_stats(args, out=sys.stdout) -> int:
     return 0
 
 
+def _render_fleet_top(snap, out) -> int:
+    """One ``pq_tool top`` frame from a :meth:`FleetAggregator.scan`
+    snapshot: per-process lanes/queue/cache table, the merged tenant
+    table, then active fleet verdicts."""
+    from ..obs import LatencyHistogram
+    from ..obs_fleet import doctor_fleet, process_lanes
+
+    procs = snap.get("processes") or {}
+    if not procs:
+        out.write(f"pq-tool top: {snap.get('spool_dir')}: no spool members "
+                  f"yet — processes publish once TPQ_OBS_SPOOL points here\n")
+        return 1
+    stale_n = sum(1 for p in procs.values() if p.get("stale"))
+    out.write(f"fleet top — {snap.get('spool_dir')} — {len(procs)} "
+              f"process(es), {stale_n} stale, "
+              f"{snap.get('rejected', 0)} rejected file(s)\n")
+    name_w = max(max(len(n) for n in procs), 7) + 2
+    out.write(f"{'process':<{name_w}}{'role':<8}{'hb':>8}{'queue':>7}"
+              f"{'cache%':>8}{'lane_s':>9}  dominant lane\n")
+    for name in sorted(procs):
+        p = procs[name]
+        tree = p.get("registry") or {}
+        lanes = {k: v for k, v in process_lanes(tree).items() if v > 0}
+        total = sum(lanes.values())
+        sv = tree.get("serve") or {}
+        cache = sv.get("cache") or {}
+        hits = sum(int(cache.get(f"{k}_hits", 0))
+                   for k in ("footer", "plan", "dict"))
+        miss = sum(int(cache.get(f"{k}_misses", 0))
+                   for k in ("footer", "plan", "dict"))
+        rate = f"{100 * hits / (hits + miss):.0f}" if hits + miss else "-"
+        age = p.get("heartbeat_age_s")
+        hb = ("STALE" if p.get("stale")
+              else f"{age:.1f}s" if age is not None else "?")
+        dom = max(lanes, key=lanes.get) if lanes else None
+        out.write(f"{name:<{name_w}}{p.get('role', '?'):<8}{hb:>8}"
+                  f"{sv.get('queue_depth', 0):>7}{rate:>8}{total:>9.3f}  "
+                  + (f"{dom} ({lanes[dom]:.3f}s)" if dom else "-") + "\n")
+    merged = snap.get("registry") or {}
+    msv = merged.get("serve") or {}
+    tenants = {n: t for n, t in (msv.get("tenants") or {}).items()
+               if isinstance(t, dict)}
+    if tenants:
+        hists = merged.get("histograms") or {}
+        out.write("tenants (fleet-merged):\n")
+        out.write(f"  {'name':<16}{'weight':>7}{'submit':>8}{'done':>7}"
+                  f"{'reject':>8}{'p99':>12}\n")
+        for name in sorted(tenants):
+            t = tenants[name]
+            hd = hists.get(f"serve.tenant.{name}")
+            if isinstance(hd, dict):
+                q99 = LatencyHistogram.from_dict(hd).quantile(0.99) * 1e3
+                p99 = f"{q99:>10.2f}ms"
+            else:
+                p99 = f"{'-':>12}"
+            out.write(f"  {name:<16}{t.get('weight', 1):>7}"
+                      f"{t.get('submitted', 0):>8}{t.get('completed', 0):>7}"
+                      f"{t.get('rejected', 0):>8}{p99}\n")
+    rep = doctor_fleet(snap)
+    verdicts = (rep or {}).get("verdicts") or []
+    if not verdicts:
+        out.write("verdicts: none\n")
+        return 0
+    out.write("verdicts:\n")
+    for v in verdicts:
+        kind = v.get("verdict")
+        if kind == "straggler":
+            out.write(f"  straggler: {v.get('process')} ({v.get('role')}) — "
+                      f"dominant lane {v.get('dominant_lane')}, "
+                      f"{float(v.get('deviation', 0)):.2f}x the fleet "
+                      f"median lane-seconds\n")
+        elif kind == "dead-process":
+            out.write(f"  dead-process: {v.get('process')} "
+                      f"({v.get('role')}) — heartbeat "
+                      f"{float(v.get('heartbeat_age_s', 0)):.1f}s old "
+                      f"(stale after {float(v.get('stale_after_s', 0)):g}s)\n")
+        elif kind == "slo-burn":
+            out.write(f"  slo-burn: tenant {v.get('tenant')} p99 "
+                      f"{float(v.get('p99_ms', 0)):.1f}ms over its "
+                      f"{float(v.get('slo_p99_ms', 0)):g}ms budget "
+                      f"(x{float(v.get('burn_ratio', 0)):.2f}"
+                      + (f"; exemplar {v['exemplar_trace']} retained by "
+                         f"{v.get('exemplar_process') or '?'}"
+                         if v.get("exemplar_trace") else "")
+                      + ")\n")
+        else:
+            out.write(f"  {kind}: {v.get('advice', v)}\n")
+    return 0
+
+
+def cmd_top(args, out=sys.stdout) -> int:
+    """``pq_tool top <spool_dir>``: the live fleet dashboard — every
+    process publishing into a ``TPQ_OBS_SPOOL`` directory on one screen
+    (throughput lanes, queue depths, cache hit rates, merged tenant
+    table, active ``straggler``/``dead-process``/``slo-burn`` verdicts),
+    refreshed in place with plain ANSI.  ``--once`` renders a single
+    frame and exits (tests/CI); ``--count`` bounds the refresh loop."""
+    import time as _time
+
+    from ..obs_fleet import FleetAggregator
+
+    agg = FleetAggregator(spool_dir=args.spool,
+                          stale_s=getattr(args, "stale", None))
+    if args.once:
+        return _render_fleet_top(agg.scan(), out)
+    polls = 0
+    rc = 0
+    try:
+        while args.count is None or polls < args.count:
+            if polls:
+                _time.sleep(max(float(args.interval), 0.05))
+            polls += 1
+            out.write("\x1b[2J\x1b[H")  # clear + home — the whole protocol
+            rc = _render_fleet_top(agg.scan(), out)
+            if hasattr(out, "flush"):
+                out.flush()
+    except KeyboardInterrupt:
+        pass
+    return rc
+
+
 def cmd_quarantine(args, out=sys.stdout) -> int:
     """Summarize a run's quarantine ledger (the JSONL ``TPQ_QUARANTINE_LOG``
     wrote, one record per contained data error): totals, per-file /
@@ -1055,7 +1243,8 @@ def build_parser() -> argparse.ArgumentParser:
     tr = sub.add_parser(
         "trace", help="summarize a TPQ_TRACE run (Chrome trace-event JSON, "
                       "or a ledger ref: latest, #N, ledger.jsonl#N)")
-    tr.add_argument("file")
+    tr.add_argument("file", nargs="?", default=None,
+                    help="trace/dump file (optional with --request --spool)")
     tr.add_argument("--config", default=None,
                     help="ledger-ref input: which config's trace artifact "
                          "to summarize (default: the record's first)")
@@ -1063,6 +1252,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="FILE is a tail-sampler dump (ScanService."
                          "trace_dump): print the named retained request's "
                          "span tree (prefix match accepted)")
+    tr.add_argument("--spool", default=None, metavar="DIR",
+                    help="--request: also pool the fleet spool's trace docs "
+                         "(TPQ_OBS_SPOOL dir) and render child-process "
+                         "traces stitched under the request")
     tr.set_defaults(func=cmd_trace)
 
     dr = sub.add_parser(
@@ -1100,12 +1293,32 @@ def build_parser() -> argparse.ArgumentParser:
                          "summarize")
     ss.set_defaults(func=cmd_serve_stats)
 
+    tp = sub.add_parser(
+        "top",
+        help="live fleet dashboard over a TPQ_OBS_SPOOL directory: "
+             "per-process lanes/queues/caches, tenant table, verdicts")
+    tp.add_argument("spool", help="fleet spool directory (TPQ_OBS_SPOOL)")
+    tp.add_argument("--once", action="store_true",
+                    help="render one frame and exit (tests/CI)")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh interval seconds (default 2)")
+    tp.add_argument("--count", type=int, default=None,
+                    help="stop after N refreshes (default: forever)")
+    tp.add_argument("--stale", type=float, default=None,
+                    help="heartbeat staleness threshold seconds "
+                         "(default TPQ_OBS_STALE_S / 10)")
+    tp.set_defaults(func=cmd_top)
+
     mt = sub.add_parser(
         "metrics",
         help="OpenMetrics exposition of a registry snapshot "
              "(TPQ_METRICS_DUMP output); two snapshots diff; --watch polls")
-    mt.add_argument("file", help="registry snapshot JSON, trace/bench "
-                                 "artifact, or ledger ref")
+    mt.add_argument("file", nargs="?", default=None,
+                    help="registry snapshot JSON, trace/bench artifact, or "
+                         "ledger ref (optional with --spool)")
+    mt.add_argument("--spool", default=None, metavar="DIR",
+                    help="render the aggregated fleet spool instead: every "
+                         "per-process series labelled host/pid/role")
     mt.add_argument("file2", nargs="?", default=None,
                     help="second snapshot: print numeric counter deltas "
                          "FILE -> FILE2 instead of rendering")

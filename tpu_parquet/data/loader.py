@@ -863,6 +863,16 @@ class DataLoader:
             lane = watchdog.watch_consumer()
             self._watchdog = watchdog  # _blocks registers its budget's abort
             watchdog.start()
+        # fleet spool (TPQ_OBS_SPOOL; inert when unset): this loader
+        # process's registry + any cross-process request trace it adopted
+        # (TPQ_TRACE_CONTEXT) become visible to the FleetAggregator
+        from ..obs_fleet import SpoolWriter, ambient_request_trace
+
+        spool_tr = ambient_request_trace()
+        spool = SpoolWriter(
+            self.obs_registry, role="loader",
+            sampler=lambda: [spool_tr.as_dict()] if spool_tr else [])
+        spool.start()
         # per-epoch error-budget scope: the fraction denominator is this
         # shard's unit count; the ledger and skip counters are cumulative
         self._quarantine.begin_scan(len(self._my_units))
@@ -900,6 +910,7 @@ class DataLoader:
             watchdog.stop()  # thread-leak-safe even on early abandon
             self._watchdog = None
             sampler.stop()
+            spool.stop()  # publishes a final generation, joins (no leak)
             gen.close()
             if self._owns_tracer:
                 # per-loader trace artifact: rewrite (cumulatively) at every

@@ -1667,6 +1667,182 @@ def crafted_request_trace_blobs() -> "list[bytes]":
     return [deep, storm, churn, early, spread]
 
 
+def fuzz_fleet_snapshot(data: bytes) -> None:
+    """Fleet-spool op-stream interpreter (obs_fleet.py, ISSUE 20): the
+    blob picks the member count, per-member retained generations, and
+    staleness threshold, then drives counter bumps / gauge raises /
+    histogram records / ``publish_once`` / torn-file injection /
+    dead-member injection / full aggregation scans against one spool
+    directory.  Whatever the stream does: fleet counters reconcile
+    EXACTLY with the sum of each member's last-published model, gauges
+    (``workers``) merge as the max, merged histogram counts equal the
+    published sum and every exemplar's raw value re-derives its bucket,
+    torn/truncated/garbage files are counted rejected (exactly) and are
+    never fatal, injected dead members always read stale, per-member
+    heartbeats are monotonic across generations, and pruning never
+    retains more than ``keep`` generations — anything else is a finding.
+    """
+    import json
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import time
+
+    from .obs import LatencyHistogram, StatsRegistry
+    from .obs_fleet import FleetAggregator, SpoolWriter
+
+    if len(data) < 4:
+        return
+    n_members = 1 + data[0] % 4
+    keep = 1 + data[1] % 3
+    stale_s = 0.5 + (data[2] & 3)
+    ops = data[3:131]
+    tmp = _tempfile.mkdtemp(prefix="tpq-fuzz-spool-")
+    try:
+        members = []
+        for m in range(n_members):
+            reg = StatsRegistry()
+            members.append({
+                "reg": reg,
+                "w": SpoolWriter(reg, role=("serve", "loader", "writer")[
+                    m % 3], spool_dir=tmp, keep=keep,
+                    host=f"h{m % 2}", pid=1000 + m),
+                "rows": 0, "workers": 0, "hist": 0,
+                "pub": None, "hb": -1.0,
+            })
+        agg = FleetAggregator(spool_dir=tmp, stale_s=stale_s)
+        garbage = dead = 0
+
+        def check_scan():
+            snap = agg.scan()
+            if snap["rejected"] != garbage:
+                raise AssertionError(
+                    f"{garbage} garbage file(s) written but "
+                    f"{snap['rejected']} rejected")
+            pubs = [mm["pub"] for mm in members if mm["pub"] is not None]
+            live = len(pubs)
+            if len(snap["processes"]) != live + dead:
+                raise AssertionError(
+                    f"{live} live + {dead} dead member(s) but "
+                    f"{len(snap['processes'])} in the fleet snapshot")
+            wr = (snap["registry"].get("write") or {})
+            want_rows = sum(p["rows"] for p in pubs)
+            if int(wr.get("rows", 0)) != want_rows:
+                raise AssertionError(
+                    f"fleet write.rows {wr.get('rows')} != published sum "
+                    f"{want_rows}")
+            want_workers = max((p["workers"] for p in pubs), default=0)
+            if int(wr.get("workers", 0)) != want_workers:
+                raise AssertionError(
+                    f"fleet write.workers {wr.get('workers')} != published "
+                    f"max {want_workers}")
+            hd = (snap["registry"].get("histograms") or {}).get(
+                "serve.request") or {}
+            want_n = sum(p["hist"] for p in pubs)
+            if int(hd.get("count", 0)) != want_n:
+                raise AssertionError(
+                    f"fleet histogram count {hd.get('count')} != published "
+                    f"sum {want_n}")
+            for bi, ex in (hd.get("exemplars") or {}).items():
+                if LatencyHistogram.bucket_index(float(ex[1])) != int(bi):
+                    raise AssertionError(
+                        f"merged exemplar {ex} under bucket {bi} re-derives "
+                        f"{LatencyHistogram.bucket_index(float(ex[1]))}")
+            for key, p in snap["processes"].items():
+                if key.startswith("dead") and not p["stale"]:
+                    raise AssertionError(
+                        f"injected dead member {key} not flagged stale: {p}")
+
+        for i, b in enumerate(ops):
+            op, arg = b >> 5, b & 31
+            mem = members[arg % n_members]
+            if op in (0, 1):
+                mem["reg"].add_write({"rows": arg + 1})
+                mem["rows"] += arg + 1
+            elif op == 2:
+                mem["reg"].add_write({"workers": arg})
+                mem["workers"] = max(mem["workers"], arg)
+            elif op == 3:
+                mem["reg"].histogram("serve.request").record(
+                    (arg + 1) * 1e-4, exemplar=f"t-{arg}-{i}")
+                mem["hist"] += 1
+            elif op == 4:
+                path = mem["w"].publish_once()
+                if path is None:
+                    raise AssertionError(
+                        f"publish_once failed with a live spool dir "
+                        f"({mem['w'].dropped} dropped)")
+                with open(path) as f:
+                    doc = json.load(f)
+                if doc["heartbeat_ts"] < mem["hb"]:
+                    raise AssertionError(
+                        f"heartbeat went backwards: {doc['heartbeat_ts']} "
+                        f"after {mem['hb']}")
+                mem["hb"] = doc["heartbeat_ts"]
+                mem["pub"] = {"rows": mem["rows"],
+                              "workers": mem["workers"],
+                              "hist": mem["hist"]}
+            elif op == 5:
+                kind = arg % 3
+                blob = (b"{torn" if kind == 0
+                        else b"[1, 2, 3]" if kind == 1
+                        else json.dumps({"spool_version": 999, "host": "x",
+                                         "pid": 1, "seq": 1,
+                                         "heartbeat_ts": 0,
+                                         "registry": {}}).encode())
+                with open(os.path.join(tmp, f"zz-garbage-{i}.json"),
+                          "wb") as f:
+                    f.write(blob)
+                garbage += 1
+            elif op == 6:
+                doc = {"spool_version": 1, "host": f"dead{i}", "pid": 9000,
+                       "role": "loader", "seq": 1,
+                       "heartbeat_ts": time.time() - 3600.0,
+                       "registry": StatsRegistry().as_dict(), "traces": []}
+                with open(os.path.join(tmp, f"dead{i}-9000.00000001.json"),
+                          "w") as f:
+                    json.dump(doc, f)
+                dead += 1
+            else:
+                check_scan()
+        check_scan()
+        for mem in members:
+            prefix = f"{mem['w']._member}."
+            mine = [fn for fn in os.listdir(tmp) if fn.startswith(prefix)
+                    and fn.endswith(".json")]
+            if len(mine) > keep:
+                raise AssertionError(
+                    f"prune kept {len(mine)} generation(s) of "
+                    f"{mem['w']._member}, cap {keep}: {sorted(mine)}")
+    finally:
+        _shutil.rmtree(tmp, ignore_errors=True)
+
+
+def crafted_fleet_snapshot_blobs() -> "list[bytes]":
+    """Hand-crafted ``fleet_snapshot`` inputs (and corpus blobs): a
+    publish/scan cadence across 4 members, a garbage storm against one
+    publishing member, a keep=1 prune churn with gauge raises, a
+    dead-member graveyard, and a histogram/exemplar spread — each ends in
+    a full-invariant aggregation scan."""
+    BUMP, GAUGE, HIST, PUB, TORN, DEAD, SCAN = (
+        0 << 5, 2 << 5, 3 << 5, 4 << 5, 5 << 5, 6 << 5, 7 << 5)
+    cadence = bytes([3, 1, 1]) + bytes(
+        b for i in range(8)
+        for b in (BUMP | (i % 4), HIST | (i % 4), PUB | (i % 4), SCAN))
+    storm = bytes([0, 1, 0]) + bytes(
+        b for i in range(10)
+        for b in (BUMP | 0, TORN | (i % 3), PUB | 0, SCAN))
+    churn = bytes([0, 0, 2]) + bytes(
+        b for i in range(12)
+        for b in (GAUGE | (i % 8), BUMP | 0, PUB | 0)) + bytes([SCAN])
+    graveyard = bytes([1, 1, 3]) + bytes(
+        b for i in range(6) for b in (DEAD | 0, PUB | 0)) + bytes(
+        [SCAN, DEAD | 0, SCAN])
+    spread = bytes([2, 2, 0]) + bytes(
+        b for i in range(20) for b in (HIST | (i % 32 & 31), PUB | (i % 2))
+    ) + bytes([SCAN])
+    return [cadence, storm, churn, graveyard, spread]
+
+
 TARGETS = {
     "file_reader": fuzz_file_reader,
     "thrift": fuzz_thrift,
@@ -1691,6 +1867,7 @@ TARGETS = {
     "stream_cursor": fuzz_stream_cursor,
     "fetch_engine": fuzz_fetch_engine,
     "request_trace": fuzz_request_trace,
+    "fleet_snapshot": fuzz_fleet_snapshot,
 }
 
 
@@ -1904,6 +2081,8 @@ def _seed_inputs(target: str) -> list[bytes]:
         return crafted_fetch_engine_blobs()
     if target == "request_trace":
         return crafted_request_trace_blobs()
+    if target == "fleet_snapshot":
+        return crafted_fleet_snapshot_blobs()
     if target == "loader_state":
         from .data import checkpoint as ck
 

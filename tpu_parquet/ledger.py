@@ -67,6 +67,8 @@ _ENV_KEYS = (
     "TPQ_CIRCUIT_COOLDOWN_S",
     "TPQ_TRACE_TAIL", "TPQ_TRACE_RING", "TPQ_TRACE_SPANS",
     "TPQ_TRACE_SLOW_Q", "TPQ_METRICS_DUMP",
+    "TPQ_OBS_SPOOL", "TPQ_OBS_SPOOL_S", "TPQ_OBS_SPOOL_KEEP",
+    "TPQ_OBS_STALE_S", "TPQ_SERVE_STREAM_YIELD",
     "BENCH_SCALE", "BENCH_DEVICE_REPS",
     "BENCH_BASELINE_REPS", "BENCH_RESAMPLE", "BENCH_CONFIGS",
     "JAX_PLATFORMS",

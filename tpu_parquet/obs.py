@@ -82,7 +82,7 @@ __all__ = [
     "Sampler", "StatsRegistry", "TailSampler", "Tracer", "Watchdog",
     "autopsy_dump",
     "current_request_trace", "current_tracer", "doctor_registry",
-    "env_float", "env_int",
+    "env_float", "env_int", "fleet_host",
     "flight_dump_path",
     "flight_recorder", "install_flight_hooks", "note_worker_crash",
     "register_flight_registry", "register_flight_source",
@@ -315,6 +315,21 @@ def _mint_trace_id() -> str:
         return f"{_trace_base}-{_trace_seq:06x}"
 
 
+_fleet_host_cache: "str | None" = None
+
+
+def fleet_host() -> str:
+    """This process's host name as it appears in spool snapshots and
+    stitched traces (cached; never raises)."""
+    global _fleet_host_cache
+    if _fleet_host_cache is None:
+        try:
+            _fleet_host_cache = os.uname().nodename or "localhost"
+        except (AttributeError, OSError):
+            _fleet_host_cache = "localhost"
+    return _fleet_host_cache
+
+
 class _TraceSpan:
     """Span context manager for :class:`RequestTrace` (slots, one lock
     round-trip per open and per close)."""
@@ -349,13 +364,15 @@ class RequestTrace:
     """
 
     __slots__ = ("trace_id", "t0", "t0_unix", "duration_s", "spans",
-                 "max_spans", "dropped", "error", "flags", "_lock", "_local")
+                 "max_spans", "dropped", "error", "flags", "origin",
+                 "_lock", "_local")
 
     def __init__(self, trace_id: "str | None" = None,
                  max_spans: "int | None" = None):
         if max_spans is None:
             max_spans = env_int("TPQ_TRACE_SPANS", 512, lo=1)
         self.trace_id = trace_id or _mint_trace_id()
+        self.origin: "dict | None" = None
         self.t0 = time.perf_counter()
         self.t0_unix = time.time()
         self.duration_s: "float | None" = None
@@ -457,6 +474,41 @@ class RequestTrace:
                                - (self.t0 + s[1]), 0.0)
             return self.duration_s
 
+    # -- cross-process stitching ----------------------------------------------
+
+    def trace_context(self) -> dict:
+        """Exportable context blob identifying this request across process
+        seams — hand it (e.g. JSON via ``TPQ_TRACE_CONTEXT``) to a child
+        process whose traces should re-parent under this request."""
+        return {
+            "trace_version": TRACE_VERSION,
+            "trace_id": self.trace_id,
+            "host": fleet_host(),
+            "pid": os.getpid(),
+            "t0_unix": round(self.t0_unix, 3),
+        }
+
+    @classmethod
+    def adopt_context(cls, ctx: dict,
+                      max_spans: "int | None" = None) -> "RequestTrace":
+        """Create a child-process trace re-parented under the originating
+        request described by ``ctx`` (a :meth:`trace_context` blob).  The
+        child gets its OWN trace id (ids stay process-unique); ``origin``
+        records the parent so the aggregated view can stitch the trees.
+        Raises ``ValueError`` on a malformed blob — callers adopting from
+        an env var degrade via ``warn_env_once`` instead."""
+        if not isinstance(ctx, dict):
+            raise ValueError(f"trace context must be a dict, got "
+                             f"{type(ctx).__name__}")
+        tid = ctx.get("trace_id")
+        if not isinstance(tid, str) or not tid:
+            raise ValueError(f"trace context missing trace_id: {ctx!r}")
+        tr = cls(max_spans=max_spans)
+        tr.origin = {"trace_id": tid,
+                     "host": str(ctx.get("host") or "unknown"),
+                     "pid": int(ctx.get("pid") or 0)}
+        return tr
+
     # -- export ---------------------------------------------------------------
 
     def as_dict(self) -> dict:
@@ -468,9 +520,11 @@ class RequestTrace:
                 "parent": s[3],
                 **({"args": s[4]} if s[4] else {}),
             } for s in self.spans]
-            return {
+            doc = {
                 "trace_version": TRACE_VERSION,
                 "trace_id": self.trace_id,
+                "host": fleet_host(),
+                "pid": os.getpid(),
                 "t0_unix": round(self.t0_unix, 3),
                 "duration_s": (round(self.duration_s, 6)
                                if self.duration_s is not None else None),
@@ -479,6 +533,9 @@ class RequestTrace:
                 "dropped": self.dropped,
                 "spans": spans,
             }
+            if self.origin:
+                doc["origin"] = dict(self.origin)
+            return doc
 
 
 # the request trace of the thread currently executing a request — how code

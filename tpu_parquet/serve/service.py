@@ -34,6 +34,7 @@ bounded admission pipeline in front of the same readers:
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -228,6 +229,9 @@ class ServeStats:
         # most recent shed/reject carried (obs merges max it)
         self.stream_sessions = 0
         self.stream_batches = 0
+        # mid-stream worker-slot yields (stream-aware fair scheduling):
+        # how many times a session parked so another tenant could run
+        self.stream_yields = 0
         self.retry_after_hint_s = 0.0
 
     def as_dict(self) -> dict:
@@ -246,6 +250,7 @@ class ServeStats:
                 "sheds": {"low": self.shed_low, "normal": self.shed_normal},
                 "stream_sessions": self.stream_sessions,
                 "stream_batches": self.stream_batches,
+                "stream_yields": self.stream_yields,
                 "retry_after_hint_s": self.retry_after_hint_s,
             }
 
@@ -263,7 +268,8 @@ class ScanService:
                  result_cache_mb: "int | None" = None,
                  result_cache_hbm_mb: "int | None" = None,
                  tenants: "TenantRegistry | Mapping | str | None" = None,
-                 fair: "bool | None" = None):
+                 fair: "bool | None" = None,
+                 stream_yield: "bool | None" = None):
         from ..iostore import ByteStore
 
         if concurrency is None:
@@ -368,6 +374,23 @@ class ScanService:
         # the file `pq_tool metrics --watch` polls; inert when unset
         self._dumper = MetricsDumper(self.obs_registry)
         self._dumper.start()
+        # fleet spool: per-process snapshots into TPQ_OBS_SPOOL (obs_fleet;
+        # inert when unset) — what FleetAggregator / `pq_tool top` read
+        from ..obs_fleet import SpoolWriter
+
+        self._spool = SpoolWriter(self.obs_registry, role="serve",
+                                  sampler=self.sampler)
+        self._spool.start()
+        # stream-aware fair scheduling: a streaming session hands its
+        # worker slot back between batches while another tenant has queued
+        # work (DRR at batch granularity).  Only meaningful under fair
+        # scheduling; TPQ_SERVE_STREAM_YIELD=0 (or stream_yield=False)
+        # pins a session to its slot for its whole lifetime (the old
+        # behavior, and the bench A/B).
+        if stream_yield is None:
+            stream_yield = os.environ.get(
+                "TPQ_SERVE_STREAM_YIELD", "1") != "0"
+        self._stream_yield = bool(stream_yield) and self._q.fair
         self._inflight: dict = {}  # rid -> (path0, t_start)
         self._inflight_lock = threading.Lock()
         self._closed = False
@@ -576,6 +599,7 @@ class ScanService:
             with self._inflight_lock:
                 self._inflight[ticket.id] = (str(first), t_start)
             rows = 0
+            yielded = False
             try:
                 # a request that expired (or was cancelled) while queued
                 # fails HERE, typed, before any byte is charged or read
@@ -583,16 +607,62 @@ class ScanService:
                 if session is not None:
                     # the session IS the response: the caller's result()
                     # unblocks with it now, batches flow as they decode.
-                    # A streaming session occupies this worker slot until
-                    # it drains, errors, or is cancelled.
-                    ticket._finish(result=session)
-                    rows = session._produce()
+                    # Under stream-aware fair scheduling the session hands
+                    # this slot back between batches whenever another
+                    # tenant has queued work; otherwise it occupies the
+                    # slot until it drains, errors, or is cancelled.
+                    if not ticket.done():
+                        ticket._finish(result=session)
+                    ycheck = None
+                    if self._stream_yield and tenant is not None:
+                        tname = tenant.name
+                        ycheck = (lambda _t=tname:
+                                  self._q.has_other_waiters(_t))
+                    finished = session._produce(yield_check=ycheck)
+                    if not finished and self._closed:
+                        # closed while mid-yield: requeueing would strand
+                        # the session behind the shutdown sentinels
+                        exc0 = CancelledError(
+                            "scan service closed; streaming session "
+                            "terminated")
+                        session._abort(exc0)
+                        raise exc0
+                    yielded = not finished
+                    rows = session.rows_emitted
                     result, exc = session, None
                 else:
                     result, exc = self._execute(request, ticket.token), None
                     rows = _count_rows(result)
             except BaseException as e:  # noqa: BLE001 — delivered to caller
                 result, exc = None, e
+                yielded = False
+                # a continuation leg's ticket already resolved to the
+                # session — its failure must reach the consumer through
+                # the session buffer (first verdict wins; idempotent)
+                if session is not None and ticket.done():
+                    session._fail(e)
+            if yielded:
+                # mid-stream slot yield: book this leg's seconds, hand the
+                # slot back, requeue the session as a fresh arrival (DRR
+                # charges the tenant's deficit again — batch-granular
+                # fairness).  No completion bookkeeping: the stream is
+                # still live and a later leg finishes it.
+                t_end = time.perf_counter()
+                if trace is not None:
+                    set_request_trace(prev_trace)
+                with self._inflight_lock:
+                    self._inflight.pop(ticket.id, None)
+                with self.stats.lock:
+                    self.stats.queue_wait_seconds += wait
+                    self.stats.exec_seconds += t_end - t_start
+                    self.stats.stream_yields += 1
+                with tenant.lock:
+                    tenant.queue_wait_seconds += wait
+                    tenant.exec_seconds += t_end - t_start
+                self._q.requeue(tenant.name, tenant.weight,
+                                (ticket, request, time.perf_counter(),
+                                 session))
+                continue
             # ALL bookkeeping lands before _finish sets the ticket's event:
             # a caller waking from result() must read final exec_s/stats,
             # never a zero the worker hadn't written yet
@@ -899,7 +969,11 @@ class ScanService:
                 with self._inflight_lock:
                     self._streams.pop(ticket.id, None)
                 session._abort(exc)
-            ticket._finish(exc=exc)
+            # a yielded streaming continuation's ticket already resolved
+            # to its session — the abort above delivered the verdict; a
+            # re-finish would clobber the caller's result
+            if not ticket.done():
+                ticket._finish(exc=exc)
         with self._inflight_lock:
             live = list(self._streams.values())
         for session in live:
@@ -910,6 +984,7 @@ class ScanService:
         for t in self._workers:
             t.join(timeout=60)
         self._dumper.stop()
+        self._spool.stop()
 
     def __enter__(self) -> "ScanService":
         return self

@@ -276,11 +276,16 @@ class StreamingScan:
         self._batches_taken = (resume_state["batches_emitted"]
                                if resume_state else 0)
         self._resume = resume_state
+        # the persistent producer generator (stream-aware fair scheduling:
+        # a worker can park the session mid-file and a later leg — on any
+        # worker — resumes exactly where the last batch left off)
+        self._gen = None
         # structural warm/cold accounting (tests + serve stats)
         self.warm_batches = 0
         self.cold_groups = 0
         self.warm_groups = 0
         self.rows_emitted = 0
+        self.slot_yields = 0
         # a cancel flips the terminal latch immediately — a blocked
         # next() caller sees the verdict on its next poll tick instead of
         # only at the producer's next boundary
@@ -377,8 +382,14 @@ class StreamingScan:
     def _fail(self, exc: BaseException) -> None:
         """Producer-side failure delivery: latch the verdict and try to
         queue it BEHIND already-buffered batches (the consumer drains good
-        work first, then sees the typed error)."""
-        self._note_terminal(exc)
+        work first, then sees the typed error).  First verdict wins —
+        a later failure of an already-terminal session is not re-queued."""
+        with self._lock:
+            already = self._terminal is not None
+            if not already:
+                self._terminal = exc
+        if already:
+            return
         try:
             self._buf.put_nowait(("error", exc, None))
         except queue.Full:
@@ -390,7 +401,21 @@ class StreamingScan:
         ``exc`` within one poll tick."""
         self.token.cancel(exc)
         self._note_terminal(exc)
+        self._close_gen()
         self._drain_release()
+
+    def _close_gen(self) -> None:
+        """Release a parked producer generator's resources (its open
+        FileReader).  A generator mid-``next()`` on a worker cannot be
+        closed from here — the cancelled token stops it at its next
+        boundary instead."""
+        gen = self._gen
+        self._gen = None
+        if gen is not None:
+            try:
+                gen.close()
+            except (ValueError, RuntimeError):
+                pass  # executing on a worker right now
 
     def _drain_release(self) -> None:
         while True:
@@ -447,32 +472,56 @@ class StreamingScan:
                             nbytes=nbytes, path_index=path_index,
                             file_done=file_done)
 
-    def _produce(self) -> int:
-        """The producer loop: per file, per surviving row group, decode
-        (or serve warm), slice into fixed-row batches, buffer.  Returns
-        the total unpadded rows emitted.  Exceptions propagate to the
-        worker (which counts them) after being delivered to the consumer
-        via :meth:`_fail`."""
+    def _produce(self, yield_check=None) -> bool:
+        """Drive the producer: per file, per surviving row group, decode
+        (or serve warm), slice into fixed-row batches, buffer.
+
+        With ``yield_check`` set (stream-aware fair scheduling), the leg
+        parks after any emitted batch for which ``yield_check()`` is true
+        and returns ``False`` — the worker requeues the session and a
+        later leg resumes the SAME generator (same reader, same pending
+        remainder) exactly where it left off.  Returns ``True`` when the
+        stream is fully produced.  Exceptions propagate to the worker
+        (which counts them) after being delivered to the consumer via
+        :meth:`_fail`."""
+        try:
+            gen = self._gen
+            if gen is None:
+                gen = self._gen = self._produce_gen()
+            while True:
+                try:
+                    next(gen)
+                except StopIteration:
+                    self._gen = None
+                    return True
+                if yield_check is not None and yield_check():
+                    self.slot_yields += 1
+                    return False
+        except BaseException as e:  # noqa: BLE001 — delivered to consumer
+            self._gen = None
+            self._fail(e)
+            raise
+
+    def _produce_gen(self):
+        """The producer generator: yields once per emitted batch (the
+        slot-yield boundaries)."""
         token = self.token
         req = self.request
         start = self._cur_path if self._resume is not None else 0
         skip = self._cur_rows if self._resume is not None else 0
-        try:
-            for pi in range(start, len(req.paths)):
-                token.check()
-                self._stream_file(pi, req.paths[pi], skip)
-                skip = 0
-            self._push(("end", None, None), token)
-        except BaseException as e:  # noqa: BLE001 — delivered to consumer
-            self._fail(e)
-            raise
-        return self.rows_emitted
+        for pi in range(start, len(req.paths)):
+            token.check()
+            yield from self._stream_file(pi, req.paths[pi], skip)
+            skip = 0
+        self._push(("end", None, None), token)
 
-    def _stream_file(self, path_index: int, path, skip_rows: int) -> None:
-        """Stream one file: warm groups straight from the result cache,
-        cold groups through a lazily-opened plan-replaying FileReader.
-        ``skip_rows`` (resume) skips whole groups by plan row counts —
-        no IO, no decode — then slices into the first partial group."""
+    def _stream_file(self, path_index: int, path, skip_rows: int):
+        """Stream one file (generator: yields after every emitted batch —
+        the slot-yield boundaries): warm groups straight from the result
+        cache, cold groups through a lazily-opened plan-replaying
+        FileReader.  ``skip_rows`` (resume) skips whole groups by plan row
+        counts — no IO, no decode — then slices into the first partial
+        group."""
         from ..reader import FileReader
         from .cache import BoundDictCache
         from .service import _CLASSIFIED_FAILURES
@@ -578,6 +627,7 @@ class StreamingScan:
                     # the carried remainder came from the group decoded
                     # LAST — its temperature is the remainder's
                     pend_cold = cold if pend_n else False
+                    yield None  # slot-yield boundary (state is consistent)
             if pend_n:
                 tail = {c: (np.concatenate(pend[c])
                             if len(pend[c]) > 1 else pend[c][0])
@@ -585,6 +635,7 @@ class StreamingScan:
                 if not pend_cold:
                     self.warm_batches += 1
                 self._emit(token, path_index, tail, pend_n, 0, True)
+                yield None
         except _CLASSIFIED_FAILURES:
             self.breaker_note(bkey, path, ok=False)
             raise

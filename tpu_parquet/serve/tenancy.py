@@ -30,16 +30,18 @@ always outrank queued work.
 
 from __future__ import annotations
 
+import json
 import os
 import queue
 import threading
 from collections import OrderedDict, deque
 
 from ..alloc import InFlightBudget
-from ..obs import LatencyHistogram
+from ..obs import LatencyHistogram, warn_env_once
 
 __all__ = ["DEFAULT_TENANT", "FairScheduler", "Tenant", "TenantRegistry",
-           "fair_enabled", "parse_tenant_spec"]
+           "fair_enabled", "load_tenant_file", "parse_tenant_spec",
+           "tenant_table"]
 
 # requests that name no tenant all land here — single-tenant deployments
 # never see tenancy at all (one queue, the whole budget, weight 1)
@@ -55,16 +57,77 @@ def fair_enabled(flag: "bool | None" = None) -> bool:
     return os.environ.get("TPQ_SERVE_FAIR", "1") != "0"
 
 
+def load_tenant_file(path: str) -> "dict[str, dict]":
+    """Parse a shared tenants.json (the ``TPQ_SERVE_TENANTS=@/path`` form
+    — one tenant table every process in a fleet loads): ``{"name":
+    weight_int | {"weight": w, "deadline_s": d, "slo_p99_ms": s}}``.
+    Returns ``{name: {"weight", "deadline_s", "slo_p99_ms"}}``.  A
+    missing/unreadable/malformed file degrades to an empty table via one
+    :func:`warn_env_once` line, never raises — a bad shared config must
+    not take a fleet member down."""
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+        if not isinstance(raw, dict):
+            raise ValueError("tenant table must be a JSON object")
+    except (OSError, ValueError) as e:
+        warn_env_once("TPQ_SERVE_TENANTS", f"@{path} ({e})", None)
+        return {}
+    out: "dict[str, dict]" = {}
+    for name, cfg in raw.items():
+        name = str(name).strip()
+        if not name:
+            continue
+        if isinstance(cfg, bool):
+            continue
+        if isinstance(cfg, (int, float)):
+            cfg = {"weight": cfg}
+        if not isinstance(cfg, dict):
+            continue  # a malformed entry, not a malformed table
+
+        def fnum(key, lo=None, _cfg=cfg):
+            v = _cfg.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                return None
+            v = float(v)
+            return v if (lo is None or v > lo) else None
+
+        w = fnum("weight", lo=0)
+        out[name] = {
+            "weight": max(int(w), 1) if w is not None else 1,
+            "deadline_s": fnum("deadline_s", lo=0),
+            "slo_p99_ms": fnum("slo_p99_ms", lo=0),
+        }
+    return out
+
+
+def tenant_table(spec: "str | None") -> "dict[str, dict]":
+    """Resolve a ``TPQ_SERVE_TENANTS`` value — inline ``name=weight:
+    deadline_s`` pairs or the ``@/path/to/tenants.json`` shared-file form
+    — to ``{name: {"weight", "deadline_s", "slo_p99_ms"}}``."""
+    if not spec:
+        return {}
+    spec = str(spec).strip()
+    if spec.startswith("@"):
+        return load_tenant_file(spec[1:])
+    return {name: {"weight": w, "deadline_s": d, "slo_p99_ms": None}
+            for name, (w, d) in _parse_inline_spec(spec).items()}
+
+
 def parse_tenant_spec(spec: "str | None") -> "dict[str, tuple]":
     """Parse ``TPQ_SERVE_TENANTS``: ``"name=weight:deadline_s,..."``
     (weight optional, defaults 1, floored at 1; ``:deadline_s`` optional —
-    a per-tenant default request deadline in seconds).  Returns
+    a per-tenant default request deadline in seconds) or
+    ``@/path/to/tenants.json`` (see :func:`load_tenant_file`).  Returns
     ``{name: (weight, deadline_s_or_None)}``.  Malformed entries are
     ignored rather than raised — a bad env var must not take the serve
     tier down at import time."""
+    return {name: (cfg["weight"], cfg["deadline_s"])
+            for name, cfg in tenant_table(spec).items()}
+
+
+def _parse_inline_spec(spec: str) -> "dict[str, tuple]":
     out: "dict[str, tuple]" = {}
-    if not spec:
-        return out
     for part in str(spec).split(","):
         part = part.strip()
         if not part:
@@ -173,12 +236,20 @@ class TenantRegistry:
         self._tenants: "dict[str, Tenant]" = {}
         if spec is None:
             spec = os.environ.get("TPQ_SERVE_TENANTS")
-        for name, (weight, deadline) in parse_tenant_spec(spec).items():
-            self._tenants[name] = Tenant(name, weight=weight,
-                                         deadline_s=deadline)
+        for name, cfg in tenant_table(spec).items():
+            self._tenants[name] = Tenant(name, weight=cfg["weight"],
+                                         deadline_s=cfg["deadline_s"],
+                                         slo_p99_ms=cfg["slo_p99_ms"])
         if DEFAULT_TENANT not in self._tenants:
             self._tenants[DEFAULT_TENANT] = Tenant(DEFAULT_TENANT)
         self._rebalance_locked()
+
+    @classmethod
+    def from_file(cls, path: str, max_memory: int = 0) -> "TenantRegistry":
+        """The fleet form: every process loads ONE shared tenants.json
+        (equivalent to ``spec="@"+path``; malformed degrades to the
+        default table, never raises)."""
+        return cls(max_memory=max_memory, spec=f"@{path}")
 
     def _rebalance_locked(self) -> None:
         total = sum(t.weight for t in self._tenants.values()) or 1
@@ -290,6 +361,31 @@ class FairScheduler:
             q.append((self._seq, item))
             self._size += 1
             self._cv.notify()
+
+    def requeue(self, tenant: str, weight: int, item) -> None:
+        """Re-enqueue ALREADY-ADMITTED work (a streaming session yielding
+        its worker slot between batches).  Exempt from the ``maxsize``
+        bound — the item was admitted once and must never bounce on
+        re-entry — but it takes a fresh arrival sequence, so DRR charges
+        the tenant's deficit again per leg (batch-granular fairness)."""
+        with self._cv:
+            q = self._queues.get(tenant)
+            if q is None:
+                q = self._queues[tenant] = deque()
+                self._order.append(tenant)
+                self._deficit[tenant] = 0.0
+            self._weights[tenant] = max(int(weight), 1)
+            self._seq += 1
+            q.append((self._seq, item))
+            self._size += 1
+            self._cv.notify()
+
+    def has_other_waiters(self, tenant: str) -> bool:
+        """True when any OTHER tenant has queued work — the stream-yield
+        trigger (a lone tenant's stream keeps its slot; handing it back
+        would only add requeue latency)."""
+        with self._cv:
+            return any(q for t, q in self._queues.items() if t != tenant)
 
     def put_sentinel(self) -> None:
         """Queue one worker-shutdown sentinel (``get`` returns ``None``).

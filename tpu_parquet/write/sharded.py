@@ -273,7 +273,30 @@ def write_sharded(out, schema, row_groups, *, workers=None, layout=None,
     st.touch_wall()
     budget = InFlightBudget(max_memory)
 
+    # fleet seam: adopt the originating request's trace context (if the
+    # caller exported one across the process boundary) so encode spans
+    # land in a child trace that stitches back under the parent, and arm
+    # a writer-role spool snapshot (inert unless TPQ_OBS_SPOOL is set)
+    from ..obs_fleet import SpoolWriter, ambient_request_trace
+
+    tr = ambient_request_trace()
+
+    def _spool_tree():
+        from ..obs import StatsRegistry
+
+        reg = StatsRegistry()
+        reg.add_write(st)
+        return reg
+
+    spool = SpoolWriter(
+        _spool_tree, role="writer",
+        sampler=lambda: [tr.as_dict()] if tr is not None else [])
+
     def encode(batch):
+        if tr is not None:
+            with tr.span("encode", role="writer"):
+                return encode_row_group(schema, batch, stats=st,
+                                        **writer_opts)
         return encode_row_group(schema, batch, stats=st, **writer_opts)
 
     # prefetch == requested worker count, so the pool never exceeds it (a
@@ -300,6 +323,7 @@ def write_sharded(out, schema, row_groups, *, workers=None, layout=None,
             replaced.append(path)
         return _FilePart(path, schema, created_by, kv_metadata, st)
 
+    spool.start()
     try:
         for blob, meta in results:
             if part is None:
@@ -329,6 +353,8 @@ def write_sharded(out, schema, row_groups, *, workers=None, layout=None,
         if part is not None:
             part.abort()
         raise
+    finally:
+        spool.stop()  # publishes a final generation, joins (no leak)
 
     res.paths = member_paths
     if layout == "manifest":
